@@ -1,0 +1,63 @@
+"""TensorFlowOnSpark-TPU: a TPU-native distributed ML framework.
+
+A ground-up redesign of the capabilities of TensorFlowOnSpark
+(reference: tensorflowonspark/ @ v2.2.0) for TPU pods:
+
+- Cluster orchestration: turn a fleet of executors (Spark or local
+  processes) into a JAX/XLA accelerator cluster with one API call
+  (reference: tensorflowonspark/TFCluster.py).
+- Data bridging: stream RDD/DataFrame/iterator data into device-resident
+  JAX arrays and pull results back (reference: tensorflowonspark/TFNode.py
+  DataFeed, TFSparkNode.py train/inference paths).
+- ML pipeline Estimator/Model wrappers (reference: tensorflowonspark/pipeline.py).
+- TFRecord <-> columnar-data interchange (reference: tensorflowonspark/dfutil.py,
+  src/main/scala/com/yahoo/tensorflowonspark/DFUtil.scala).
+- First-class mesh parallelism the reference delegated or lacked:
+  DP/TP/PP/SP(ring attention, Ulysses)/EP over a jax.sharding.Mesh with
+  XLA collectives riding ICI.
+
+The compute core is JAX/XLA/pallas; the orchestration layer is pure
+Python with a C++ fast path for the TFRecord codec.
+"""
+
+import logging
+
+# Library etiquette: never configure the root logger at import time.
+# Framework-owned processes (executor runners, serving CLI) call
+# ``setup_logging`` in their own bootstrap instead.
+logging.getLogger(__name__).addHandler(logging.NullHandler())
+
+LOG_FORMAT = "%(asctime)s %(levelname)s (%(threadName)s-%(process)d) %(message)s"
+
+
+def setup_logging(level=logging.INFO):
+    """Opt-in root logging config for framework-owned processes
+    (the reference did this unconditionally at import,
+    tensorflowonspark/__init__.py:3; we make it explicit)."""
+    logging.basicConfig(level=level, format=LOG_FORMAT)
+
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "InputMode": ("tensorflowonspark_tpu.cluster.cluster", "InputMode"),
+    "TPUCluster": ("tensorflowonspark_tpu.cluster.cluster", "TPUCluster"),
+    # Drop-in style alias for users migrating from the reference API surface.
+    "TFCluster": ("tensorflowonspark_tpu.cluster.cluster", "TPUCluster"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        try:
+            return getattr(importlib.import_module(module), attr)
+        except ImportError as e:
+            # Per the module-__getattr__ contract, only AttributeError may
+            # escape (hasattr() must not crash on a broken lazy target).
+            raise AttributeError(
+                "lazy attribute {0!r} failed to import: {1}".format(name, e)
+            ) from e
+    raise AttributeError("module {0!r} has no attribute {1!r}".format(__name__, name))

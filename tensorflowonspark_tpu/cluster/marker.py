@@ -1,0 +1,14 @@
+"""In-band queue sentinels (reference: tensorflowonspark/marker.py:11-17).
+
+``None`` remains the end-of-feed sentinel by convention (reference:
+TFSparkNode.py:601, TFNode.py:267); ``EndPartition`` marks partition
+boundaries on the inference path (reference: TFSparkNode.py:534).
+"""
+
+
+class Marker(object):
+    """Base class for in-band control markers."""
+
+
+class EndPartition(Marker):
+    """Marks the end of one input partition within the feed stream."""

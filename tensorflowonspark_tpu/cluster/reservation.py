@@ -1,0 +1,340 @@
+"""Cluster bootstrap rendezvous: the framework's own coordination component.
+
+Re-designed from the reference's ``reservation.py`` (reference:
+tensorflowonspark/reservation.py) which implements a TCP server on the
+driver that executors register with, plus a client-side barrier.  Design
+changes for the TPU build:
+
+- **Typed JSON frames instead of pickle** (reference used pickled python
+  objects, reservation.py:68-97 — an RCE hazard on an open port).  Frames
+  are 4-byte big-endian length + UTF-8 JSON.
+- Node metadata carries TPU topology (chip count, coords, process index)
+  instead of GPU info, so the driver can assemble a
+  ``jax.distributed.initialize`` coordination plan and a logical mesh.
+- Same message vocabulary as the reference: REG / QINFO / QUERY / STOP
+  (reference: reservation.py:130-146) plus LOOKUP for keyed queries.
+
+The server survives in the TPU architecture as the component that produces
+the coordinator address + topology and enforces the startup barrier
+(SURVEY.md §5 'Distributed communication backend').
+"""
+
+import json
+import logging
+import os
+import select
+import socket
+import struct
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+#: Env overrides for multi-homed driver hosts
+#: (reference: reservation.py:25-26 TFOS_SERVER_HOST/TFOS_SERVER_PORT).
+TFOS_SERVER_HOST = "TFOS_SERVER_HOST"
+TFOS_SERVER_PORT = "TFOS_SERVER_PORT"
+
+BUFSIZE = 1024 * 1024
+
+#: Upper bound on a single frame; a bogus length prefix (e.g. stray HTTP
+#: bytes hitting the port) must not wedge the select() loop in a
+#: gigabyte-sized blocking read.
+MAX_FRAME = 16 * 1024 * 1024
+
+#: Per-connection socket timeout on the server side, seconds.  A client that
+#: stalls mid-frame gets dropped instead of blocking the single-threaded
+#: event loop for everyone else.
+SERVER_SOCKET_TIMEOUT = 10.0
+
+
+class Reservations(object):
+    """Thread-safe store of cluster reservations
+    (reference: reservation.py:31-65)."""
+
+    def __init__(self, required):
+        self.required = required
+        self._lock = threading.RLock()
+        self._reservations = []
+
+    def add(self, meta):
+        """Add (or refresh) a reservation.
+
+        Registration is idempotent per ``executor_id``: a client that lost
+        the OK response and re-sent REG must not count twice, or the
+        barrier would release before all real nodes registered (the
+        reference detects duplicates late, at TFCluster.py:355-370; we
+        dedup at the source).
+        """
+        with self._lock:
+            key = meta.get("executor_id") if isinstance(meta, dict) else None
+            if key is not None:
+                for i, existing in enumerate(self._reservations):
+                    if isinstance(existing, dict) and existing.get("executor_id") == key:
+                        self._reservations[i] = meta
+                        return
+            self._reservations.append(meta)
+
+    def done(self):
+        with self._lock:
+            return len(self._reservations) >= self.required
+
+    def get(self):
+        with self._lock:
+            return list(self._reservations)
+
+    def remaining(self):
+        with self._lock:
+            return self.required - len(self._reservations)
+
+
+class MessageSocket(object):
+    """Length-prefixed JSON framing over a TCP socket
+    (reference: reservation.py:68-97, re-done without pickle)."""
+
+    def receive(self, sock):
+        header = self._recv_exact(sock, 4)
+        if header is None:
+            raise ConnectionError("connection closed while reading header")
+        (length,) = struct.unpack(">I", header)
+        if length > MAX_FRAME:
+            raise ConnectionError(
+                "frame length {0} exceeds limit; dropping connection".format(length)
+            )
+        payload = self._recv_exact(sock, length)
+        if payload is None:
+            raise ConnectionError("connection closed while reading payload")
+        return json.loads(payload.decode("utf-8"))
+
+    def send(self, sock, msg):
+        payload = json.dumps(msg).encode("utf-8")
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+    @staticmethod
+    def _recv_exact(sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(min(n - len(buf), BUFSIZE))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+
+class Server(MessageSocket):
+    """Driver-side rendezvous server: single-thread ``select()`` loop
+    (reference: reservation.py:100-199)."""
+
+    def __init__(self, count):
+        assert count > 0
+        self.reservations = Reservations(count)
+        self.done = threading.Event()
+        self._stop_requested = threading.Event()
+        self._listener = None
+
+    @property
+    def stop_requested(self):
+        return self._stop_requested.is_set()
+
+    def start(self):
+        """Bind and start the background listener; returns ``(host, port)``.
+
+        Env overrides for multi-NIC hosts (reference: reservation.py:190-199).
+        """
+        from tensorflowonspark_tpu.utils.net import get_ip_address
+
+        host = os.environ.get(TFOS_SERVER_HOST, get_ip_address())
+        port = int(os.environ.get(TFOS_SERVER_PORT, 0))
+
+        server_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server_sock.bind(("", port))
+        server_sock.listen(64)
+        self._listener = server_sock
+        addr = (host, server_sock.getsockname()[1])
+        self.addr = addr
+
+        t = threading.Thread(target=self._serve, args=(server_sock,), daemon=True)
+        t.start()
+        logger.info("reservation server listening on %s", addr)
+        return addr
+
+    def _serve(self, server_sock):
+        # select()-based single-thread event loop (reference: reservation.py:162-187)
+        inputs = [server_sock]
+        while not self.done.is_set():
+            try:
+                readable, _, exceptional = select.select(inputs, [], [], 1.0)
+            except (OSError, ValueError):
+                break
+            for s in readable:
+                if s is server_sock:
+                    try:
+                        conn, _ = server_sock.accept()
+                        conn.settimeout(SERVER_SOCKET_TIMEOUT)
+                        inputs.append(conn)
+                    except OSError:
+                        pass
+                else:
+                    try:
+                        msg = self.receive(s)
+                        self._handle(s, msg)
+                    except (ConnectionError, OSError, json.JSONDecodeError):
+                        inputs.remove(s)
+                        s.close()
+                    except Exception:  # noqa: BLE001
+                        # A malformed-but-valid-JSON frame (wrong shape,
+                        # missing keys) must not kill the serve thread —
+                        # answer with an error and keep the rendezvous up.
+                        logger.exception("error handling rendezvous message")
+                        try:
+                            self.send(s, {"type": "ERROR", "error": "bad request"})
+                        except OSError:
+                            inputs.remove(s)
+                            s.close()
+            for s in exceptional:
+                if s in inputs:
+                    inputs.remove(s)
+                    s.close()
+        for s in inputs:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _handle(self, sock, msg):
+        # message vocabulary (reference: reservation.py:130-146)
+        mtype = msg.get("type")
+        if mtype == "REG":
+            self.reservations.add(msg["data"])
+            self.send(sock, {"type": "OK"})
+        elif mtype == "QUERY":
+            self.send(
+                sock,
+                {
+                    "type": "QUERY_RESP",
+                    "done": self.reservations.done(),
+                    "stop": self.stop_requested,
+                },
+            )
+        elif mtype == "QINFO":
+            self.send(
+                sock,
+                {"type": "QINFO_RESP", "reservations": self.reservations.get()},
+            )
+        elif mtype == "STOP":
+            # request_stop: streaming shutdown / early termination
+            # (reference: reservation.py:142-146, used by TFSparkNode.py:497)
+            self._stop_requested.set()
+            self.send(sock, {"type": "OK"})
+        else:
+            self.send(sock, {"type": "ERROR", "error": "unknown message %r" % mtype})
+
+    def await_reservations(self, status=None, timeout=600):
+        """Block until all nodes registered; abort on error status or timeout
+        (reference: reservation.py:113-128)."""
+        timespent = 0.0
+        while not self.reservations.done():
+            logger.info(
+                "waiting for %d reservations", self.reservations.remaining()
+            )
+            if status is not None and status.get("error"):
+                raise RuntimeError(
+                    "cluster startup aborted: {0}".format(status["error"])
+                )
+            time.sleep(1)
+            timespent += 1
+            if timespent > timeout:
+                raise RuntimeError("timed out waiting for cluster reservations")
+        logger.info("all reservations completed")
+        return self.reservations.get()
+
+    def stop(self):
+        self.done.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+
+class Client(MessageSocket):
+    """Executor-side rendezvous client (reference: reservation.py:206-273)."""
+
+    def __init__(self, server_addr):
+        self.server_addr = tuple(server_addr)
+        self.sock = self._connect(self.server_addr)
+
+    #: Client-side socket timeout: a stalled server must surface as a
+    #: retryable error, not an unbounded block that bypasses the polling
+    #: timeout in ``await_reservations``.
+    SOCKET_TIMEOUT = 30.0
+
+    @staticmethod
+    def _connect(addr, retries=3):
+        last = None
+        for i in range(retries):
+            try:
+                sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                sock.settimeout(Client.SOCKET_TIMEOUT)
+                sock.connect(addr)
+                return sock
+            except OSError as e:
+                last = e
+                time.sleep(1 + i)
+        raise ConnectionError(
+            "unable to connect to reservation server at {0}: {1}".format(addr, last)
+        )
+
+    def _request(self, msg):
+        """Send with retry + reconnect (reference: reservation.py:228-241)."""
+        for i in range(3):
+            try:
+                self.send(self.sock, msg)
+                return self.receive(self.sock)
+            except (ConnectionError, OSError):
+                logger.warning("lost connection to server, reconnecting (try %d)", i)
+                try:
+                    self.sock.close()
+                except OSError:
+                    pass
+                self.sock = self._connect(self.server_addr)
+        raise ConnectionError("unable to reach reservation server")
+
+    def register(self, reservation):
+        resp = self._request({"type": "REG", "data": reservation})
+        return resp
+
+    def get_reservations(self):
+        resp = self._request({"type": "QINFO"})
+        return resp["reservations"]
+
+    def await_reservations(self, timeout=600):
+        """1s-poll barrier until the cluster is fully registered
+        (reference: reservation.py:262-268)."""
+        done = False
+        timespent = 0.0
+        while not done:
+            resp = self._request({"type": "QUERY"})
+            done = resp["done"]
+            if not done:
+                time.sleep(1)
+                timespent += 1
+                if timespent > timeout:
+                    raise RuntimeError("timed out waiting for cluster reservations")
+        return self.get_reservations()
+
+    def request_stop(self):
+        """Ask the server to set the cluster-wide stop flag
+        (reference: reservation.py:270-273; examples/utils/stop_streaming.py)."""
+        return self._request({"type": "STOP"})
+
+    def get_stop_requested(self):
+        resp = self._request({"type": "QUERY"})
+        return resp.get("stop", False)
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass

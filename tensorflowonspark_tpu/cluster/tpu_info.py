@@ -1,0 +1,107 @@
+"""TPU device discovery and per-process chip allocation.
+
+TPU-native replacement for the reference's ``gpu_info.py`` (reference:
+tensorflowonspark/gpu_info.py), which shelled out to ``nvidia-smi`` to find
+free GPUs and exported ``CUDA_VISIBLE_DEVICES``.  On TPU the equivalents
+are:
+
+- discovery: ``jax.devices()`` / ``jax.local_devices()`` with platform
+  probing (no subprocess needed);
+- topology: each TPU device exposes ``coords`` (its position in the ICI
+  torus) and ``core_on_chip``;
+- per-process visibility: the ``TPU_VISIBLE_CHIPS`` /
+  ``TPU_PROCESS_BOUNDS`` / ``TPU_CHIPS_PER_PROCESS_BOUNDS`` env vars,
+  which must be set *before* JAX initializes — the moral twin of
+  ``CUDA_VISIBLE_DEVICES`` (reference: gpu_info.py:87-94).
+
+Like the reference's deterministic by-worker-index placement
+(reference: gpu_info.py:74-86), ``get_chips`` assigns chips by local
+worker index so co-located workers don't collide.
+"""
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+MAX_RETRIES = 3  # (reference: gpu_info.py:18 MAX_RETRIES)
+
+
+def is_tpu_available():
+    """True if this host has TPU devices JAX can see
+    (reference analogue: gpu_info.py:22-28 is_gpu_available)."""
+    try:
+        import jax
+
+        return any(d.platform == "tpu" for d in jax.devices())
+    except Exception:  # noqa: BLE001 - any backend init failure means "no"
+        return False
+
+
+def get_device_info():
+    """Describe local accelerator topology for the reservation payload.
+
+    Returns a JSON-able dict: platform, device count, per-device coords.
+    This is what executors register with the rendezvous server so the
+    driver can build the global mesh (SURVEY.md §7 step 1).
+    """
+    import jax
+
+    devices = jax.local_devices()
+    info = {
+        "platform": devices[0].platform if devices else "none",
+        "num_devices": len(devices),
+        "devices": [],
+    }
+    for d in devices:
+        entry = {"id": d.id, "process_index": d.process_index}
+        coords = getattr(d, "coords", None)
+        if coords is not None:
+            entry["coords"] = list(coords)
+        core = getattr(d, "core_on_chip", None)
+        if core is not None:
+            entry["core_on_chip"] = core
+        info["devices"].append(entry)
+    return info
+
+
+def set_visible_chips(chip_ids):
+    """Restrict this process to a subset of local TPU chips.
+
+    Must run before JAX backend initialization; sets ``TPU_VISIBLE_CHIPS``
+    (the TPU twin of ``CUDA_VISIBLE_DEVICES`` export, reference:
+    gpu_info.py:87-94 / TFSparkNode.py:364-366).
+    """
+    value = ",".join(str(c) for c in chip_ids)
+    os.environ["TPU_VISIBLE_CHIPS"] = value
+    # One process per chip-set: megacore-style process bounds left to the
+    # runtime; visibility alone is sufficient for executor isolation.
+    logger.info("TPU_VISIBLE_CHIPS=%s", value)
+
+
+def get_chips(num_chips, worker_index=-1, total_chips=None):
+    """Allocate ``num_chips`` local chip ids for this worker.
+
+    Deterministic placement by local worker index, mirroring the
+    reference's by-index GPU placement so multiple workers on one host
+    land on disjoint chips (reference: gpu_info.py:74-86).
+    """
+    if total_chips is None:
+        total_chips = int(os.environ.get("TPU_HOST_CHIPS", "4"))
+    if num_chips > total_chips:
+        raise RuntimeError(
+            "requested {0} chips but host has {1}".format(num_chips, total_chips)
+        )
+    if worker_index < 0:
+        start = 0
+    else:
+        start = (worker_index * num_chips) % total_chips
+        if start + num_chips > total_chips:
+            # A wrapped window would collide with another worker's chips;
+            # two JAX runtimes contending for a chip is fatal — fail loudly.
+            raise RuntimeError(
+                "worker {0} needs {1} chips but the host window wraps "
+                "(total {2}); use fewer chips per worker or fewer workers "
+                "per host".format(worker_index, num_chips, total_chips)
+            )
+    return list(range(start, start + num_chips))

@@ -1,0 +1,7 @@
+from tensorflowonspark_tpu.utils.paths import absolute_path, resolve_path  # noqa: F401
+from tensorflowonspark_tpu.utils.net import get_ip_address, find_in_path  # noqa: F401
+from tensorflowonspark_tpu.utils.env import (  # noqa: F401
+    read_executor_id,
+    write_executor_id,
+    single_node_env,
+)

@@ -1,0 +1,42 @@
+"""Executor-local environment helpers.
+
+The executor-id file handshake lets separate jobs landing on the same
+executor (the cluster-start job vs later feed jobs) discover which logical
+node lives there (reference: tensorflowonspark/util.py:77-85, used at
+TFSparkNode.py:450).
+"""
+
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+_EXECUTOR_ID_FILE = "executor_id"
+
+
+def write_executor_id(num, working_dir=None):
+    """Persist this executor's logical id (reference: util.py:77-80)."""
+    path = os.path.join(working_dir or os.getcwd(), _EXECUTOR_ID_FILE)
+    with open(path, "w") as f:
+        f.write(str(num))
+
+
+def read_executor_id(working_dir=None):
+    """Read back the executor id written by the start job
+    (reference: util.py:82-85)."""
+    path = os.path.join(working_dir or os.getcwd(), _EXECUTOR_ID_FILE)
+    with open(path, "r") as f:
+        return int(f.read())
+
+
+def single_node_env(num_chips=None):
+    """Configure the environment for a single-node JAX run
+    (reference: util.py:21-49 single_node_env: classpath + GPU env).
+
+    On the TPU build this restricts chip visibility when ``num_chips`` is
+    given and otherwise leaves JAX to grab the host's devices.
+    """
+    from tensorflowonspark_tpu.cluster import tpu_info
+
+    if num_chips is not None:
+        tpu_info.set_visible_chips(list(range(num_chips)))

@@ -1,0 +1,37 @@
+"""Small networking helpers (reference: tensorflowonspark/util.py:52-75)."""
+
+import os
+import socket
+
+
+def get_ip_address():
+    """Best-effort externally-routable IP of this host via the UDP-connect
+    trick (reference: util.py:52-66)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        # The address doesn't need to be reachable; no packet is sent.
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+    except Exception:
+        ip = "127.0.0.1"
+    finally:
+        s.close()
+    return ip
+
+
+def find_in_path(path, file_name):
+    """Find a file in a colon-separated search path (reference: util.py:68-75)."""
+    for p in path.split(os.pathsep):
+        candidate = os.path.join(p, file_name)
+        if os.path.exists(candidate) and os.path.isfile(candidate):
+            return candidate
+    return False
+
+
+def free_port():
+    """Grab an ephemeral TCP port (bind to 0 and release)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port

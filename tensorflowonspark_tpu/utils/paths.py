@@ -1,0 +1,80 @@
+"""Path normalization across local/remote filesystem schemes.
+
+Re-designed from the reference's ``TFNode.hdfs_path`` (reference:
+tensorflowonspark/TFNode.py:29-64), which normalizes user paths against the
+cluster's default filesystem so the same script works on local disk, HDFS,
+GCS, or any other scheme.  The TPU build targets GCS as the primary remote
+store (the natural filesystem for Cloud TPU pods) but keeps the same
+scheme-dispatch semantics and the same set of recognized schemes.
+"""
+
+import getpass
+import logging
+import os
+
+logger = logging.getLogger(__name__)
+
+#: Schemes that are passed through untouched when already fully qualified.
+#: (reference: TFNode.py:40-43 lists hdfs/viewfs/file; we add cloud stores.)
+_KNOWN_SCHEMES = (
+    "hdfs://",
+    "viewfs://",
+    "file://",
+    "gs://",
+    "s3://",
+    "s3a://",
+    "s3n://",
+    "abfs://",
+    "abfss://",
+    "wasb://",
+    "maprfs://",
+)
+
+
+def resolve_path(path, default_fs="file://", working_dir=None):
+    """Normalize ``path`` against ``default_fs`` like the reference's
+    ``hdfs_path`` (reference: TFNode.py:29-64).
+
+    - Fully-qualified paths (any known scheme) are returned as-is.
+    - Absolute paths are joined to the default filesystem scheme.
+    - Relative paths resolve against the working dir for ``file://`` or the
+      user's home dir for remote filesystems (matching reference behavior).
+    """
+    if any(path.startswith(s) for s in _KNOWN_SCHEMES):
+        return path
+
+    if working_dir is None:
+        working_dir = os.getcwd()
+
+    if path.startswith("/"):
+        # absolute path: qualify with the default FS
+        if default_fs.startswith("file://"):
+            return "file://" + path
+        return _join_fs(default_fs, path)
+
+    # relative path
+    if default_fs.startswith("file://"):
+        return "file://" + os.path.join(working_dir, path)
+    user = getpass.getuser()
+    return _join_fs(default_fs, "/user/{0}/{1}".format(user, path))
+
+
+def _join_fs(default_fs, abs_path):
+    base = default_fs
+    if base.endswith("/"):
+        base = base[:-1]
+    return base + abs_path
+
+
+def absolute_path(ctx, path):
+    """Convenience used by ``NodeContext.absolute_path`` (reference:
+    TFSparkNode.py:58-60)."""
+    return resolve_path(path, ctx.default_fs, ctx.working_dir)
+
+
+def strip_scheme(path):
+    """Return the local filesystem path for a ``file://`` URL, else ``path``
+    unchanged.  Useful before handing paths to plain-python IO."""
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    return path

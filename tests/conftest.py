@@ -1,0 +1,33 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's strategy of testing multi-node behavior with
+multiple local processes on one box (reference: test/run_tests.sh boots a
+2-worker local Spark Standalone cluster).  Here the stand-ins are:
+
+- ``xla_force_host_platform_device_count=8`` — 8 virtual CPU devices in
+  one process stand in for 8 TPU chips (mesh/sharding tests);
+- multiprocessing executor backends stand in for Spark executors
+  (cluster/data-plane tests).
+
+These env vars MUST be set before the first ``import jax`` anywhere in the
+test process, which is why they live at module import time in conftest.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the machine env pins a TPU platform
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# A sitecustomize on this image may pre-register a TPU plugin and pin
+# jax_platforms at interpreter start; the config update (pre-backend-init)
+# restores CPU-only for the test process.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,57 @@
+"""Path normalization matrix (modeled on reference: test/test_TFNode.py:8-25)."""
+
+import unittest
+
+from tensorflowonspark_tpu.utils import paths
+
+
+class ResolvePathTest(unittest.TestCase):
+    def test_absolute_with_local_fs(self):
+        self.assertEqual(
+            paths.resolve_path("/tmp/x", "file://", "/wd"), "file:///tmp/x"
+        )
+
+    def test_relative_with_local_fs(self):
+        self.assertEqual(
+            paths.resolve_path("rel/x", "file://", "/wd"), "file:///wd/rel/x"
+        )
+
+    def test_qualified_passthrough(self):
+        for p in (
+            "hdfs://nn:8020/a/b",
+            "gs://bucket/a",
+            "s3://bucket/a",
+            "viewfs://ns/a",
+            "file:///a",
+        ):
+            self.assertEqual(paths.resolve_path(p, "hdfs://nn:8020"), p)
+
+    def test_absolute_with_remote_fs(self):
+        self.assertEqual(
+            paths.resolve_path("/data/x", "hdfs://nn:8020"), "hdfs://nn:8020/data/x"
+        )
+        self.assertEqual(
+            paths.resolve_path("/data/x", "gs://bucket"), "gs://bucket/data/x"
+        )
+
+    def test_relative_with_remote_fs_uses_user_home(self):
+        out = paths.resolve_path("models/m1", "hdfs://nn:8020")
+        self.assertTrue(out.startswith("hdfs://nn:8020/user/"))
+        self.assertTrue(out.endswith("/models/m1"))
+
+    def test_strip_scheme(self):
+        self.assertEqual(paths.strip_scheme("file:///a/b"), "/a/b")
+        self.assertEqual(paths.strip_scheme("/a/b"), "/a/b")
+
+
+class AbsolutePathCtxTest(unittest.TestCase):
+    def test_mock_ctx(self):
+        # mocked ctx, like reference test_TFNode.py:10
+        ctx = type(
+            "MockContext", (), {"default_fs": "hdfs://nn", "working_dir": "/wd"}
+        )()
+        self.assertEqual(paths.absolute_path(ctx, "/a"), "hdfs://nn/a")
+
+
+if __name__ == "__main__":
+    unittest.main()

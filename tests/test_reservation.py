@@ -1,0 +1,132 @@
+"""Rendezvous tests (modeled on reference: test/test_reservation.py)."""
+
+import os
+import threading
+import time
+import unittest
+from unittest import mock
+
+from tensorflowonspark_tpu.cluster import reservation
+
+
+class ReservationsStoreTest(unittest.TestCase):
+    """(reference: test/test_reservation.py:17-34)"""
+
+    def test_counting(self):
+        r = reservation.Reservations(3)
+        self.assertFalse(r.done())
+        self.assertEqual(r.remaining(), 3)
+        r.add({"node": 0})
+        self.assertFalse(r.done())
+        self.assertEqual(r.remaining(), 2)
+        r.add({"node": 1})
+        r.add({"node": 2})
+        self.assertTrue(r.done())
+        self.assertEqual(r.remaining(), 0)
+        self.assertEqual(len(r.get()), 3)
+
+
+class ServerClientTest(unittest.TestCase):
+    """Real Server+Client over localhost TCP
+    (reference: test/test_reservation.py:36-58)."""
+
+    def test_single_client(self):
+        server = reservation.Server(1)
+        addr = server.start()
+        client = reservation.Client(addr)
+        meta = {"host": "h", "executor_id": 0, "ports": {"ctl": 1}}
+        client.register(meta)
+        got = client.await_reservations(timeout=10)
+        self.assertEqual(got, [meta])
+        got2 = server.await_reservations(timeout=10)
+        self.assertEqual(got2, [meta])
+        client.close()
+        server.stop()
+
+    def test_request_stop(self):
+        server = reservation.Server(1)
+        addr = server.start()
+        client = reservation.Client(addr)
+        client.register({"executor_id": 0})
+        self.assertFalse(client.get_stop_requested())
+        client.request_stop()
+        self.assertTrue(client.get_stop_requested())
+        self.assertTrue(server.stop_requested)
+        client.close()
+        server.stop()
+
+    def test_duplicate_register_is_idempotent(self):
+        # a retried REG (lost OK response) must not release the barrier early
+        server = reservation.Server(2)
+        addr = server.start()
+        client = reservation.Client(addr)
+        client.register({"executor_id": 0, "try": 1})
+        client.register({"executor_id": 0, "try": 2})
+        self.assertFalse(server.reservations.done())
+        client.register({"executor_id": 1})
+        self.assertTrue(server.reservations.done())
+        metas = {m["executor_id"]: m for m in server.reservations.get()}
+        self.assertEqual(metas[0]["try"], 2)  # refreshed, not duplicated
+        client.close()
+        server.stop()
+
+    def test_malformed_request_does_not_kill_server(self):
+        # valid JSON, wrong shape: REG without 'data' -> server must survive
+        server = reservation.Server(1)
+        addr = server.start()
+        bad = reservation.Client(addr)
+        resp = bad._request({"type": "REG"})  # missing 'data'
+        self.assertEqual(resp["type"], "ERROR")
+        good = reservation.Client(addr)
+        good.register({"executor_id": 0})
+        self.assertTrue(server.reservations.done())
+        bad.close()
+        good.close()
+        server.stop()
+
+    def test_await_error_status_aborts(self):
+        server = reservation.Server(2)
+        server.start()
+        status = {"error": "executor died"}
+        with self.assertRaises(RuntimeError):
+            server.await_reservations(status=status, timeout=5)
+        server.stop()
+
+    def test_concurrent_clients(self):
+        """4 concurrent registrations (reference: test_reservation.py:79-109)."""
+        n = 4
+        server = reservation.Server(n)
+        addr = server.start()
+
+        def work(i):
+            c = reservation.Client(addr)
+            time.sleep(0.1 * i)
+            c.register({"executor_id": i})
+            c.await_reservations(timeout=10)
+            c.close()
+
+        threads = [threading.Thread(target=work, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        got = server.await_reservations(timeout=15)
+        for t in threads:
+            t.join()
+        self.assertEqual(sorted(m["executor_id"] for m in got), [0, 1, 2, 3])
+        server.stop()
+
+
+class EnvOverrideTest(unittest.TestCase):
+    """(reference: test/test_reservation.py:60-77)"""
+
+    def test_host_override(self):
+        with mock.patch.dict(
+            os.environ, {reservation.TFOS_SERVER_HOST: "9.9.9.9"}
+        ):
+            server = reservation.Server(1)
+            addr = server.start()
+            self.assertEqual(addr[0], "9.9.9.9")
+            server.stop()
+
+
+if __name__ == "__main__":
+    unittest.main()

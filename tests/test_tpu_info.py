@@ -1,0 +1,40 @@
+import os
+import unittest
+from unittest import mock
+
+from tensorflowonspark_tpu.cluster import tpu_info
+
+
+class DeviceInfoTest(unittest.TestCase):
+    def test_get_device_info_cpu(self):
+        info = tpu_info.get_device_info()
+        self.assertEqual(info["platform"], "cpu")
+        self.assertEqual(info["num_devices"], 8)  # conftest virtual devices
+        self.assertEqual(len(info["devices"]), 8)
+
+    def test_chip_allocation_deterministic(self):
+        with mock.patch.dict(os.environ, {"TPU_HOST_CHIPS": "4"}):
+            self.assertEqual(tpu_info.get_chips(1, worker_index=0), [0])
+            self.assertEqual(tpu_info.get_chips(1, worker_index=1), [1])
+            self.assertEqual(tpu_info.get_chips(2, worker_index=1), [2, 3])
+            self.assertEqual(tpu_info.get_chips(4, worker_index=0), [0, 1, 2, 3])
+
+    def test_chip_allocation_overflow(self):
+        with mock.patch.dict(os.environ, {"TPU_HOST_CHIPS": "4"}):
+            with self.assertRaises(RuntimeError):
+                tpu_info.get_chips(8, worker_index=0)
+
+    def test_chip_allocation_wrap_collision_raises(self):
+        # a wrapped window would collide with worker 0's chips -> loud failure
+        with mock.patch.dict(os.environ, {"TPU_HOST_CHIPS": "4"}):
+            with self.assertRaises(RuntimeError):
+                tpu_info.get_chips(3, worker_index=1)
+
+    def test_set_visible_chips(self):
+        with mock.patch.dict(os.environ, {}, clear=False):
+            tpu_info.set_visible_chips([0, 2])
+            self.assertEqual(os.environ["TPU_VISIBLE_CHIPS"], "0,2")
+
+
+if __name__ == "__main__":
+    unittest.main()
