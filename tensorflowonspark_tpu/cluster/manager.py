@@ -1,0 +1,139 @@
+"""Per-executor control/data plane: queues + shared state over IPC.
+
+Re-designed from the reference's ``TFManager.py`` (reference:
+tensorflowonspark/TFManager.py:14-83): a
+``multiprocessing.managers.BaseManager`` subclass exposing named
+``JoinableQueue``s plus a small key/value dict, shared between the
+executor's task processes (which feed data) and the compute process
+(which consumes it and runs the JAX train/infer loop).
+
+Two modes (reference: TFManager.py:40-65):
+
+- ``'local'``  — loopback TCP socket reachable only from this host;
+  used by worker nodes whose queues are only touched by co-located
+  feeder tasks.  (The reference used an AF_UNIX socket here; we bind
+  127.0.0.1:0 so the same address tuple type works in both modes.)
+- ``'remote'`` — TCP socket bound on all interfaces so the *driver* can
+  reach the manager across hosts; used by ps/evaluator nodes whose
+  shutdown signal comes directly from the driver (reference:
+  TFCluster.py:186-194).
+
+Auth uses a per-node random authkey exactly like the reference
+(reference: TFSparkNode.py:237) — ``multiprocessing``'s HMAC challenge
+handshake provides the authentication layer.
+"""
+
+import logging
+import multiprocessing
+import queue as _queue_mod
+import threading
+from multiprocessing.managers import BaseManager
+
+logger = logging.getLogger(__name__)
+
+
+class _KVStore(object):
+    """Thread-safe kv store for node state (reference: TFManager.py:20-37).
+
+    Keys in use by the runtime (mirroring the reference):
+
+    - ``'state'``: ``'running'`` | ``'terminating'`` | ``'stopped'``
+      (reference: TFSparkNode.py:246, TFNode.py:307-329)
+    - ``'num_data_inputs'``: feed item counter for observability.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data = {}
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key, value):
+        with self._lock:
+            self._data[key] = value
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._data)
+
+
+class QueueManager(BaseManager):
+    """Named JoinableQueues + kv state shared across processes
+    (reference: TFManager.py:14-17)."""
+
+
+def start(authkey, queue_names, mode="local"):
+    """Create and start a manager server process owning the named queues.
+
+    Args:
+      authkey: bytes; per-node random secret (reference: TFSparkNode.py:237).
+      queue_names: list of queue names, e.g. ``['input', 'output', 'error']``
+        for workers or ``['control', 'error']`` for ps/evaluator
+        (reference: TFSparkNode.py:235-246).
+      mode: ``'local'`` or ``'remote'`` (see module docstring).
+
+    Returns:
+      ``(manager, address)`` where address is a ``(host, port)`` tuple.
+    """
+    qdict = {}
+    kv = _KVStore()
+    for name in queue_names:
+        qdict[name] = multiprocessing.JoinableQueue()
+
+    # Closures capture the live objects; BaseManager proxies them.
+    QueueManager.register("get_queue", callable=lambda qname: qdict[qname])
+    QueueManager.register("get", callable=lambda key: kv.get(key))
+    QueueManager.register("set", callable=lambda key, value: kv.set(key, value))
+
+    if mode == "remote":
+        addr = ("", 0)
+    else:
+        addr = ("127.0.0.1", 0)
+
+    # The manager server must be forked, not spawned: its registry holds
+    # closures over the live queue/kv objects, which cannot be pickled
+    # into a spawn-context child.  Forking here is safe — the executor
+    # process never initializes a JAX backend (only the spawned compute
+    # process owns TPU chips).
+    mgr = QueueManager(
+        address=addr, authkey=authkey, ctx=multiprocessing.get_context("fork")
+    )
+    mgr.start()
+    logger.info("started %s queue manager at %s", mode, mgr.address)
+    return mgr, mgr.address
+
+
+def connect(address, authkey):
+    """Connect to an existing manager, e.g. from a feeder task process or
+    from the driver for ps shutdown (reference: TFManager.py:68-83)."""
+    QueueManager.register("get_queue")
+    QueueManager.register("get")
+    QueueManager.register("set")
+    m = QueueManager(address=tuple(address), authkey=authkey)
+    m.connect()
+    return m
+
+
+def qsize_safe(q):
+    """``qsize()`` that tolerates platforms where it raises
+    ``NotImplementedError`` (macOS)."""
+    try:
+        return q.qsize()
+    except NotImplementedError:
+        return -1
+
+
+def drain(q):
+    """Discard everything currently in a queue, marking each item done so
+    ``join()`` callers are released (reference: TFNode.py:316-329
+    terminate-side drain)."""
+    count = 0
+    while True:
+        try:
+            q.get(block=False)
+            q.task_done()
+            count += 1
+        except _queue_mod.Empty:
+            return count

@@ -1,0 +1,694 @@
+"""Per-executor node runtime: role assignment, process launch, data plane.
+
+Re-designed from the reference's ``TFSparkNode.py`` (reference:
+tensorflowonspark/TFSparkNode.py).  Each executor runs ``_mapfn`` exactly
+once at cluster startup (reference: TFSparkNode.py:126-431); it
+
+1. claims its executor id (from the start-partition payload),
+2. allocates local accelerator devices (TPU chips here; the reference
+   probed nvidia-smi and set CUDA_VISIBLE_DEVICES,
+   TFSparkNode.py:149-207),
+3. derives its role (job_name, task_index) from the cluster template
+   (reference: TFSparkNode.py:209-219),
+4. starts the per-node :mod:`manager` with role-appropriate queues
+   (reference: TFSparkNode.py:235-246),
+5. registers with the rendezvous server and blocks on the startup
+   barrier (reference: TFSparkNode.py:300-338),
+6. assembles the cluster spec and the JAX coordination plan — the
+   TPU-native replacement for the reference's TF_CONFIG export
+   (reference: TFSparkNode.py:340-362), and
+7. launches the user's ``main_fun(args, ctx)`` in foreground or
+   background (reference: TFSparkNode.py:375-431).
+
+The data-plane map functions (``train``/``inference``/``shutdown``)
+reconnect to the node's manager from whatever executor the feed task
+landed on (reference: TFSparkNode.py:97-123) and preserve the reference's
+error-containment contract: feeders poll the error queue each second,
+shutdown peeks-and-requeues so engine-level task retries still fail
+(reference: TFSparkNode.py:612-618).
+"""
+
+import json
+import logging
+import multiprocessing
+import os
+import queue as _queue_mod
+import socket
+import time
+import uuid
+
+from tensorflowonspark_tpu.cluster import manager, reservation, tpu_info
+from tensorflowonspark_tpu.cluster.marker import EndPartition
+from tensorflowonspark_tpu.utils import paths as path_utils
+from tensorflowonspark_tpu.utils.net import get_ip_address
+
+logger = logging.getLogger(__name__)
+
+
+class NodeContext(object):
+    """Encapsulates cluster metadata for the user's ``main_fun``
+    (reference: TFSparkNode.py:37-77 TFNodeContext).
+
+    Attributes mirror the reference: ``executor_id``, ``job_name``,
+    ``task_index``, ``cluster_spec``, ``num_workers``, ``default_fs``,
+    ``working_dir``, ``mgr``.  TPU additions: ``coordinator`` (address
+    for ``jax.distributed.initialize``), ``process_id`` / ``num_processes``
+    (this node's rank among JAX worker processes), ``device_info``.
+    """
+
+    def __init__(
+        self,
+        executor_id=0,
+        job_name="",
+        task_index=0,
+        cluster_spec=None,
+        default_fs="file://",
+        working_dir=".",
+        mgr=None,
+        coordinator=None,
+        process_id=0,
+        num_processes=1,
+        device_info=None,
+        manager_addr=None,
+        manager_authkey=None,
+    ):
+        self.executor_id = executor_id
+        self.job_name = job_name
+        self.task_index = task_index
+        self.cluster_spec = cluster_spec or {}
+        self.default_fs = default_fs
+        self.working_dir = working_dir
+        self.mgr = mgr
+        self.coordinator = coordinator
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.device_info = device_info or {}
+        #: (addr, authkey-hex) so a spawned compute process can rebind its
+        #: manager proxy — BaseManager proxies don't survive pickling into
+        #: a spawn-context child (the fork-context inheritance the
+        #: reference relied on is a TPU hazard: a forked JAX runtime is
+        #: undefined behavior, so we spawn and reconnect instead).
+        self.manager_addr = manager_addr
+        self.manager_authkey = manager_authkey
+        self.num_workers = sum(
+            len(v)
+            for k, v in self.cluster_spec.items()
+            if k in ("worker", "chief", "master")
+        )
+
+    def absolute_path(self, path):
+        """Convert a relative path into an absolute path on the default FS
+        (reference: TFSparkNode.py:54-56, TFNode.py:29-64)."""
+        return path_utils.resolve_path(path, self.default_fs, self.working_dir)
+
+    def get_data_feed(
+        self, train_mode=True, qname_in="input", qname_out="output", input_mapping=None
+    ):
+        """Return a :class:`~tensorflowonspark_tpu.data.feed.DataFeed` bound
+        to this node's queues (reference: TFSparkNode.py:58-60)."""
+        from tensorflowonspark_tpu.data.feed import DataFeed
+
+        return DataFeed(self.mgr, train_mode, qname_in, qname_out, input_mapping)
+
+    def initialize_distributed(self):
+        """Initialize JAX multi-host coordination for this node.
+
+        The TPU-native replacement for the reference's
+        ``start_cluster_server`` / TF_CONFIG export (reference:
+        TFNode.py:67-151, TFSparkNode.py:354-362): instead of booting a
+        gRPC ``tf.train.Server``, a multi-host JAX node calls
+        ``jax.distributed.initialize(coordinator, num_processes,
+        process_id)`` and lets XLA run collectives over ICI/DCN.
+
+        No-op for single-process clusters (workers colocated on one host
+        already share a chip set) — returns ``jax`` either way.
+        """
+        import jax
+
+        if self.num_processes > 1 and self.coordinator:
+            jax.distributed.initialize(
+                coordinator_address=self.coordinator,
+                num_processes=self.num_processes,
+                process_id=self.process_id,
+            )
+        return jax
+
+    def mesh(self, axes=None):
+        """Build a :class:`jax.sharding.Mesh` over this cluster's devices
+        (SURVEY.md §7 step 5; see :mod:`tensorflowonspark_tpu.parallel.mesh`)."""
+        from tensorflowonspark_tpu.parallel.mesh import build_mesh
+
+        return build_mesh(axes)
+
+
+def _cluster_template(num_executors, num_ps, master_node=None, eval_node=False):
+    """Map job names to executor-id lists (reference: TFCluster.py:255-270).
+
+    Layout (by executor id): ps nodes first, then optional master/chief,
+    then optional evaluator, then workers.
+    """
+    template = {}
+    idx = 0
+    if num_ps > 0:
+        template["ps"] = list(range(idx, idx + num_ps))
+        idx += num_ps
+    if master_node:
+        template[master_node] = [idx]
+        idx += 1
+    if eval_node:
+        template["evaluator"] = [idx]
+        idx += 1
+    if idx < num_executors:
+        template["worker"] = list(range(idx, num_executors))
+    return template
+
+
+def _role_for(template, executor_id):
+    for job_name, ids in template.items():
+        if executor_id in ids:
+            return job_name, ids.index(executor_id)
+    raise ValueError(
+        "executor_id {0} not present in cluster template {1}".format(
+            executor_id, template
+        )
+    )
+
+
+#: Module-level keepalive for this executor's queue manager.  BaseManager
+#: installs a finalizer that shuts the server down when the last local
+#: reference is collected — if the start task's ``mgr`` went out of scope
+#: when ``_mapfn`` returned, the data plane would vanish with it.  The
+#: reference kept the same process-lifetime singleton
+#: (reference: TFSparkNode.py:90-95).
+#:
+#: NOTE: must be mutated via :func:`_register_local_manager`, never via a
+#: ``global`` statement inside ``_mapfn`` — the start task's map function
+#: travels to executors as a cloudpickled closure whose ``__globals__`` is
+#: a reconstructed dict that dies with the function object, not this
+#: module's real namespace.
+_LOCAL_MANAGERS = []
+
+
+def _register_local_manager(mgr):
+    _LOCAL_MANAGERS.append(mgr)
+
+
+_MANAGER_FILE = "tfos_manager.json"
+
+
+def _write_manager_info(workdir, info):
+    with open(os.path.join(workdir, _MANAGER_FILE), "w") as f:
+        json.dump(info, f)
+
+
+def _read_manager_info(workdir):
+    p = os.path.join(workdir, _MANAGER_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+def _get_manager(cluster_info, host, executor_id):
+    """Reconnect to the manager of the node hosting ``executor_id``
+    (reference: TFSparkNode.py:97-123)."""
+    for node in cluster_info:
+        if node["executor_id"] == executor_id:
+            addr = tuple(node["addr"])
+            authkey = bytes.fromhex(node["authkey"])
+            m = manager.connect(addr, authkey)
+            logger.debug(
+                "connected to manager of executor %d at %s", executor_id, addr
+            )
+            return m
+    raise RuntimeError(
+        "no node with executor_id {0} in cluster_info".format(executor_id)
+    )
+
+
+def _local_executor_workdir():
+    from tensorflowonspark_tpu.engine import TFOS_EXECUTOR_WORKDIR
+
+    return os.environ.get(TFOS_EXECUTOR_WORKDIR, os.getcwd())
+
+
+def _local_executor_id():
+    """The executor id claimed by this executor's start task, persisted in
+    its working dir (reference: util.py:77-85 read_executor_id)."""
+    from tensorflowonspark_tpu.utils.env import read_executor_id
+
+    return read_executor_id(_local_executor_workdir())
+
+
+def _compute_process_main(fn_bytes, args, ctx):
+    """Entry point of the background compute process: rebind the manager
+    proxy, run the user fn, ship any traceback home via the node's error
+    queue (reference: TFSparkNode.py:391-397 wrapper_fn_background)."""
+    import traceback
+
+    try:
+        import cloudpickle as _cp
+    except ImportError:  # pragma: no cover
+        import pickle as _cp
+
+    authkey = bytes.fromhex(ctx.manager_authkey)
+    multiprocessing.current_process().authkey = authkey
+    ctx.mgr = manager.connect(tuple(ctx.manager_addr), authkey)
+    try:
+        fn = _cp.loads(fn_bytes)
+        fn(args, ctx)
+    except Exception:  # noqa: BLE001 - process boundary, traceback shipped home
+        tb = traceback.format_exc()
+        logger.error("compute process failed:\n%s", tb)
+        try:
+            ctx.mgr.get_queue("error").put(tb)
+        except Exception:  # noqa: BLE001 - best effort error reporting
+            logger.exception("unable to report error to manager")
+        raise
+
+
+def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
+    """Build the start-job map function executed once per executor
+    (reference: TFSparkNode.py:126-431).
+
+    Args:
+      fn: user ``main_fun(args, ctx)``.
+      args: opaque user args (argparse Namespace or list).
+      cluster_meta: dict from the driver — ``id``, ``cluster_template``,
+        ``num_executors``, ``default_fs``, ``server_addr``,
+        ``reservation_timeout``, ``queues``.
+      input_mode: ``InputMode.SPARK`` feeds data through the engine;
+        ``InputMode.TENSORFLOW`` (kept name for API parity) means the
+        user fn reads its own data and runs in the foreground.
+      log_dir: directory for event logs / tensorboard.
+      tensorboard: launch a managed tensorboard subprocess on chief/worker:0
+        (reference: TFSparkNode.py:260-297).
+    """
+
+    def _mapfn(iterator):
+        from tensorflowonspark_tpu.cluster.cluster import InputMode
+        from tensorflowonspark_tpu.utils.env import write_executor_id
+
+        # 1. claim executor id from the start partition payload
+        executor_id = None
+        for item in iterator:
+            executor_id = item
+        assert executor_id is not None, "empty start partition"
+        workdir = _local_executor_workdir()
+        write_executor_id(executor_id, workdir)
+
+        template = cluster_meta["cluster_template"]
+        job_name, task_index = _role_for(template, executor_id)
+        logger.info(
+            "executor_id=%d assigned role %s:%d", executor_id, job_name, task_index
+        )
+
+        # 2. duplicate / retry detection (reference: TFSparkNode.py:227-233):
+        # if this executor already hosts a *running* manager for this
+        # cluster, the engine re-ran the start task — fail fast so the
+        # retry lands elsewhere instead of double-starting a TPU owner.
+        existing = _read_manager_info(workdir)
+        if existing is not None and existing.get("cluster_id") == cluster_meta["id"]:
+            try:
+                m = manager.connect(
+                    tuple(existing["addr"]), bytes.fromhex(existing["authkey"])
+                )
+                state = str(m.get("state")._getvalue())
+            except (ConnectionError, OSError):
+                # The previous incarnation died with its manager: this is a
+                # legitimate retry — start fresh.
+                state = "dead"
+            if state == "running":
+                raise RuntimeError(
+                    "TFOS node already running on executor {0}; "
+                    "duplicate start task".format(executor_id)
+                )
+
+        # 3. start the per-node queue manager (reference: TFSparkNode.py:235-246)
+        authkey = uuid.uuid4().bytes
+        is_service_node = job_name in ("ps", "evaluator")
+        if is_service_node:
+            queues = ["control", "error"]
+        else:
+            queues = list(cluster_meta.get("queues", ["input", "output", "error"]))
+            if "error" not in queues:
+                queues.append("error")
+        # All managers bind 'remote' (all interfaces + HMAC authkey) so the
+        # driver can reach every node directly for shutdown/error-check —
+        # the reference could only reach ps/evaluator managers and had to
+        # run a racy per-executor job to shut workers down
+        # (reference: TFManager.py:60-63, TFCluster.py:174-194).
+        mgr, addr = manager.start(authkey, queues, mode="remote")
+        _register_local_manager(mgr)  # keepalive for the executor lifetime
+        mgr.set("state", "running")
+        host = get_ip_address()
+        adv_addr = (host, addr[1])
+        _write_manager_info(
+            workdir,
+            {
+                "cluster_id": cluster_meta["id"],
+                "addr": list(adv_addr),
+                "authkey": authkey.hex(),
+            },
+        )
+
+        # 5. reserve a port for this node's coordination plane (the
+        # moral equivalent of the reference's TF gRPC port,
+        # TFSparkNode.py:330-335): bound now so it can't be stolen
+        # between registration and jax.distributed.initialize.
+        coord_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        coord_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        coord_sock.bind(("", 0))
+        coord_port = coord_sock.getsockname()[1]
+
+        # tensorboard on exactly one node: the chief when one exists, else
+        # worker:0 (reference: TFSparkNode.py:260-297; the reference's
+        # condition could double-launch when both chief and worker:0 exist)
+        tb_pid, tb_port = 0, 0
+        has_chief = any(j in template for j in ("chief", "master"))
+        is_tb_node = (
+            job_name in ("chief", "master")
+            if has_chief
+            else (job_name == "worker" and task_index == 0)
+        )
+        if tensorboard and is_tb_node:
+            from tensorflowonspark_tpu.tensorboard import start_tensorboard
+
+            tb_proc, tb_port = start_tensorboard(log_dir)
+            tb_pid = tb_proc.pid if tb_proc is not None else 0
+
+        # 6. rendezvous registration + startup barrier
+        # (reference: TFSparkNode.py:300-338)
+        node_meta = {
+            "executor_id": executor_id,
+            "host": host,
+            "job_name": job_name,
+            "task_index": task_index,
+            "addr": list(adv_addr),
+            "authkey": authkey.hex(),
+            "port": coord_port,
+            "tb_pid": tb_pid,
+            "tb_port": tb_port,
+            "device_info": _safe_device_info(),
+        }
+        client = reservation.Client(cluster_meta["server_addr"])
+        client.register(node_meta)
+        cluster_info = client.await_reservations(
+            timeout=cluster_meta.get("reservation_timeout", 600)
+        )
+        client.close()
+
+        # 7. cluster spec sorted by executor id (reference: TFSparkNode.py:340-352)
+        spec, coordinator, process_ranks = build_cluster_spec(cluster_info)
+
+        # accelerator allocation by HOST-LOCAL rank: co-located nodes must
+        # land on disjoint chip windows, so the index comes from this
+        # node's position among same-host nodes, not the global task_index
+        # (reference: TFSparkNode.py:149-207 + gpu_info.py:74-86).
+        # Visibility env vars are set before the compute process spawns.
+        num_chips = cluster_meta.get("num_chips_per_node")
+        if num_chips:
+            cohosted = sorted(
+                n["executor_id"] for n in cluster_info if n["host"] == host
+            )
+            local_rank = cohosted.index(executor_id)
+            tpu_info.set_visible_chips(
+                tpu_info.get_chips(num_chips, worker_index=local_rank)
+            )
+
+        # The coordination port was held only across the registration
+        # barrier so no co-located node could grab it; release it now —
+        # jax.distributed.initialize (or a user server) must be able to
+        # bind it from the compute process.
+        coord_sock.close()
+
+        ctx = NodeContext(
+            executor_id=executor_id,
+            job_name=job_name,
+            task_index=task_index,
+            cluster_spec=spec,
+            default_fs=cluster_meta.get("default_fs", "file://"),
+            working_dir=workdir,
+            mgr=None,  # compute process rebinds via manager_addr
+            coordinator=coordinator,
+            process_id=process_ranks.get(executor_id, 0),
+            num_processes=len(process_ranks) or 1,
+            device_info=node_meta["device_info"],
+            manager_addr=list(adv_addr),
+            manager_authkey=authkey.hex(),
+        )
+
+        # 8. launch user fn (reference: TFSparkNode.py:375-431)
+        background = (input_mode == InputMode.SPARK) or is_service_node
+        if background:
+            try:
+                import cloudpickle as _cp
+            except ImportError:  # pragma: no cover
+                import pickle as _cp
+
+            # The compute process owns the TPU chips; exactly one per
+            # node (SURVEY.md §7 'Spark process model vs TPU ownership').
+            proc = multiprocessing.get_context("spawn").Process(
+                target=_compute_process_main,
+                args=(_cp.dumps(fn), args, ctx),
+                daemon=True,
+                name="compute-%s-%d" % (job_name, task_index),
+            )
+            proc.start()
+            mgr.set("compute_pid", proc.pid)
+
+            if is_service_node:
+                # ps/evaluator executors block on the control queue until
+                # the driver posts None (reference: TFSparkNode.py:409-426),
+                # pinning the executor slot so no feed task lands here.
+                control = mgr.get_queue("control")
+                while True:
+                    msg = control.get(block=True)
+                    control.task_done()
+                    if msg is None:
+                        break
+                _check_error_queue(mgr)
+                proc.terminate()
+                mgr.set("state", "stopped")
+            # SPARK-mode workers return immediately, freeing the executor
+            # for feed tasks; the compute process keeps running.
+        else:
+            # TENSORFLOW input mode: user fn reads its own data; run in
+            # the foreground, pinning this executor for the duration
+            # (reference: TFSparkNode.py:427-431).
+            ctx.mgr = mgr
+            try:
+                fn(args, ctx)
+            except Exception:
+                import traceback
+
+                mgr.get_queue("error").put(traceback.format_exc())
+                mgr.set("state", "stopped")
+                raise
+            mgr.set("state", "stopped")
+        return []
+
+    return _mapfn
+
+
+def _safe_device_info():
+    """Device info without forcing JAX backend init in the executor task
+    process (only the compute process may own TPU chips)."""
+    try:
+        return tpu_info.get_device_info_lazy()
+    except Exception:  # noqa: BLE001 - absent accelerators are fine
+        return {"platform": "unknown", "num_devices": 0}
+
+
+def build_cluster_spec(cluster_info):
+    """Assemble ``{job: ["host:port", ...]}`` sorted by executor id, plus
+    the JAX coordination plan (reference: TFSparkNode.py:340-362 built the
+    TF clusterspec + TF_CONFIG; the TPU plan is a coordinator address and
+    a dense process rank per compute node).
+
+    Returns ``(spec, coordinator, process_ranks)`` where ``process_ranks``
+    maps executor_id → JAX process index over the *compute* nodes
+    (chief/master/worker — ps and evaluator are not part of the mesh).
+    """
+    ordered = sorted(cluster_info, key=lambda n: n["executor_id"])
+    spec = {}
+    for node in ordered:
+        spec.setdefault(node["job_name"], []).append(
+            "{0}:{1}".format(node["host"], node["port"])
+        )
+    compute = [
+        n for n in ordered if n["job_name"] in ("chief", "master", "worker")
+    ]
+    process_ranks = {n["executor_id"]: i for i, n in enumerate(compute)}
+    coordinator = (
+        "{0}:{1}".format(compute[0]["host"], compute[0]["port"]) if compute else None
+    )
+    return spec, coordinator, process_ranks
+
+
+# ----------------------------------------------------------------------
+# Data-plane map functions (feed jobs)
+# ----------------------------------------------------------------------
+
+
+def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    """Build the feeder map function for training data
+    (reference: TFSparkNode.py:436-503)."""
+
+    def _train(iterator):
+        mgr = _get_manager(cluster_info, get_ip_address(), _local_executor_id())
+        state = str(mgr.get("state")._getvalue())
+        logger.info("connected to node manager, state=%s", state)
+        terminating = state == "terminating"
+        queue = mgr.get_queue(qname)
+        if terminating:
+            # Compute is done: discard remaining partitions quickly and
+            # tell the driver to stop scheduling feed jobs
+            # (reference: TFSparkNode.py:458-499).
+            logger.info("node terminating; skipping partition")
+            count = sum(1 for _ in iterator)
+            logger.debug("skipped %d items", count)
+            try:
+                client = reservation.Client(cluster_meta["server_addr"])
+                client.request_stop()
+                client.close()
+            except (ConnectionError, OSError) as e:
+                logger.debug("unable to reach reservation server: %s", e)
+            return []
+        count = 0
+        for item in iterator:
+            count += 1
+            queue.put(item, block=True)
+        # wait for consumption, surfacing compute errors promptly
+        # (reference: TFSparkNode.py:472-483)
+        joinThr = _JoinWatcher(queue)
+        timeout = feed_timeout
+        while joinThr.is_alive():
+            _check_error_queue(mgr)
+            time.sleep(1)
+            timeout -= 1
+            if timeout <= 0:
+                raise RuntimeError(
+                    "timed out waiting for consumption of all batches "
+                    "(feed_timeout exceeded)"
+                )
+        _check_error_queue(mgr)
+        logger.info("fed %d items", count)
+        return []
+
+    return _train
+
+
+def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
+    """Build the inference map function: feed a partition, then drain
+    exactly as many results (reference: TFSparkNode.py:506-565)."""
+
+    def _inference(iterator):
+        mgr = _get_manager(cluster_info, get_ip_address(), _local_executor_id())
+        queue_in = mgr.get_queue(qname)
+        count = 0
+        for item in iterator:
+            count += 1
+            queue_in.put(item, block=True)
+        queue_in.put(EndPartition())
+        if count == 0:
+            return []
+        joinThr = _JoinWatcher(queue_in)
+        timeout = feed_timeout
+        while joinThr.is_alive():
+            _check_error_queue(mgr)
+            time.sleep(1)
+            timeout -= 1
+            if timeout <= 0:
+                raise RuntimeError("timed out waiting for inference consumption")
+        _check_error_queue(mgr)
+        queue_out = mgr.get_queue("output")
+        results = []
+        while count > 0:
+            results.append(queue_out.get(block=True))
+            queue_out.task_done()
+            count -= 1
+        logger.info("returning %d inference results", len(results))
+        return results
+
+    return _inference
+
+
+def shutdown(cluster_info, queues, cluster_meta, grace_secs=0):
+    """Build the worker-shutdown map function (reference:
+    TFSparkNode.py:570-622)."""
+
+    def _shutdown(iterator):
+        host = get_ip_address()
+        executor_id = _local_executor_id()
+        mgr = _get_manager(cluster_info, host, executor_id)
+
+        # stop tensorboard if this node launched one
+        # (reference: TFSparkNode.py:587-593)
+        for node in cluster_info:
+            if node["executor_id"] == executor_id and node.get("tb_pid"):
+                import signal
+
+                try:
+                    os.kill(node["tb_pid"], signal.SIGTERM)
+                except OSError:
+                    pass
+
+        # end-of-feed sentinel on each data queue
+        # (reference: TFSparkNode.py:595-605)
+        for qname in queues:
+            try:
+                mgr.get_queue(qname).put(None, block=True)
+            except Exception:  # noqa: BLE001 - queue may not exist on this role
+                logger.debug("no queue %s on executor %d", qname, executor_id)
+
+        if grace_secs > 0:
+            # let the compute process finish consuming + exporting
+            # (reference: TFSparkNode.py:607-610)
+            time.sleep(grace_secs)
+
+        # peek-and-requeue the error queue so engine-level task retries
+        # still observe the failure (reference: TFSparkNode.py:612-618)
+        try:
+            error = mgr.get_queue("error").get(block=False)
+            mgr.get_queue("error").task_done()
+            mgr.get_queue("error").put(error)
+            raise RuntimeError(
+                "compute process on executor {0} failed:\n{1}".format(
+                    executor_id, error
+                )
+            )
+        except _queue_mod.Empty:
+            pass
+
+        mgr.set("state", "stopped")
+        return []
+
+    return _shutdown
+
+
+def _check_error_queue(mgr):
+    """Raise if the node's compute process posted an error; the error is
+    re-queued first so later tasks (and shutdown) see it too
+    (reference: TFSparkNode.py:476-479,612-618)."""
+    try:
+        error = mgr.get_queue("error").get(block=False)
+        mgr.get_queue("error").task_done()
+        mgr.get_queue("error").put(error)
+        raise RuntimeError("compute process failed:\n{0}".format(error))
+    except _queue_mod.Empty:
+        pass
+
+
+class _JoinWatcher(object):
+    """Runs ``queue.join()`` on a daemon thread so the caller can poll
+    with a timeout + error checks (reference: TFSparkNode.py:472-475)."""
+
+    def __init__(self, queue):
+        import threading
+
+        self._t = threading.Thread(target=queue.join, daemon=True)
+        self._t.start()
+
+    def is_alive(self):
+        return self._t.is_alive()

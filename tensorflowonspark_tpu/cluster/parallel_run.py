@@ -1,0 +1,74 @@
+"""Barrier-mode launcher for N independent single-node instances.
+
+Re-designed from the reference's ``TFParallel.py`` (reference:
+tensorflowonspark/TFParallel.py:17-64), which used Spark barrier
+execution to run one *independent* (non-communicating) instance per
+executor — the parallel batch-inference pattern.  Each instance gets a
+bare :class:`~tensorflowonspark_tpu.cluster.node.NodeContext` with no
+cluster spec and runs the user function in the foreground.
+"""
+
+import logging
+
+from tensorflowonspark_tpu.cluster.node import NodeContext
+
+logger = logging.getLogger(__name__)
+
+
+def run(engine, map_fun, args=None, num_executors=None, num_chips_per_node=None):
+    """Run ``map_fun(args, ctx)`` as N independent single-node instances
+    (reference: TFParallel.py:17-63).
+
+    Returns the per-instance results collected from all executors.
+    """
+    from tensorflowonspark_tpu.engine import Engine, LocalEngine, SparkEngine
+
+    owns_engine = False
+    if isinstance(engine, int):
+        engine = LocalEngine(engine)
+        owns_engine = True
+    elif not isinstance(engine, Engine) and hasattr(engine, "parallelize"):
+        engine = SparkEngine(engine)
+    if num_executors is None:
+        num_executors = engine.num_executors
+
+    default_fs = engine.default_fs
+
+    def _mapfn(iterator):
+        import os
+
+        from tensorflowonspark_tpu.cluster import tpu_info
+        from tensorflowonspark_tpu.engine import TFOS_EXECUTOR_WORKDIR
+
+        executor_id = None
+        for item in iterator:
+            executor_id = item
+        assert executor_id is not None
+        # chip allocation for co-located instances (reference:
+        # TFParallel.py:38-48 barrier placement + GPU alloc).  NOTE:
+        # executor_id is only a correct host-local rank on single-host
+        # engines (LocalEngine); a multi-host Spark deployment needs
+        # host-grouped ranks like cluster mode computes from its
+        # rendezvous info — instances there should pass explicit chips.
+        if num_chips_per_node:
+            tpu_info.set_visible_chips(
+                tpu_info.get_chips(num_chips_per_node, worker_index=executor_id)
+            )
+        ctx = NodeContext(
+            executor_id=executor_id,
+            job_name="worker",
+            task_index=executor_id,
+            cluster_spec={"worker": ["localhost"] * num_executors},
+            default_fs=default_fs,
+            working_dir=os.environ.get(TFOS_EXECUTOR_WORKDIR, os.getcwd()),
+        )
+        result = map_fun(args, ctx)
+        return [result] if result is not None else []
+
+    try:
+        return engine.run_job(
+            _mapfn, [[i] for i in range(num_executors)], collect=True
+        )
+    finally:
+        if owns_engine:
+            engine.stop()

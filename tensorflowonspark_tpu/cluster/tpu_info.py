@@ -105,3 +105,22 @@ def get_chips(num_chips, worker_index=-1, total_chips=None):
                 "per host".format(worker_index, num_chips, total_chips)
             )
     return list(range(start, start + num_chips))
+
+
+def get_device_info_lazy():
+    """Device info WITHOUT initializing a JAX backend.
+
+    The executor task process must never claim TPU chips (exactly one
+    process per host may own a chip set — the compute process); this
+    reads env/topology hints only.  ``get_device_info`` (above) is the
+    full probe for use inside the compute process.
+    """
+    platform = "tpu" if os.environ.get("TPU_SKIP_MDS_QUERY") or os.environ.get(
+        "TPU_VISIBLE_CHIPS"
+    ) else os.environ.get("JAX_PLATFORMS", "unknown").split(",")[0] or "unknown"
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        num = len([c for c in visible.split(",") if c.strip()])
+    else:
+        num = int(os.environ.get("TPU_HOST_CHIPS", "0"))
+    return {"platform": platform, "num_devices": num, "devices": []}

@@ -1,0 +1,1 @@
+from tensorflowonspark_tpu.data.feed import DataFeed  # noqa: F401

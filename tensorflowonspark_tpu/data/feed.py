@@ -1,0 +1,221 @@
+"""DataFeed: the compute-process side of the executor data plane.
+
+Re-designed from the reference's ``TFNode.DataFeed`` (reference:
+tensorflowonspark/TFNode.py:221-329).  Semantics preserved:
+
+- ``next_batch(batch_size)`` blocks on the input queue and returns up to
+  ``batch_size`` items; a ``None`` sentinel means end-of-feed
+  (reference: TFNode.py:243-288), an ``EndPartition`` marker truncates
+  the batch at a partition boundary (reference: TFNode.py:268-274).
+- With ``input_mapping``, batches come back as a dict of named columns
+  (reference: TFNode.py:276-288) — the natural layout for feeding a JAX
+  step function.
+- ``batch_results`` pushes inference results to the output queue
+  (reference: TFNode.py:294-305).
+- ``terminate`` sets the node state to ``'terminating'`` and drains the
+  input queue so blocked feeders are released
+  (reference: TFNode.py:307-329).
+
+TPU-native additions (no reference analogue — SURVEY.md §7 step 3):
+
+- ``batches(...)`` generator with numpy stacking, padding of the final
+  short batch, and optional device placement,
+- ``prefetch_to_device`` double-buffering so host→HBM transfer of batch
+  N+1 overlaps compute on batch N (the InputMode.SPARK → HBM path).
+"""
+
+import logging
+import queue as _queue_mod
+
+import numpy as np
+
+from tensorflowonspark_tpu.cluster.marker import EndPartition
+
+logger = logging.getLogger(__name__)
+
+
+class DataFeed(object):
+    """Consumes feed items from the executor queue manager inside the
+    compute process (reference: TFNode.py:221)."""
+
+    def __init__(
+        self,
+        mgr,
+        train_mode=True,
+        qname_in="input",
+        qname_out="output",
+        input_mapping=None,
+    ):
+        self.mgr = mgr
+        self.train_mode = train_mode
+        self.qname_in = qname_in
+        self.qname_out = qname_out
+        self.done_feeding = False
+        # Sorted column order matches the driver's df.select(sorted(cols))
+        # convention (reference: TFNode.py:239-241, pipeline.py:411-413).
+        self.input_tensors = (
+            sorted(input_mapping.keys()) if input_mapping is not None else None
+        )
+
+    def next_batch(self, batch_size):
+        """Gets a batch of items from the input queue.
+
+        Blocks until items are available (or the ``None`` end-of-feed
+        sentinel is seen).  Returns a list of items, or — when
+        ``input_mapping`` was provided — a dict of named column lists
+        (reference: TFNode.py:243-288).
+        """
+        queue_in = self.mgr.get_queue(self.qname_in)
+        tensors = [] if self.input_tensors is None else {
+            tensor: [] for tensor in self.input_tensors
+        }
+        count = 0
+        while count < batch_size:
+            item = queue_in.get(block=True)
+            if item is None:
+                # End-of-feed: mark done and stop (reference: TFNode.py:265-268)
+                queue_in.task_done()
+                self.done_feeding = True
+                break
+            elif isinstance(item, EndPartition):
+                # Truncate the batch at a partition boundary
+                # (reference: TFNode.py:268-274)
+                queue_in.task_done()
+                if count > 0:
+                    break
+            else:
+                if self.input_tensors is None:
+                    tensors.append(item)
+                else:
+                    for i, tensor in enumerate(self.input_tensors):
+                        tensors[tensor].append(item[i])
+                count += 1
+                queue_in.task_done()
+        logger.debug("next_batch() returning %d items", count)
+        return tensors
+
+    def should_stop(self):
+        """True once the feeder posted the end-of-feed sentinel
+        (reference: TFNode.py:290-292)."""
+        return self.done_feeding
+
+    def batch_results(self, results):
+        """Push a batch of inference results to the output queue
+        (reference: TFNode.py:294-305)."""
+        queue_out = self.mgr.get_queue(self.qname_out)
+        for item in results:
+            queue_out.put(item, block=True)
+
+    def terminate(self):
+        """Terminate data feeding early: set node state to 'terminating'
+        and drain the input queue so blocked feeders are released
+        (reference: TFNode.py:307-329)."""
+        logger.info("terminate() invoked")
+        self.mgr.set("state", "terminating")
+
+        queue_in = self.mgr.get_queue(self.qname_in)
+        count = 0
+        done = False
+        while not done:
+            try:
+                queue_in.get(block=True, timeout=5)
+                queue_in.task_done()
+                count += 1
+            except _queue_mod.Empty:
+                done = True
+        logger.info("terminate() drained %d items from input queue", count)
+
+    # ------------------------------------------------------------------
+    # TPU-native batch pipeline (SURVEY.md §7 step 3)
+    # ------------------------------------------------------------------
+
+    def batches(self, batch_size, stack=True, pad_to_batch=False):
+        """Generator of batches until end-of-feed.
+
+        The JAX analogue of the reference examples' ``rdd_generator`` →
+        ``tf.data.Dataset.from_generator`` idiom (reference:
+        examples/mnist/keras/mnist_spark.py:33-47), folded into the
+        framework so user code shrinks.
+
+        Args:
+          batch_size: items per batch.
+          stack: stack each column into a single ``np.ndarray``.
+          pad_to_batch: zero-pad the final short batch to ``batch_size``
+            (static shapes keep XLA from recompiling the jitted step);
+            yields ``(batch, n_valid)`` tuples when set.
+        """
+        while not self.should_stop():
+            batch = self.next_batch(batch_size)
+            n = _batch_len(batch)
+            if n == 0:
+                continue
+            if stack:
+                batch = _stack_batch(batch)
+            if pad_to_batch:
+                if n < batch_size:
+                    batch = _pad_batch(batch, batch_size)
+                yield batch, n
+            else:
+                yield batch
+
+
+def _batch_len(batch):
+    if isinstance(batch, dict):
+        return len(next(iter(batch.values()))) if batch else 0
+    return len(batch)
+
+
+def _stack_batch(batch):
+    """Rows → columnar numpy arrays (host-side, ready for device_put)."""
+    if isinstance(batch, dict):
+        return {k: np.asarray(v) for k, v in batch.items()}
+    rows = [np.asarray(r) for r in batch]
+    return np.stack(rows)
+
+
+def _pad_batch(batch, batch_size):
+    def pad(a):
+        n = batch_size - a.shape[0]
+        if n <= 0:
+            return a
+        widths = [(0, n)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths)
+
+    if isinstance(batch, dict):
+        return {k: pad(v) for k, v in batch.items()}
+    return pad(batch)
+
+
+def prefetch_to_device(iterator, size=2, sharding=None):
+    """Double-buffered host→device transfer.
+
+    Keeps ``size`` batches in flight: batch N+1's ``jax.device_put`` (an
+    async HBM DMA on TPU) overlaps the compute consuming batch N —
+    the zero-copy staging the reference's JoinableQueue feed path lacks
+    (SURVEY.md §7 'Hard parts: feed-path throughput').
+
+    Args:
+      iterator: yields pytrees of numpy arrays (or ``(batch, n)`` tuples).
+      size: number of in-flight device batches.
+      sharding: optional ``jax.sharding.Sharding`` for multi-chip
+        placement of each batch (data-parallel feeding).
+    """
+    import collections
+
+    import jax
+
+    q = collections.deque()
+
+    def put(item):
+        if sharding is not None:
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, sharding), item
+            )
+        return jax.tree_util.tree_map(jax.device_put, item)
+
+    for item in iterator:
+        q.append(put(item))
+        if len(q) >= size:
+            yield q.popleft()
+    while q:
+        yield q.popleft()

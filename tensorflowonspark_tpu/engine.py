@@ -1,0 +1,330 @@
+"""Execution engines: the substrate that stands in for Spark.
+
+The reference is welded to Spark — ``TFCluster.run`` takes a
+``SparkContext`` and every job is an RDD operation (reference:
+tensorflowonspark/TFCluster.py:215-334).  The TPU build abstracts the
+executor fleet behind a small ``Engine`` interface so the same cluster /
+data-plane / compute code runs on:
+
+- ``LocalEngine`` — N executor *processes* on one host, with Spark-like
+  scheduling semantics (serial task execution per executor, tasks pulled
+  from a shared pool by free executors).  This is both the test substrate
+  (the reference tested against a 2-worker local Spark Standalone cluster
+  for the same reason, reference: test/run_tests.sh:16-27) and a real
+  single-host runtime for TPU pods-in-one-VM.
+- ``SparkEngine`` — a thin adapter over a live ``SparkContext`` when
+  pyspark is installed (gated import; the orchestration protocol is
+  identical).
+
+Scheduling semantics preserved from Spark (these are load-bearing — the
+reference's correctness depends on them, SURVEY.md §7 'Hard parts'):
+
+- each executor runs ONE task at a time (a 1-core executor);
+- a task that blocks (ps control loop, TENSORFLOW-mode training) pins its
+  executor, so data-feed tasks are only ever scheduled on free executors;
+- task failure fails the whole job and propagates the remote traceback.
+"""
+
+import logging
+import multiprocessing
+import os
+import queue as _queue_mod
+import tempfile
+import threading
+import traceback
+
+try:
+    import cloudpickle as _pickle
+except ImportError:  # pragma: no cover - cloudpickle is in the base image
+    import pickle as _pickle
+
+logger = logging.getLogger(__name__)
+
+#: Env var carrying the executor's stable id inside executor processes
+#: (the reference used a file handshake, util.py:77-85; we set both).
+TFOS_EXECUTOR_WORKDIR = "TFOS_EXECUTOR_WORKDIR"
+
+
+class JobHandle(object):
+    """Handle for an asynchronously launched job."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._results = None
+        self._error = None
+
+    def _complete(self, results=None, error=None):
+        self._results = results
+        self._error = error
+        self._done.set()
+
+    def wait(self, timeout=None):
+        """Block until the job finishes; re-raises remote failure."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("job did not complete within timeout")
+        if self._error is not None:
+            raise RuntimeError("job failed: {0}".format(self._error))
+        return self._results
+
+    def done(self):
+        return self._done.is_set()
+
+    @property
+    def error(self):
+        return self._error
+
+
+class Engine(object):
+    """Abstract executor-fleet interface (see module docstring)."""
+
+    @property
+    def num_executors(self):
+        raise NotImplementedError
+
+    @property
+    def default_fs(self):
+        """Filesystem root for relative paths (reference reads
+        ``fs.defaultFS`` from the Hadoop conf, TFCluster.py:274)."""
+        return "file://"
+
+    def run_job(self, mapfn, partitions, collect=False):
+        """Run ``mapfn(iterator)`` over each partition; blocks.
+
+        Returns the concatenated per-partition results if ``collect``.
+        Spark analogue: ``rdd.mapPartitions(...).collect()`` /
+        ``rdd.foreachPartition(...)``.
+        """
+        raise NotImplementedError
+
+    def run_job_async(self, mapfn, partitions):
+        """Launch a job without blocking; returns a :class:`JobHandle`.
+
+        Spark analogue: the reference's daemon-thread ``foreachPartition``
+        launch of the start job (reference: TFCluster.py:316-334).
+        """
+        handle = JobHandle()
+
+        def _runner():
+            try:
+                handle._complete(results=self.run_job(mapfn, partitions, collect=True))
+            except Exception as e:  # noqa: BLE001 - job boundary
+                logger.error("async job failed: %s", e)
+                handle._complete(error="{0}".format(e))
+
+        t = threading.Thread(target=_runner, daemon=True, name="job-runner")
+        t.start()
+        return handle
+
+    def num_active_jobs(self):
+        """Approximate count of running jobs (reference polls the Spark
+        statusTracker, TFCluster.py:154-169,196-202)."""
+        return 0
+
+    def stop(self):
+        pass
+
+
+# ----------------------------------------------------------------------
+# LocalEngine
+# ----------------------------------------------------------------------
+
+
+def _executor_main(executor_idx, workdir, task_queue, result_queue, env_overrides):
+    """Executor process main loop: pull (job_id, task_id, payload) off the
+    shared task queue, run it, report (job_id, task_id, ok, payload)."""
+    os.environ[TFOS_EXECUTOR_WORKDIR] = workdir
+    os.environ.update(env_overrides or {})
+    os.chdir(workdir)
+    # Own process group so engine.stop() can reap the whole executor tree
+    # (queue-manager and compute children included).
+    try:
+        os.setpgid(0, 0)
+    except OSError:
+        pass
+    # Child processes spawned by tasks (compute processes) must not be
+    # reaped here; they outlive individual tasks by design.
+    while True:
+        item = task_queue.get()
+        if item is None:
+            break
+        job_id, task_id, fn_bytes, part_bytes = item
+        try:
+            fn = _pickle.loads(fn_bytes)
+            partition = _pickle.loads(part_bytes)
+            result = fn(iter(partition))
+            result = list(result) if result is not None else []
+            result_queue.put((job_id, task_id, True, _pickle.dumps(result)))
+        except Exception:  # noqa: BLE001 - task boundary, traceback shipped home
+            result_queue.put(
+                (job_id, task_id, False, traceback.format_exc())
+            )
+
+
+class LocalEngine(Engine):
+    """N executor processes on one host with Spark-like task scheduling."""
+
+    def __init__(self, num_executors, env=None, start_method="spawn"):
+        self._num_executors = num_executors
+        self._ctx = multiprocessing.get_context(start_method)
+        self._task_queue = self._ctx.Queue()
+        self._result_queue = self._ctx.Queue()
+        self._job_counter = 0
+        self._active_jobs = 0
+        self._lock = threading.Lock()
+        #: job_id -> local queue; a single dispatcher thread routes results
+        #: so concurrent run_job waiters never contend on the shared queue
+        #: (results for dead jobs — e.g. stragglers of a job whose waiter
+        #: already raised — are dropped here).
+        self._job_queues = {}
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_results, daemon=True, name="engine-dispatch"
+        )
+        self._dispatcher.start()
+        self._tmpdir = tempfile.mkdtemp(prefix="tfos_tpu_engine_")
+        self._procs = []
+        for i in range(num_executors):
+            workdir = os.path.join(self._tmpdir, "executor-%d" % i)
+            os.makedirs(workdir, exist_ok=True)
+            # non-daemonic: executors spawn children (node queue managers,
+            # compute processes); cleanup is handled by stop()
+            p = self._ctx.Process(
+                target=_executor_main,
+                args=(i, workdir, self._task_queue, self._result_queue, env or {}),
+                daemon=False,
+                name="executor-%d" % i,
+            )
+            p.start()
+            self._procs.append(p)
+        logger.info(
+            "LocalEngine started %d executor processes under %s",
+            num_executors,
+            self._tmpdir,
+        )
+
+    @property
+    def num_executors(self):
+        return self._num_executors
+
+    def _dispatch_results(self):
+        while True:
+            item = self._result_queue.get()
+            if item is None:
+                return
+            job_id = item[0]
+            with self._lock:
+                q = self._job_queues.get(job_id)
+            if q is not None:
+                q.put(item)
+            # else: straggler of a job whose waiter already gave up — drop
+
+    def run_job(self, mapfn, partitions, collect=False):
+        my_queue = _queue_mod.Queue()
+        with self._lock:
+            job_id = self._job_counter
+            self._job_counter += 1
+            self._active_jobs += 1
+            self._job_queues[job_id] = my_queue
+        try:
+            fn_bytes = _pickle.dumps(mapfn)
+            ntasks = len(partitions)
+            for task_id, part in enumerate(partitions):
+                self._task_queue.put(
+                    (job_id, task_id, fn_bytes, _pickle.dumps(list(part)))
+                )
+            results = [None] * ntasks
+            remaining = ntasks
+            while remaining:
+                _, task_id, ok, payload = my_queue.get()
+                if not ok:
+                    raise RuntimeError(
+                        "task {0} of job {1} failed:\n{2}".format(
+                            task_id, job_id, payload
+                        )
+                    )
+                results[task_id] = _pickle.loads(payload)
+                remaining -= 1
+            if collect:
+                return [item for part in results for item in part]
+            return None
+        finally:
+            with self._lock:
+                self._active_jobs -= 1
+                self._job_queues.pop(job_id, None)
+
+    def num_active_jobs(self):
+        with self._lock:
+            return self._active_jobs
+
+    def stop(self):
+        for _ in self._procs:
+            try:
+                self._task_queue.put(None)
+            except (OSError, ValueError):
+                pass
+        try:
+            self._result_queue.put(None)  # release the dispatcher thread
+        except (OSError, ValueError):
+            pass
+        for p in self._procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        # reap each executor's process group (managers, compute children)
+        import signal
+
+        for p in self._procs:
+            if p.pid:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except (OSError, ProcessLookupError):
+                    pass
+        logger.info("LocalEngine stopped")
+
+
+# ----------------------------------------------------------------------
+# SparkEngine (gated: requires pyspark at construction time)
+# ----------------------------------------------------------------------
+
+
+class SparkEngine(Engine):
+    """Adapter over a live SparkContext (reference architecture:
+    TFCluster.py drives nodeRDD/dataRDD jobs; here the same jobs flow
+    through :meth:`run_job`)."""
+
+    def __init__(self, sc):
+        self.sc = sc
+        self._num_executors = int(
+            sc.getConf().get("spark.executor.instances", "1")
+        )
+        try:
+            self._default_fs = sc._jsc.hadoopConfiguration().get("fs.defaultFS")
+        except Exception:  # noqa: BLE001 - py4j surface varies
+            self._default_fs = "file://"
+
+    @property
+    def num_executors(self):
+        return self._num_executors
+
+    @property
+    def default_fs(self):
+        return self._default_fs
+
+    def run_job(self, mapfn, partitions, collect=False):
+        rdd = self.sc.parallelize(partitions, len(partitions))
+
+        def _adapter(it):
+            out = []
+            for part in it:
+                r = mapfn(iter(part))
+                if r is not None:
+                    out.extend(r)
+            return out
+
+        if collect:
+            return rdd.mapPartitions(_adapter).collect()
+        rdd.foreachPartition(lambda it: mapfn(iter(next(it, []))))
+        return None
+
+    def num_active_jobs(self):
+        st = self.sc.statusTracker()
+        return len(st.getActiveJobsIds())

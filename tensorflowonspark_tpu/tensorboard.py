@@ -1,0 +1,70 @@
+"""Managed TensorBoard / profiler subprocesses.
+
+The reference launches TensorBoard as a managed subprocess on
+chief/worker:0 with a port from ``TENSORBOARD_PORT`` or an ephemeral one,
+surfaces the URL, and SIGTERMs it at shutdown (reference:
+tensorflowonspark/TFSparkNode.py:260-297, TFCluster.py:207-212).  Same
+pattern here, plus a hook for serving ``jax.profiler`` traces, the
+TPU-native profiling story (SURVEY.md §5 'Tracing/profiling').
+"""
+
+import logging
+import os
+import shutil
+import subprocess
+import sys
+
+logger = logging.getLogger(__name__)
+
+TENSORBOARD_PORT = "TENSORBOARD_PORT"
+
+
+def find_tensorboard():
+    """Locate a tensorboard executable (reference resolved it out of the
+    pypi install path or PATH, TFSparkNode.py:269-289)."""
+    tb = shutil.which("tensorboard")
+    if tb:
+        return [tb]
+    try:
+        import tensorboard  # noqa: F401
+
+        return [sys.executable, "-m", "tensorboard.main"]
+    except ImportError:
+        return None
+
+
+def start_tensorboard(log_dir, port=None):
+    """Launch tensorboard against ``log_dir``; returns ``(proc, port)``.
+
+    Returns ``(None, 0)`` when tensorboard isn't installed — the cluster
+    must come up regardless (the reference assumed a pypi install,
+    TFSparkNode.py:279-287; we degrade gracefully).
+    """
+    cmd = find_tensorboard()
+    if cmd is None or not log_dir:
+        logger.warning("tensorboard unavailable or no log_dir; skipping")
+        return None, 0
+    if port is None:
+        port = int(os.environ.get(TENSORBOARD_PORT, 0))
+    if not port:
+        from tensorflowonspark_tpu.utils.net import free_port
+
+        port = free_port()
+    proc = subprocess.Popen(
+        cmd + ["--logdir=%s" % log_dir, "--port=%d" % port, "--bind_all"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    logger.info("started tensorboard pid=%d port=%d", proc.pid, port)
+    return proc, port
+
+
+def start_profiler_server(port=9999):
+    """Expose this process's JAX profiler so Xprof/TensorBoard can capture
+    device traces (TPU-native analogue of TB-only profiling in the
+    reference)."""
+    import jax
+
+    jax.profiler.start_server(port)
+    logger.info("jax profiler server on port %d", port)
+    return port

@@ -1,0 +1,158 @@
+"""End-to-end cluster lifecycle tests over the LocalEngine.
+
+Mirrors the reference's integration suite (reference:
+test/test_TFCluster.py), which ran against a 2-worker local Spark
+Standalone cluster: basic independent graphs, a full InputMode.SPARK
+DataFeed round trip, and failure injection during/after feeding.
+"""
+
+import time
+
+import pytest
+
+from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+from tensorflowonspark_tpu.cluster.cluster import InputMode
+from tensorflowonspark_tpu.engine import LocalEngine
+
+
+# --- user map functions (top-level so they pickle by reference) ---------
+
+
+def _basic_fn(args, ctx):
+    # independent single-node computation per executor
+    # (reference: test_TFCluster.py:16-27 test_basic_tf)
+    x = [1.0, 2.0, 3.0]
+    assert sum(x) == 6.0
+
+
+def _square_fn(args, ctx):
+    # consume input queue, emit squares to output queue
+    # (reference: test_TFCluster.py:29-48 test_inputmode_spark)
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(10)
+        if batch:
+            feed.batch_results([x * x for x in batch])
+
+
+def _fail_during_feed_fn(args, ctx):
+    raise RuntimeError("injected failure before consuming")
+
+
+def _fail_after_feed_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(10)
+        if batch:
+            feed.batch_results([x * x for x in batch])
+    raise RuntimeError("injected failure after feeding")
+
+
+def _train_consume_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+        batch = feed.next_batch(16)
+        total += len(batch)
+
+
+@pytest.fixture()
+def engine():
+    e = LocalEngine(2)
+    yield e
+    e.stop()
+
+
+def test_basic_foreground(engine):
+    cluster = tpu_cluster.run(
+        engine,
+        _basic_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.TENSORFLOW,
+    )
+    cluster.shutdown(timeout=60)
+
+
+def test_inputmode_spark_roundtrip(engine):
+    cluster = tpu_cluster.run(
+        engine,
+        _square_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    # squares of 0..99 fed via 10 partitions (reference fed 0..999 via 10)
+    data = list(range(100))
+    partitions = [data[i::10] for i in range(10)]
+    results = cluster.inference(partitions, feed_timeout=60)
+    assert sorted(results) == sorted(x * x for x in data)
+    cluster.shutdown(grace_secs=1, timeout=60)
+
+
+def test_train_feed(engine):
+    cluster = tpu_cluster.run(
+        engine,
+        _train_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    partitions = [[[float(i), float(2 * i)] for i in range(20)] for _ in range(4)]
+    cluster.train(partitions, num_epochs=2, feed_timeout=60)
+    cluster.shutdown(grace_secs=1, timeout=60)
+
+
+def test_failure_during_feed(engine):
+    # reference: test_TFCluster.py:50-68 test_inputmode_spark_exception
+    cluster = tpu_cluster.run(
+        engine,
+        _fail_during_feed_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    partitions = [[1, 2, 3] for _ in range(4)]
+    with pytest.raises(RuntimeError, match="injected failure"):
+        cluster.train(partitions, feed_timeout=10)
+    with pytest.raises(RuntimeError):
+        cluster.shutdown(timeout=60)
+
+
+def test_failure_after_feed(engine):
+    # reference: test_TFCluster.py:70-93 test_inputmode_spark_late_exception:
+    # the error only surfaces via the error queue during shutdown
+    cluster = tpu_cluster.run(
+        engine,
+        _fail_after_feed_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    data = list(range(20))
+    partitions = [data[i::2] for i in range(2)]
+    results = cluster.inference(partitions, feed_timeout=60)
+    assert sorted(results) == sorted(x * x for x in data)
+    time.sleep(1)  # let the compute processes reach the injected raise
+    with pytest.raises(RuntimeError, match="injected failure after feeding"):
+        cluster.shutdown(grace_secs=2, timeout=60)
+
+
+def test_cluster_composition_validation(engine):
+    with pytest.raises(ValueError):
+        tpu_cluster.run(
+            engine, _basic_fn, args={}, num_executors=2, num_ps=2
+        )
+
+
+def _parallel_fn(args, ctx):
+    # independent per-instance work (reference: TFParallel pattern,
+    # examples/mnist/keras/mnist_inference.py:79)
+    return ctx.executor_id * 10
+
+
+def test_parallel_run(engine):
+    from tensorflowonspark_tpu.cluster import parallel_run
+
+    results = parallel_run.run(engine, _parallel_fn, args={}, num_executors=2)
+    assert sorted(results) == [0, 10]

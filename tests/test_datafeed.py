@@ -1,0 +1,112 @@
+"""DataFeed + QueueManager semantics.
+
+Modeled on the reference's test strategy (reference: test/test_TFNode.py:27-58
+runs DataFeed against a locally started real TFManager with a hand-fed
+queue including the ``None`` sentinel).
+"""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.cluster import manager
+from tensorflowonspark_tpu.cluster.marker import EndPartition
+from tensorflowonspark_tpu.data.feed import DataFeed, prefetch_to_device
+
+
+@pytest.fixture()
+def mgr():
+    m, addr = manager.start(b"test-authkey", ["input", "output", "error"])
+    yield m
+    m.shutdown()
+
+
+def _feed(mgr, items):
+    q = mgr.get_queue("input")
+    for item in items:
+        q.put(item)
+
+
+def test_next_batch_basic(mgr):
+    _feed(mgr, [[1, 2], [3, 4], [5, 6], None])
+    feed = DataFeed(mgr, train_mode=True)
+    batch = feed.next_batch(2)
+    assert batch == [[1, 2], [3, 4]]
+    assert not feed.should_stop()
+    batch = feed.next_batch(2)
+    assert batch == [[5, 6]]
+    assert feed.should_stop()
+
+
+def test_next_batch_input_mapping(mgr):
+    # input_mapping produces named columns in sorted-key order
+    # (reference: TFNode.py:276-288)
+    _feed(mgr, [[0, 10], [1, 11], None])
+    feed = DataFeed(mgr, input_mapping={"x": "inp", "y": "label"})
+    batch = feed.next_batch(4)
+    assert batch == {"x": [0, 1], "y": [10, 11]}
+    assert feed.should_stop()
+
+
+def test_end_partition_truncates_batch(mgr):
+    _feed(mgr, [[1], [2], EndPartition(), [3], None])
+    feed = DataFeed(mgr)
+    batch = feed.next_batch(10)
+    assert batch == [[1], [2]]
+    batch = feed.next_batch(10)
+    assert batch == [[3]]
+    assert feed.should_stop()
+
+
+def test_batch_results_roundtrip(mgr):
+    feed = DataFeed(mgr)
+    feed.batch_results([7, 8, 9])
+    q = mgr.get_queue("output")
+    assert [q.get() for _ in range(3)] == [7, 8, 9]
+
+
+def test_terminate_sets_state_and_drains(mgr):
+    _feed(mgr, [[1], [2], [3]])
+    feed = DataFeed(mgr)
+    feed.terminate()
+    assert mgr.get("state")._getvalue() == "terminating"
+    # queue now empty: join() returns immediately
+    mgr.get_queue("input").join()
+
+
+def test_batches_generator_stacks_and_pads(mgr):
+    _feed(mgr, [[i, 2 * i] for i in range(5)] + [None])
+    feed = DataFeed(mgr)
+    out = list(feed.batches(2, pad_to_batch=True))
+    assert len(out) == 3
+    (b0, n0), (_, n1), (b2, n2) = out
+    assert n0 == 2 and n1 == 2 and n2 == 1
+    assert b0.shape == (2, 2)
+    assert b2.shape == (2, 2)  # padded
+    np.testing.assert_array_equal(b2[1], [0, 0])
+
+
+def test_kv_store(mgr):
+    mgr.set("state", "running")
+    assert mgr.get("state")._getvalue() == "running"
+    assert mgr.get("missing")._getvalue() is None
+
+
+def test_remote_manager_cross_connect():
+    m, addr = manager.start(b"secret", ["control", "error"], mode="remote")
+    try:
+        # Reconnect as the driver would for ps shutdown
+        # (reference: TFCluster.py:186-194)
+        host_addr = ("127.0.0.1", addr[1])
+        client = manager.connect(host_addr, b"secret")
+        client.get_queue("control").put(None)
+        assert m.get_queue("control").get() is None
+    finally:
+        m.shutdown()
+
+
+def test_prefetch_to_device_preserves_order():
+    batches = [{"x": np.full((2, 2), i)} for i in range(5)]
+    out = list(prefetch_to_device(iter(batches), size=2))
+    assert len(out) == 5
+    for i, b in enumerate(out):
+        np.testing.assert_array_equal(np.asarray(b["x"]), np.full((2, 2), i))
