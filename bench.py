@@ -1,0 +1,87 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Workload: the reference's headline benchmark, ResNet56 on CIFAR-10-shaped
+synthetic data at batch 128 (reference defaults:
+examples/resnet/resnet_cifar_dist.py:33-35; measurement machinery modeled
+on the reference's TimeHistory/build_stats `exp_per_second`,
+examples/resnet/common.py:175-246; synthetic-input pattern from
+examples/resnet/common.py:315-363).
+
+Metric: trained images/sec on the available accelerator (one TPU chip
+under the driver).  ``vs_baseline`` divides by the BASELINE.md north-star
+stand-in — a nominal 20k img/s for ResNet56/CIFAR on one A100 with mixed
+precision (BASELINE.md records no published reference numbers, so the
+north-star "≥1× A100+NCCL per chip" is the only bar; 20k is our
+documented estimate of that bar for this workload).
+"""
+
+import json
+import sys
+import time
+
+A100_BASELINE_IMG_PER_SEC = 20000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import resnet
+    from tensorflowonspark_tpu.parallel import dp
+    from tensorflowonspark_tpu.parallel.mesh import build_mesh
+
+    platform = jax.devices()[0].platform
+    on_accel = platform in ("tpu", "gpu")
+    batch = 128 if on_accel else 32
+    warmup, timed = (5, 30) if on_accel else (1, 3)
+
+    dtype = "bfloat16" if on_accel else "float32"
+    model = resnet.ResNetCIFAR(depth=56, dtype=dtype)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, 32, 32, 3)))
+
+    mesh = build_mesh()
+    trainer = dp.SyncTrainer(
+        resnet.loss_fn(model),
+        optax.sgd(0.1, momentum=0.9),
+        mesh=mesh,
+        has_model_state=True,
+    )
+    state = trainer.create_state(
+        variables["params"], {"batch_stats": variables["batch_stats"]}
+    )
+
+    x = np.random.RandomState(0).rand(batch, 32, 32, 3).astype(np.float32)
+    y = (np.arange(batch) % 10).astype(np.int32)
+
+    for i in range(warmup):
+        state, metrics = trainer.step(state, (x, y), jax.random.PRNGKey(i))
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for i in range(timed):
+        state, metrics = trainer.step(state, (x, y), jax.random.PRNGKey(i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * timed / dt
+    print(
+        "platform=%s batch=%d steps=%d wall=%.3fs" % (platform, batch, timed, dt),
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "resnet56_cifar_train_images_per_sec",
+                "value": round(img_per_sec, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(img_per_sec / A100_BASELINE_IMG_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

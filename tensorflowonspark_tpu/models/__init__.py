@@ -1,0 +1,18 @@
+"""Model zoo covering the reference's acceptance workloads
+(reference: examples/mnist, examples/resnet, examples/segmentation —
+SURVEY.md §2.4) plus the long-context Transformer flagship the reference
+lacks (SURVEY.md §5 'Long-context / sequence parallelism: absent').
+
+All models are flax.linen modules carrying *logical* sharding
+annotations (see :mod:`tensorflowonspark_tpu.parallel.sharding`), so the
+same definition runs under DP, FSDP, TP, and sequence parallelism by
+swapping rule sets.
+"""
+
+from tensorflowonspark_tpu.models.mlp import MNISTNet  # noqa: F401
+from tensorflowonspark_tpu.models.resnet import ResNetCIFAR, ResNet50  # noqa: F401
+from tensorflowonspark_tpu.models.transformer import (  # noqa: F401
+    Transformer,
+    TransformerConfig,
+)
+from tensorflowonspark_tpu.models.unet import UNet  # noqa: F401

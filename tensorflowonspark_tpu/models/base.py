@@ -1,0 +1,62 @@
+"""Shared model utilities: logical-axis annotation of parameter trees.
+
+Bridges flax parameter pytrees to the sharding-rule system in
+:mod:`tensorflowonspark_tpu.parallel.sharding` without depending on
+flax's own logical-metadata machinery: each model ships a table of
+``(path_regex, logical_axes)`` rules, and :func:`annotate` produces the
+annotation pytree that ``param_specs`` consumes.
+"""
+
+import re
+
+import jax
+
+
+def _path_str(path):
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def annotate(params, path_rules):
+    """Build a logical-axis annotation pytree for ``params``.
+
+    Args:
+      params: parameter pytree.
+      path_rules: ordered ``(regex, axes_tuple_or_None)`` pairs matched
+        (``re.search``) against the slash-joined tree path; first match
+        wins.  Unmatched leaves get ``None`` (replicated / heuristic).
+
+    Returns a pytree with the same structure whose leaves are logical
+    axis tuples or ``None``.
+    """
+    compiled = [(re.compile(rx), axes) for rx, axes in path_rules]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    out = []
+    for path, leaf in flat:
+        s = _path_str(path)
+        axes = None
+        for rx, a in compiled:
+            if rx.search(s):
+                axes = a
+                break
+        if axes is not None and len(axes) != getattr(leaf, "ndim", len(axes)):
+            raise ValueError(
+                "annotation {0} rank-mismatches param {1} shape {2}".format(
+                    axes, s, getattr(leaf, "shape", None)
+                )
+            )
+        out.append(axes)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def param_count(params):
+    return sum(
+        getattr(l, "size", 0) for l in jax.tree_util.tree_leaves(params)
+    )

@@ -1,0 +1,66 @@
+"""MNIST model — the reference's smoke-test workload
+(reference: examples/mnist/keras/mnist_spark.py:20-27 builds
+Flatten→Dense(512,relu)→Dropout→Dense(10,softmax)).
+
+Same capacity here, flax-style, with a deterministic flag instead of a
+Dropout layer toggle (functional purity keeps the step jittable with no
+RNG plumbing in serving).
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import base
+
+
+class MNISTNet(nn.Module):
+    hidden: int = 512
+    num_classes: int = 10
+    dropout_rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, deterministic=True, rng=None):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        x = nn.Dense(self.hidden, name="dense1")(x)
+        x = nn.relu(x)
+        if not deterministic and rng is not None:
+            keep = 1.0 - self.dropout_rate
+            mask = jax.random.bernoulli(rng, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0)
+        return nn.Dense(self.num_classes, name="dense2")(x)
+
+
+LOGICAL_AXES_RULES = (
+    (r"dense1/kernel", ("embed", "mlp")),
+    (r"dense1/bias", ("mlp",)),
+    (r"dense2/kernel", ("mlp", None)),
+    (r"dense2/bias", None),
+)
+
+
+def logical_axes(params):
+    return base.annotate(params, LOGICAL_AXES_RULES)
+
+
+def loss_fn(model):
+    """Softmax cross-entropy; batch = (images, labels) or dict."""
+
+    def _loss(params, batch, rng):
+        if isinstance(batch, dict):
+            images, labels = batch["image"], batch["label"]
+        else:
+            images, labels = batch
+        logits = model.apply(
+            {"params": params}, images, deterministic=False, rng=rng
+        )
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[:, None], axis=-1
+        )[:, 0]
+        acc = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        )
+        return jnp.mean(nll), {"accuracy": acc}
+
+    return _loss

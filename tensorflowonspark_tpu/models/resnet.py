@@ -1,0 +1,216 @@
+"""ResNets — the reference's throughput benchmark workloads
+(reference: examples/resnet/resnet_cifar_dist.py ResNet56/CIFAR-10,
+examples/resnet/resnet_imagenet_main.py ResNet50/ImageNet; both vendored
+from tensorflow/models).
+
+Fresh flax implementations, TPU-first:
+
+- NHWC layout (XLA's native conv layout on TPU);
+- bfloat16 conv compute with f32 batch-norm statistics;
+- no dynamic shapes; `train` is a static flag so both graphs compile
+  once each.
+
+ResNetCIFAR follows the v1 topology of the paper the reference example
+implements (3 stages × n blocks, 16/32/64 filters, n = (depth-2)/6 → 56
+= n 9); ResNet50 is the standard bottleneck v1.5 (stride in the 3×3).
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import base
+
+
+class ConvBN(nn.Module):
+    filters: int
+    kernel: int = 3
+    strides: int = 1
+    dtype: str = "bfloat16"
+    use_relu: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = nn.Conv(
+            self.filters,
+            (self.kernel, self.kernel),
+            strides=(self.strides, self.strides),
+            padding="SAME",
+            use_bias=False,
+            dtype=jnp.dtype(self.dtype),
+            name="conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,
+            name="bn",
+        )(x)
+        if self.use_relu:
+            x = nn.relu(x)
+        return x
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        shortcut = x
+        y = ConvBN(self.filters, 3, self.strides, self.dtype, name="c1")(x, train)
+        y = ConvBN(self.filters, 3, 1, self.dtype, use_relu=False, name="c2")(
+            y, train
+        )
+        if shortcut.shape != y.shape:
+            shortcut = ConvBN(
+                self.filters, 1, self.strides, self.dtype, use_relu=False,
+                name="proj",
+            )(x, train)
+        return nn.relu(y + shortcut)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        shortcut = x
+        y = ConvBN(self.filters, 1, 1, self.dtype, name="c1")(x, train)
+        y = ConvBN(self.filters, 3, self.strides, self.dtype, name="c2")(y, train)
+        y = ConvBN(
+            self.filters * 4, 1, 1, self.dtype, use_relu=False, name="c3"
+        )(y, train)
+        if shortcut.shape != y.shape:
+            shortcut = ConvBN(
+                self.filters * 4, 1, self.strides, self.dtype,
+                use_relu=False, name="proj",
+            )(x, train)
+        return nn.relu(y + shortcut)
+
+
+class ResNetCIFAR(nn.Module):
+    """ResNet-v1 for 32×32 inputs (reference default depth 56)."""
+
+    depth: int = 56
+    num_classes: int = 10
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        n = (self.depth - 2) // 6
+        x = x.astype(jnp.dtype(self.dtype))
+        x = ConvBN(16, 3, 1, self.dtype, name="stem")(x, train)
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(n):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BasicBlock(
+                    filters, strides, self.dtype,
+                    name="stage%d_block%d" % (stage, block),
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32)
+        )
+
+
+class ResNet50(nn.Module):
+    """Bottleneck ResNet-50 for 224×224 inputs."""
+
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+    stage_sizes: tuple = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = x.astype(jnp.dtype(self.dtype))
+        x = nn.Conv(
+            64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+            use_bias=False, dtype=jnp.dtype(self.dtype), name="stem_conv",
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5,
+            dtype=jnp.float32, name="stem_bn",
+        )(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, blocks in enumerate(self.stage_sizes):
+            filters = 64 * (2 ** stage)
+            for block in range(blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    filters, strides, self.dtype,
+                    name="stage%d_block%d" % (stage, block),
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="fc")(
+            x.astype(jnp.float32)
+        )
+
+
+LOGICAL_AXES_RULES = (
+    # conv kernels: shard output channels on fsdp when wide enough
+    (r"fc/kernel", ("embed", None)),
+)
+
+
+def logical_axes(params):
+    return base.annotate(params, LOGICAL_AXES_RULES)
+
+
+@dataclasses.dataclass
+class CIFARSchedule:
+    """The reference's piecewise LR schedule (reference:
+    examples/resnet/resnet_cifar_dist.py:33-35: 0.1/0.01/0.001 at epoch
+    boundaries 91/136, scaled by batch/128)."""
+
+    batch_size: int = 128
+    steps_per_epoch: int = 390
+
+    def __call__(self, step):
+        scale = self.batch_size / 128.0
+        e = step / self.steps_per_epoch
+        lr = jnp.where(e < 91, 0.1, jnp.where(e < 136, 0.01, 0.001))
+        return lr * scale
+
+
+def loss_fn(model, weight_decay=2e-4):
+    """Cross-entropy + L2 (reference resnet uses wd 2e-4, vendored
+    official-models default).  Follows the trainer's model-state contract
+    (``SyncTrainer(has_model_state=True)``):
+    ``(params, model_state, batch, rng) -> (loss, (metrics, new_state))``
+    so BatchNorm running stats flow through :class:`TrainState`."""
+    import jax
+
+    def _loss(params, model_state, batch, rng):
+        if isinstance(batch, dict):
+            images, labels = batch["image"], batch["label"]
+        else:
+            images, labels = batch
+        logits, new_state = model.apply(
+            {"params": params, **model_state},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, labels.astype(jnp.int32)[:, None], axis=-1
+        )[:, 0]
+        l2 = sum(
+            jnp.sum(jnp.square(p.astype(jnp.float32)))
+            for p in jax.tree_util.tree_leaves(params)
+            if p.ndim > 1
+        )
+        acc = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32)
+        )
+        loss = jnp.mean(nll) + weight_decay * l2
+        return loss, ({"accuracy": acc}, dict(new_state))
+
+    return _loss

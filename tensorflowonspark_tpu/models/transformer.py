@@ -1,0 +1,187 @@
+"""Decoder-only Transformer LM — the long-context flagship.
+
+The reference has no transformer and no long-context support at all
+(SURVEY.md §5 'Long-context / sequence parallelism: absent'); this model
+is the vehicle for the new TP/SP/ring-attention capabilities.  Design is
+TPU-first:
+
+- bfloat16 activations/weights with f32 softmax/layernorm reductions —
+  MXU-native matmuls, stable reductions;
+- RoPE positions (no learned position table → no max-seq coupling, and
+  rotations fuse into the surrounding elementwise ops);
+- attention layout ``[B, S, H, D]`` so the ``seq`` dim shards for
+  ring/Ulysses context parallelism and ``H`` shards for TP;
+- static shapes everywhere; the whole forward is one traced jit region.
+
+Logical sharding axes (consumed by
+:func:`tensorflowonspark_tpu.parallel.sharding.param_specs` through
+:func:`logical_axes`): ``vocab``, ``embed``, ``heads``, ``mlp``.
+"""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import base
+from tensorflowonspark_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 4
+    num_heads: int = 8
+    head_dim: int = 64
+    embed_dim: int = 512
+    mlp_dim: int = 2048
+    max_seq_len: int = 2048
+    dtype: str = "bfloat16"
+    attention_impl: str = "dot"  # dot | flash | ring | ulysses
+    remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def rope(x, positions, max_wavelength=10000.0):
+    """Rotary position embedding on ``[B, S, H, D]`` (D even)."""
+    d = x.shape[-1]
+    freq = max_wavelength ** (
+        -jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2)
+    )
+    angles = positions[..., None].astype(jnp.float32) * freq  # [B,S,D/2]
+    angles = angles[:, :, None, :]  # [B,S,1,D/2]
+    sin, cos = jnp.sin(angles), jnp.cos(angles)
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + self.eps
+        )
+        return (normed * scale).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        cfg = self.cfg
+        h, d = cfg.num_heads, cfg.head_dim
+        dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
+            feats, axis=-1, use_bias=False, dtype=cfg.jdtype, name=name
+        )
+        q = dense("q", (h, d))(x)
+        k = dense("k", (h, d))(x)
+        v = dense("v", (h, d))(x)
+        q = rope(q, positions)
+        k = rope(k, positions)
+        out = attention(
+            q, k, v, impl=cfg.attention_impl, causal=True
+        )
+        return nn.DenseGeneral(
+            cfg.embed_dim,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.jdtype,
+            name="out",
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        wi = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.jdtype, name="wi")(x)
+        wg = nn.Dense(cfg.mlp_dim, use_bias=False, dtype=cfg.jdtype, name="wg")(x)
+        return nn.Dense(
+            cfg.embed_dim, use_bias=False, dtype=cfg.jdtype, name="wo"
+        )(nn.silu(wg) * wi)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(name="ln1")(x), positions
+        )
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
+        return x
+
+
+class Transformer(nn.Module):
+    """LM forward: ``tokens [B, S] int32 -> logits [B, S, vocab]``."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        emb = self.param(
+            "embedding",
+            nn.initializers.normal(stddev=0.02),
+            (cfg.vocab_size, cfg.embed_dim),
+        )
+        x = emb[tokens].astype(cfg.jdtype)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape
+        )
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=())
+        for i in range(cfg.num_layers):
+            x = block(cfg, name="block_%d" % i)(x, positions)
+        x = RMSNorm(name="ln_f")(x)
+        # tied output head would shard awkwardly under TP; a separate
+        # vocab projection keeps the ``vocab`` logical axis clean
+        logits = nn.Dense(
+            cfg.vocab_size, use_bias=False, dtype=cfg.jdtype, name="lm_head"
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+#: path-regex → logical axes (see models/base.annotate)
+LOGICAL_AXES_RULES = (
+    (r"embedding$", ("vocab", "embed")),
+    (r"attn/(q|k|v)/kernel", ("embed", "heads", None)),
+    (r"attn/out/kernel", ("heads", None, "embed")),
+    (r"mlp/(wi|wg)/kernel", ("embed", "mlp")),
+    (r"mlp/wo/kernel", ("mlp", "embed")),
+    (r"lm_head/kernel", ("embed", "vocab")),
+    (r"(ln1|ln2|ln_f)/scale", None),
+)
+
+
+def logical_axes(params):
+    return base.annotate(params, LOGICAL_AXES_RULES)
+
+
+def loss_fn(model):
+    """Next-token cross-entropy; batch = dict(tokens=[B,S])."""
+
+    def _loss(params, batch, rng):
+        tokens = batch["tokens"]
+        logits = model.apply({"params": params}, tokens)
+        targets = tokens[:, 1:]
+        logits = logits[:, :-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return _loss

@@ -1,0 +1,118 @@
+"""UNet for image segmentation — the reference's segmentation workload
+(reference: examples/segmentation/segmentation_spark.py:30-80 builds a
+MobileNetV2-encoder + pix2pix-upsample UNet over 128×128×3 → 3 classes).
+
+Fresh flax implementation with the same contract (128×128×3 input,
+per-pixel class logits): a depthwise-separable conv encoder (the
+MobileNet building block) with skip connections and transpose-conv
+decoder.  NHWC, bfloat16 compute, f32 norms — same TPU conventions as
+:mod:`tensorflowonspark_tpu.models.resnet`.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.models import base
+
+
+class SepConv(nn.Module):
+    """Depthwise-separable conv + group-norm + relu6."""
+
+    filters: int
+    strides: int = 1
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch, (3, 3), strides=(self.strides, self.strides),
+            padding="SAME", feature_group_count=in_ch, use_bias=False,
+            dtype=jnp.dtype(self.dtype), name="dw",
+        )(x)
+        x = nn.Conv(
+            self.filters, (1, 1), use_bias=False,
+            dtype=jnp.dtype(self.dtype), name="pw",
+        )(x)
+        x = nn.GroupNorm(num_groups=min(8, self.filters), dtype=jnp.float32)(x)
+        return jnp.minimum(nn.relu(x), 6.0).astype(jnp.dtype(self.dtype))
+
+
+class UpBlock(nn.Module):
+    """Transpose-conv ×2 upsample (the pix2pix upsample equivalent)."""
+
+    filters: int
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, skip=None):
+        x = nn.ConvTranspose(
+            self.filters, (4, 4), strides=(2, 2), padding="SAME",
+            use_bias=False, dtype=jnp.dtype(self.dtype), name="up",
+        )(x)
+        x = nn.GroupNorm(num_groups=min(8, self.filters), dtype=jnp.float32)(x)
+        x = nn.relu(x).astype(jnp.dtype(self.dtype))
+        if skip is not None:
+            x = jnp.concatenate([x, skip.astype(x.dtype)], axis=-1)
+        return x
+
+
+class UNet(nn.Module):
+    """``[B, 128, 128, 3] -> [B, 128, 128, num_classes]`` logits."""
+
+    num_classes: int = 3
+    base_filters: int = 32
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        f = self.base_filters
+        x = x.astype(jnp.dtype(self.dtype))
+        # encoder: 128 -> 64 -> 32 -> 16 -> 8 -> 4, collecting skips
+        skips = []
+        x = nn.Conv(
+            f, (3, 3), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=jnp.dtype(self.dtype), name="stem",
+        )(x)  # 64
+        for i, filters in enumerate((f * 2, f * 4, f * 8, f * 8)):
+            skips.append(x)
+            x = SepConv(filters, strides=2, dtype=self.dtype, name="down%d" % i)(x)
+        # decoder with skip connections: 4 -> 8 -> 16 -> 32 -> 64
+        for i, filters in enumerate((f * 8, f * 4, f * 2, f)):
+            x = UpBlock(filters, dtype=self.dtype, name="up%d" % i)(
+                x, skips[-(i + 1)]
+            )
+        # final ×2 to full resolution, then per-pixel classifier
+        x = nn.ConvTranspose(
+            f, (4, 4), strides=(2, 2), padding="SAME",
+            dtype=jnp.dtype(self.dtype), name="final_up",
+        )(x)  # 128
+        return nn.Conv(
+            self.num_classes, (1, 1), dtype=jnp.float32, name="classifier"
+        )(x.astype(jnp.float32))
+
+
+def logical_axes(params):
+    return base.annotate(params, ())
+
+
+def loss_fn(model):
+    """Sparse per-pixel cross-entropy; batch = (image, mask[B,H,W])."""
+    import jax
+
+    def _loss(params, batch, rng):
+        if isinstance(batch, dict):
+            images, masks = batch["image"], batch["mask"]
+        else:
+            images, masks = batch
+        logits = model.apply({"params": params}, images, train=True)
+        logp = jax.nn.log_softmax(logits)
+        nll = -jnp.take_along_axis(
+            logp, masks.astype(jnp.int32)[..., None], axis=-1
+        )[..., 0]
+        acc = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == masks).astype(jnp.float32)
+        )
+        return jnp.mean(nll), {"accuracy": acc}
+
+    return _loss

@@ -1,0 +1,13 @@
+"""TPU compute ops: attention implementations and pallas kernels.
+
+The reference had no kernels of its own (all compute delegated to
+TensorFlow, SURVEY.md §2 'Native-code reality check'); this package is
+new TPU-first capability:
+
+- :mod:`.attention` — dispatcher over attention implementations;
+- :mod:`.flash_attention` — blockwise pallas TPU kernel;
+- :mod:`.ring_attention` — sequence-parallel ring attention (ppermute);
+- :mod:`.ulysses` — all-to-all head/sequence re-sharding attention.
+"""
+
+from tensorflowonspark_tpu.ops.attention import attention, dot_attention  # noqa: F401

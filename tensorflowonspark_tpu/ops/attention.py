@@ -1,0 +1,90 @@
+"""Attention dispatcher.
+
+One entry point, four implementations (SURVEY.md §2.3 build targets —
+the reference has none of these, grep-verified SURVEY.md §5):
+
+- ``dot``     — plain XLA einsum attention (always available; the
+                numerics reference for every other impl's tests);
+- ``flash``   — blockwise pallas TPU kernel, O(seq) memory
+                (:mod:`tensorflowonspark_tpu.ops.flash_attention`);
+- ``ring``    — sequence-parallel ring attention over the ``seq`` mesh
+                axis (:mod:`tensorflowonspark_tpu.ops.ring_attention`);
+- ``ulysses`` — all-to-all sequence↔head re-sharding
+                (:mod:`tensorflowonspark_tpu.ops.ulysses`).
+
+Shapes follow the ``[batch, seq, heads, head_dim]`` convention
+throughout (the TPU-friendly layout: heads*head_dim contiguous for the
+MXU, seq shardable for context parallelism).
+"""
+
+import jax
+import jax.numpy as jnp
+
+_IMPLS = ("dot", "flash", "ring", "ulysses")
+
+
+def dot_attention(q, k, v, causal=True, scale=None, mask=None):
+    """Plain softmax attention via XLA einsums.
+
+    Args:
+      q: ``[B, Sq, H, D]``; k, v: ``[B, Sk, H, D]``.
+      causal: apply a causal mask (positions aligned at the end).
+      mask: optional additive mask broadcastable to ``[B, H, Sq, Sk]``.
+    Returns ``[B, Sq, H, D]`` in ``q.dtype``.
+    """
+    orig_dtype = q.dtype
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    # accumulate logits/softmax in f32 for stability (bf16 inputs stay
+    # bf16 through the matmuls — MXU native — but the reduction is f32)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        # queries occupy the LAST sq positions of the key timeline, which
+        # makes the same mask correct for full self-attention (sq == sk)
+        # and decode steps (sq == 1)
+        qpos = jnp.arange(sq)[:, None] + (sk - sq)
+        kpos = jnp.arange(sk)[None, :]
+        logits = jnp.where(qpos >= kpos, logits, -jnp.inf)
+    if mask is not None:
+        logits = logits + mask
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(orig_dtype)
+
+
+def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
+              seq_axis="seq", block_q=512, block_k=512):
+    """Dispatch to an attention implementation (see module docstring).
+
+    ``ring``/``ulysses`` require a mesh with a ``seq`` axis and inputs
+    already sharded on it; they are meant to be called from inside
+    ``shard_map``-decorated or jit-with-sharding code.  ``flash`` falls
+    back to ``dot`` off-TPU so the same model runs in CPU tests.
+    """
+    if impl not in _IMPLS:
+        raise ValueError("unknown attention impl {0!r}; one of {1}".format(impl, _IMPLS))
+    if impl == "flash":
+        from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+        )
+    if impl == "ring":
+        from tensorflowonspark_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(
+            q, k, v, causal=causal, scale=scale, axis_name=seq_axis
+        )
+    if impl == "ulysses":
+        from tensorflowonspark_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(
+            q, k, v, causal=causal, scale=scale, axis_name=seq_axis
+        )
+    return dot_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,35 @@
+"""TPU-native parallelism core (SURVEY.md §7 step 5).
+
+The reference delegated every parallelism strategy to TensorFlow
+(MultiWorkerMirroredStrategy / ParameterServerStrategy constructed in user
+code, reference: tensorflowonspark/TFSparkNode.py:354-362); this package
+owns them natively as mesh programs:
+
+- :mod:`.mesh` — device mesh construction over ICI/DCN axes;
+- :mod:`.sharding` — logical-axis sharding rules → ``PartitionSpec``;
+- :mod:`.dp` — synchronous data parallelism (the MWMS equivalent) with a
+  principled global-stop for uneven feeds;
+- :mod:`.tp` — tensor parallelism (sharded matmuls);
+- :mod:`.pp` — pipeline parallelism (stage mesh + microbatch loop);
+- :mod:`.cp` — sequence/context parallelism (ring attention, Ulysses);
+- :mod:`.ep` — expert parallelism (MoE all-to-all);
+- :mod:`.ps` — asynchronous parameter-server emulation.
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+    MeshSpec,
+    build_mesh,
+)
+from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
+    apply_rules,
+    batch_sharding,
+    replicated,
+    shard_batch,
+    shard_params,
+)
