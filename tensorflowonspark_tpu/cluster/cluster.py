@@ -347,6 +347,13 @@ def run(
 
     if num_executors is None:
         num_executors = engine.num_executors
+    if num_executors > engine.num_executors:
+        raise ValueError(
+            "num_executors ({0}) exceeds the engine's executor count "
+            "({1}); the startup barrier would wait forever".format(
+                num_executors, engine.num_executors
+            )
+        )
 
     # validate cluster composition (reference: TFCluster.py:246-253)
     num_special = num_ps + (1 if master_node else 0) + (1 if eval_node else 0)

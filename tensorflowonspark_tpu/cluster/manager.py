@@ -116,23 +116,20 @@ def connect(address, authkey):
     return m
 
 
-def qsize_safe(q):
-    """``qsize()`` that tolerates platforms where it raises
-    ``NotImplementedError`` (macOS)."""
-    try:
-        return q.qsize()
-    except NotImplementedError:
-        return -1
-
-
-def drain(q):
+def drain(q, timeout=0):
     """Discard everything currently in a queue, marking each item done so
     ``join()`` callers are released (reference: TFNode.py:316-329
-    terminate-side drain)."""
+    terminate-side drain).
+
+    Args:
+      timeout: seconds to keep blocking for in-flight puts before
+        declaring the queue dry (``DataFeed.terminate`` uses 5 so racing
+        feeder tasks drain too; 0 = non-blocking sweep).
+    """
     count = 0
     while True:
         try:
-            q.get(block=False)
+            q.get(block=timeout > 0, timeout=timeout or None)
             q.task_done()
             count += 1
         except _queue_mod.Empty:

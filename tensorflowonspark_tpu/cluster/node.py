@@ -209,9 +209,10 @@ def _read_manager_info(workdir):
         return json.load(f)
 
 
-def _get_manager(cluster_info, host, executor_id):
+def _get_manager(cluster_info, executor_id):
     """Reconnect to the manager of the node hosting ``executor_id``
-    (reference: TFSparkNode.py:97-123)."""
+    (reference: TFSparkNode.py:97-123; lookup is by executor id — the
+    advertised manager address already encodes the host)."""
     for node in cluster_info:
         if node["executor_id"] == executor_id:
             addr = tuple(node["addr"])
@@ -536,7 +537,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     (reference: TFSparkNode.py:436-503)."""
 
     def _train(iterator):
-        mgr = _get_manager(cluster_info, get_ip_address(), _local_executor_id())
+        mgr = _get_manager(cluster_info, _local_executor_id())
         state = str(mgr.get("state")._getvalue())
         logger.info("connected to node manager, state=%s", state)
         terminating = state == "terminating"
@@ -584,7 +585,7 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     exactly as many results (reference: TFSparkNode.py:506-565)."""
 
     def _inference(iterator):
-        mgr = _get_manager(cluster_info, get_ip_address(), _local_executor_id())
+        mgr = _get_manager(cluster_info, _local_executor_id())
         queue_in = mgr.get_queue(qname)
         count = 0
         for item in iterator:
@@ -614,57 +615,10 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     return _inference
 
 
-def shutdown(cluster_info, queues, cluster_meta, grace_secs=0):
-    """Build the worker-shutdown map function (reference:
-    TFSparkNode.py:570-622)."""
-
-    def _shutdown(iterator):
-        host = get_ip_address()
-        executor_id = _local_executor_id()
-        mgr = _get_manager(cluster_info, host, executor_id)
-
-        # stop tensorboard if this node launched one
-        # (reference: TFSparkNode.py:587-593)
-        for node in cluster_info:
-            if node["executor_id"] == executor_id and node.get("tb_pid"):
-                import signal
-
-                try:
-                    os.kill(node["tb_pid"], signal.SIGTERM)
-                except OSError:
-                    pass
-
-        # end-of-feed sentinel on each data queue
-        # (reference: TFSparkNode.py:595-605)
-        for qname in queues:
-            try:
-                mgr.get_queue(qname).put(None, block=True)
-            except Exception:  # noqa: BLE001 - queue may not exist on this role
-                logger.debug("no queue %s on executor %d", qname, executor_id)
-
-        if grace_secs > 0:
-            # let the compute process finish consuming + exporting
-            # (reference: TFSparkNode.py:607-610)
-            time.sleep(grace_secs)
-
-        # peek-and-requeue the error queue so engine-level task retries
-        # still observe the failure (reference: TFSparkNode.py:612-618)
-        try:
-            error = mgr.get_queue("error").get(block=False)
-            mgr.get_queue("error").task_done()
-            mgr.get_queue("error").put(error)
-            raise RuntimeError(
-                "compute process on executor {0} failed:\n{1}".format(
-                    executor_id, error
-                )
-            )
-        except _queue_mod.Empty:
-            pass
-
-        mgr.set("state", "stopped")
-        return []
-
-    return _shutdown
+# NOTE: the reference had a per-executor shutdown map function
+# (TFSparkNode.py:570-622); this build's shutdown is driver-direct —
+# every node manager is reachable over TCP, so TPUCluster.shutdown posts
+# the sentinels and peeks the error queues itself (cluster.py).
 
 
 def _check_error_queue(mgr):

@@ -2,10 +2,19 @@
 
 Re-designed from the reference's ``TFParallel.py`` (reference:
 tensorflowonspark/TFParallel.py:17-64), which used Spark barrier
-execution to run one *independent* (non-communicating) instance per
-executor — the parallel batch-inference pattern.  Each instance gets a
-bare :class:`~tensorflowonspark_tpu.cluster.node.NodeContext` with no
-cluster spec and runs the user function in the foreground.
+execution (``nodeRDD.barrier().mapPartitions``, TFParallel.py:62-63) to
+pin one *independent* (non-communicating) instance per executor — the
+parallel batch-inference pattern.  Each instance gets a bare
+:class:`~tensorflowonspark_tpu.cluster.node.NodeContext` with no cluster
+spec and runs the user function in the foreground.
+
+The barrier here is a rendezvous round: every instance registers with a
+reservation server and blocks until all N are present before running the
+user fn.  Because each instance task occupies its executor for the whole
+barrier, N simultaneous registrations force N distinct executors — the
+same one-instance-per-executor guarantee Spark barrier mode gave the
+reference, and the property that makes per-instance chip windows
+(``num_chips_per_node``) collision-free.
 """
 
 import logging
@@ -15,12 +24,20 @@ from tensorflowonspark_tpu.cluster.node import NodeContext
 logger = logging.getLogger(__name__)
 
 
-def run(engine, map_fun, args=None, num_executors=None, num_chips_per_node=None):
+def run(
+    engine,
+    map_fun,
+    args=None,
+    num_executors=None,
+    num_chips_per_node=None,
+    barrier_timeout=600,
+):
     """Run ``map_fun(args, ctx)`` as N independent single-node instances
     (reference: TFParallel.py:17-63).
 
     Returns the per-instance results collected from all executors.
     """
+    from tensorflowonspark_tpu.cluster import reservation
     from tensorflowonspark_tpu.engine import Engine, LocalEngine, SparkEngine
 
     owns_engine = False
@@ -31,8 +48,17 @@ def run(engine, map_fun, args=None, num_executors=None, num_chips_per_node=None)
         engine = SparkEngine(engine)
     if num_executors is None:
         num_executors = engine.num_executors
+    if num_executors > engine.num_executors:
+        raise ValueError(
+            "num_executors ({0}) exceeds the engine's executor count "
+            "({1}); the barrier would never release".format(
+                num_executors, engine.num_executors
+            )
+        )
 
     default_fs = engine.default_fs
+    server = reservation.Server(num_executors)
+    server_addr = server.start()
 
     def _mapfn(iterator):
         import os
@@ -44,6 +70,12 @@ def run(engine, map_fun, args=None, num_executors=None, num_chips_per_node=None)
         for item in iterator:
             executor_id = item
         assert executor_id is not None
+        # barrier: all instances must be running concurrently (on N
+        # distinct executors) before any proceeds
+        client = reservation.Client(server_addr)
+        client.register({"executor_id": executor_id})
+        client.await_reservations(timeout=barrier_timeout)
+        client.close()
         # chip allocation for co-located instances (reference:
         # TFParallel.py:38-48 barrier placement + GPU alloc).  NOTE:
         # executor_id is only a correct host-local rank on single-host
@@ -70,5 +102,6 @@ def run(engine, map_fun, args=None, num_executors=None, num_chips_per_node=None)
             _mapfn, [[i] for i in range(num_executors)], collect=True
         )
     finally:
+        server.stop()
         if owns_engine:
             engine.stop()

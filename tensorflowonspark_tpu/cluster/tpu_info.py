@@ -95,14 +95,15 @@ def get_chips(num_chips, worker_index=-1, total_chips=None):
     if worker_index < 0:
         start = 0
     else:
-        start = (worker_index * num_chips) % total_chips
+        # No modulo wrap: a wrapped window would silently alias another
+        # worker's chips, and two JAX runtimes contending for a chip is
+        # fatal — oversubscription must fail loudly.
+        start = worker_index * num_chips
         if start + num_chips > total_chips:
-            # A wrapped window would collide with another worker's chips;
-            # two JAX runtimes contending for a chip is fatal — fail loudly.
             raise RuntimeError(
-                "worker {0} needs {1} chips but the host window wraps "
-                "(total {2}); use fewer chips per worker or fewer workers "
-                "per host".format(worker_index, num_chips, total_chips)
+                "worker {0} needs chips [{1},{2}) but the host has only "
+                "{3}; use fewer chips per worker or fewer workers per "
+                "host".format(worker_index, start, start + num_chips, total_chips)
             )
     return list(range(start, start + num_chips))
 

@@ -25,7 +25,6 @@ TPU-native additions (no reference analogue — SURVEY.md §7 step 3):
 """
 
 import logging
-import queue as _queue_mod
 
 import numpy as np
 
@@ -113,16 +112,9 @@ class DataFeed(object):
         logger.info("terminate() invoked")
         self.mgr.set("state", "terminating")
 
-        queue_in = self.mgr.get_queue(self.qname_in)
-        count = 0
-        done = False
-        while not done:
-            try:
-                queue_in.get(block=True, timeout=5)
-                queue_in.task_done()
-                count += 1
-            except _queue_mod.Empty:
-                done = True
+        from tensorflowonspark_tpu.cluster import manager
+
+        count = manager.drain(self.mgr.get_queue(self.qname_in), timeout=5)
         logger.info("terminate() drained %d items from input queue", count)
 
     # ------------------------------------------------------------------

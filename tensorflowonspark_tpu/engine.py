@@ -129,9 +129,13 @@ class Engine(object):
 # ----------------------------------------------------------------------
 
 
-def _executor_main(executor_idx, workdir, task_queue, result_queue, env_overrides):
+def _executor_main(
+    executor_idx, workdir, task_queue, result_queue, env_overrides, cancelled
+):
     """Executor process main loop: pull (job_id, task_id, payload) off the
-    shared task queue, run it, report (job_id, task_id, ok, payload)."""
+    shared task queue, run it, report (job_id, task_id, ok, payload).
+    Tasks of a job listed in ``cancelled`` are skipped without side
+    effects (their job's waiter already raised)."""
     os.environ[TFOS_EXECUTOR_WORKDIR] = workdir
     os.environ.update(env_overrides or {})
     os.chdir(workdir)
@@ -148,6 +152,12 @@ def _executor_main(executor_idx, workdir, task_queue, result_queue, env_override
         if item is None:
             break
         job_id, task_id, fn_bytes, part_bytes = item
+        if job_id in cancelled:
+            # A failed job's leftover tasks must not execute: their side
+            # effects (queue puts into node managers) would corrupt the
+            # data plane for subsequent jobs.
+            result_queue.put((job_id, task_id, True, _pickle.dumps([])))
+            continue
         try:
             fn = _pickle.loads(fn_bytes)
             partition = _pickle.loads(part_bytes)
@@ -168,6 +178,10 @@ class LocalEngine(Engine):
         self._ctx = multiprocessing.get_context(start_method)
         self._task_queue = self._ctx.Queue()
         self._result_queue = self._ctx.Queue()
+        # shared cancelled-job registry (see _executor_main); a Manager
+        # dict so executor processes observe cancellations immediately
+        self._mp_manager = self._ctx.Manager()
+        self._cancelled = self._mp_manager.dict()
         self._job_counter = 0
         self._active_jobs = 0
         self._lock = threading.Lock()
@@ -189,7 +203,14 @@ class LocalEngine(Engine):
             # compute processes); cleanup is handled by stop()
             p = self._ctx.Process(
                 target=_executor_main,
-                args=(i, workdir, self._task_queue, self._result_queue, env or {}),
+                args=(
+                    i,
+                    workdir,
+                    self._task_queue,
+                    self._result_queue,
+                    env or {},
+                    self._cancelled,
+                ),
                 daemon=False,
                 name="executor-%d" % i,
             )
@@ -236,6 +257,12 @@ class LocalEngine(Engine):
             while remaining:
                 _, task_id, ok, payload = my_queue.get()
                 if not ok:
+                    # cancel the job's still-queued tasks so their side
+                    # effects never happen (executors skip them)
+                    try:
+                        self._cancelled[job_id] = True
+                    except (OSError, EOFError):  # manager already down
+                        pass
                     raise RuntimeError(
                         "task {0} of job {1} failed:\n{2}".format(
                             task_id, job_id, payload
@@ -269,6 +296,10 @@ class LocalEngine(Engine):
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+        try:
+            self._mp_manager.shutdown()
+        except Exception:  # noqa: BLE001 - already down
+            pass
         # reap each executor's process group (managers, compute children)
         import signal
 

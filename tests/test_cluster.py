@@ -156,3 +156,50 @@ def test_parallel_run(engine):
 
     results = parallel_run.run(engine, _parallel_fn, args={}, num_executors=2)
     assert sorted(results) == [0, 10]
+
+
+def test_parallel_run_oversubscription_fails_fast(engine):
+    from tensorflowonspark_tpu.cluster import parallel_run
+
+    with pytest.raises(ValueError, match="exceeds the engine"):
+        parallel_run.run(engine, _parallel_fn, args={}, num_executors=4)
+
+
+def test_run_oversubscription_fails_fast(engine):
+    # more nodes than executors must raise immediately, not hang at the
+    # startup barrier until reservation_timeout
+    with pytest.raises(ValueError, match="exceeds the engine"):
+        tpu_cluster.run(engine, _basic_fn, args={}, num_executors=4)
+
+
+def test_failed_job_cancels_queued_tasks(engine, tmp_path):
+    # a failed job's leftover tasks must not execute their side effects
+    # later (they would corrupt node input queues for subsequent jobs):
+    # queue 12 tasks on 2 executors where the first fails immediately
+    import time as _time
+
+    marker_dir = str(tmp_path)
+
+    def _fail_first(it):
+        import os
+        import time
+
+        items = list(it)
+        if items[0] == 0:
+            raise RuntimeError("boom")
+        time.sleep(0.2)  # give the cancellation time to land mid-job
+        open(os.path.join(marker_dir, "ran-%d" % items[0]), "w").close()
+        return []
+
+    with pytest.raises(RuntimeError, match="boom"):
+        engine.run_job(_fail_first, [[i] for i in range(12)], collect=True)
+    _time.sleep(2.0)  # any wrongly-surviving queued task would run here
+    import os
+
+    ran = len(os.listdir(marker_dir))
+    # in-flight tasks at cancellation time may legitimately complete
+    # (2 executors -> at most a couple), but the queued tail must not
+    assert ran <= 4, "cancelled job executed %d leftover tasks" % ran
+    # and the engine still schedules fresh jobs afterwards
+    results = engine.run_job(lambda it: ["ok"], [["x"]], collect=True)
+    assert results == ["ok"]
