@@ -340,6 +340,15 @@ def run(
 
     owns_engine = False
     if isinstance(engine, int):
+        # validate BEFORE constructing the engine: raising later would
+        # leak the executor processes we just spawned
+        if num_executors is not None and num_executors > engine:
+            raise ValueError(
+                "num_executors ({0}) exceeds the engine's executor count "
+                "({1}); the startup barrier would wait forever".format(
+                    num_executors, engine
+                )
+            )
         engine = LocalEngine(engine)
         owns_engine = True
     elif not isinstance(engine, Engine) and hasattr(engine, "parallelize"):
@@ -348,11 +357,23 @@ def run(
     if num_executors is None:
         num_executors = engine.num_executors
     if num_executors > engine.num_executors:
-        raise ValueError(
-            "num_executors ({0}) exceeds the engine's executor count "
-            "({1}); the startup barrier would wait forever".format(
+        # Only authoritative counts may hard-fail: Spark under dynamic
+        # allocation reports the spark.executor.instances *default*, not
+        # the real fleet (the reference never validated this at all —
+        # its reservation_timeout was the only guard, TFCluster.py:216).
+        msg = (
+            "num_executors ({0}) exceeds the engine's reported executor "
+            "count ({1}); the startup barrier would wait forever".format(
                 num_executors, engine.num_executors
             )
+        )
+        if engine.num_executors_exact:
+            raise ValueError(msg)
+        logger.warning(
+            "%s — proceeding anyway (count is not authoritative; the "
+            "reservation timeout of %ds is the backstop)",
+            msg,
+            reservation_timeout,
         )
 
     # validate cluster composition (reference: TFCluster.py:246-253)

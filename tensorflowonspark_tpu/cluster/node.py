@@ -20,12 +20,14 @@ once at cluster startup (reference: TFSparkNode.py:126-431); it
 7. launches the user's ``main_fun(args, ctx)`` in foreground or
    background (reference: TFSparkNode.py:375-431).
 
-The data-plane map functions (``train``/``inference``/``shutdown``)
-reconnect to the node's manager from whatever executor the feed task
-landed on (reference: TFSparkNode.py:97-123) and preserve the reference's
-error-containment contract: feeders poll the error queue each second,
-shutdown peeks-and-requeues so engine-level task retries still fail
-(reference: TFSparkNode.py:612-618).
+The data-plane map functions (``train``/``inference``) reconnect to the
+node's manager from whatever executor the feed task landed on (reference:
+TFSparkNode.py:97-123) and preserve the reference's error-containment
+contract: feeders poll the error queue each second and re-raise into the
+engine task so retries still fail (reference: TFSparkNode.py:612-618).
+Teardown is driver-direct — ``cluster.shutdown`` connects to each node
+manager over TCP to kill tensorboard, post end-of-feed sentinels, and
+check the error queue (no shutdown job on the executors).
 """
 
 import json

@@ -10,11 +10,12 @@ spec and runs the user function in the foreground.
 
 The barrier here is a rendezvous round: every instance registers with a
 reservation server and blocks until all N are present before running the
-user fn.  Because each instance task occupies its executor for the whole
-barrier, N simultaneous registrations force N distinct executors — the
-same one-instance-per-executor guarantee Spark barrier mode gave the
-reference, and the property that makes per-instance chip windows
-(``num_chips_per_node``) collision-free.
+user fn.  On one-task-slot-per-executor deployments (LocalEngine always;
+Spark with ``spark.executor.cores == spark.task.cpus``, the reference's
+assumed topology) each instance task occupies its executor for the whole
+barrier, so N simultaneous registrations land on N distinct executors and
+per-instance chip windows (``num_chips_per_node``) are collision-free.
+Multi-slot executors can co-locate instances; pin chips explicitly there.
 """
 
 import logging
@@ -42,6 +43,15 @@ def run(
 
     owns_engine = False
     if isinstance(engine, int):
+        # validate BEFORE constructing the engine: raising later would
+        # leak the executor processes we just spawned
+        if num_executors is not None and num_executors > engine:
+            raise ValueError(
+                "num_executors ({0}) exceeds the engine's executor count "
+                "({1}); the barrier would never release".format(
+                    num_executors, engine
+                )
+            )
         engine = LocalEngine(engine)
         owns_engine = True
     elif not isinstance(engine, Engine) and hasattr(engine, "parallelize"):
@@ -49,12 +59,19 @@ def run(
     if num_executors is None:
         num_executors = engine.num_executors
     if num_executors > engine.num_executors:
-        raise ValueError(
-            "num_executors ({0}) exceeds the engine's executor count "
-            "({1}); the barrier would never release".format(
+        msg = (
+            "num_executors ({0}) exceeds the engine's reported executor "
+            "count ({1}); the barrier would never release".format(
                 num_executors, engine.num_executors
             )
         )
+        if engine.num_executors_exact:
+            if owns_engine:
+                engine.stop()
+            raise ValueError(msg)
+        # Spark's count is not authoritative under dynamic allocation;
+        # barrier_timeout is the backstop
+        logger.warning("%s — proceeding anyway", msg)
 
     default_fs = engine.default_fs
     server = reservation.Server(num_executors)

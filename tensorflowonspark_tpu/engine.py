@@ -31,6 +31,7 @@ import os
 import queue as _queue_mod
 import tempfile
 import threading
+import time
 import traceback
 
 try:
@@ -80,6 +81,12 @@ class Engine(object):
     @property
     def num_executors(self):
         raise NotImplementedError
+
+    #: Whether :attr:`num_executors` is authoritative.  LocalEngine knows
+    #: exactly how many processes it spawned; SparkEngine only sees
+    #: ``spark.executor.instances``, which dynamic allocation leaves at
+    #: its default — callers must not hard-fail on an inexact count.
+    num_executors_exact = False
 
     @property
     def default_fs(self):
@@ -173,6 +180,8 @@ def _executor_main(
 class LocalEngine(Engine):
     """N executor processes on one host with Spark-like task scheduling."""
 
+    num_executors_exact = True
+
     def __init__(self, num_executors, env=None, start_method="spawn"):
         self._num_executors = num_executors
         self._ctx = multiprocessing.get_context(start_method)
@@ -245,6 +254,7 @@ class LocalEngine(Engine):
             self._job_counter += 1
             self._active_jobs += 1
             self._job_queues[job_id] = my_queue
+        deferred_cleanup = False
         try:
             fn_bytes = _pickle.dumps(mapfn)
             ntasks = len(partitions)
@@ -258,11 +268,16 @@ class LocalEngine(Engine):
                 _, task_id, ok, payload = my_queue.get()
                 if not ok:
                     # cancel the job's still-queued tasks so their side
-                    # effects never happen (executors skip them)
+                    # effects never happen (executors skip them and ack
+                    # with an empty result); a reaper thread waits for
+                    # those acks, then retires the cancelled-flag entry so
+                    # the registry can't grow for the engine's lifetime
                     try:
                         self._cancelled[job_id] = True
                     except (OSError, EOFError):  # manager already down
                         pass
+                    deferred_cleanup = True
+                    self._reap_cancelled(job_id, my_queue, remaining - 1)
                     raise RuntimeError(
                         "task {0} of job {1} failed:\n{2}".format(
                             task_id, job_id, payload
@@ -276,7 +291,35 @@ class LocalEngine(Engine):
         finally:
             with self._lock:
                 self._active_jobs -= 1
+                if not deferred_cleanup:
+                    self._job_queues.pop(job_id, None)
+
+    def _reap_cancelled(self, job_id, my_queue, remaining, deadline=60.0):
+        """After a job fails: consume the acks of its remaining tasks in
+        the background, then drop its result queue and cancelled-flag
+        entry.  Keeps failure propagation immediate while guaranteeing a
+        straggler task can never execute against a recycled flag."""
+
+        def _reap():
+            left = remaining
+            end = time.monotonic() + deadline
+            while left > 0:
+                try:
+                    my_queue.get(timeout=max(0.1, end - time.monotonic()))
+                    left -= 1
+                except _queue_mod.Empty:
+                    break  # executor wedged/killed; leave the flag in place
+            with self._lock:
                 self._job_queues.pop(job_id, None)
+            if left == 0:
+                try:
+                    self._cancelled.pop(job_id, None)
+                except (OSError, EOFError):
+                    pass
+
+        threading.Thread(
+            target=_reap, daemon=True, name="job-%d-reaper" % job_id
+        ).start()
 
     def num_active_jobs(self):
         with self._lock:

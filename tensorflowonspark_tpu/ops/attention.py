@@ -62,10 +62,14 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
               seq_axis="seq", block_q=512, block_k=512):
     """Dispatch to an attention implementation (see module docstring).
 
-    ``ring``/``ulysses`` require a mesh with a ``seq`` axis and inputs
-    already sharded on it; they are meant to be called from inside
-    ``shard_map``-decorated or jit-with-sharding code.  ``flash`` falls
-    back to ``dot`` off-TPU so the same model runs in CPU tests.
+    ``ring``/``ulysses`` dispatch on ``mesh``: with ``mesh=None`` the
+    inputs must be local shards and the call must already be inside
+    ``shard_map``-decorated code where ``seq_axis`` is bound; with a mesh
+    given, the inputs are *global* arrays and the op wraps itself in a
+    ``shard_map`` over the mesh's ``seq`` axis (do NOT pass a mesh from
+    code that is itself under ``shard_map``).  ``flash`` runs the pallas
+    kernels in interpret mode off-TPU so the same model runs in CPU
+    tests.
     """
     if impl not in _IMPLS:
         raise ValueError("unknown attention impl {0!r}; one of {1}".format(impl, _IMPLS))
@@ -76,14 +80,28 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
             q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
         )
     if impl == "ring":
-        from tensorflowonspark_tpu.ops.ring_attention import ring_attention
+        from tensorflowonspark_tpu.ops.ring_attention import (
+            ring_attention,
+            ring_attention_sharded,
+        )
 
+        if mesh is not None:
+            return ring_attention_sharded(
+                q, k, v, mesh, causal=causal, scale=scale, axis_name=seq_axis
+            )
         return ring_attention(
             q, k, v, causal=causal, scale=scale, axis_name=seq_axis
         )
     if impl == "ulysses":
-        from tensorflowonspark_tpu.ops.ulysses import ulysses_attention
+        from tensorflowonspark_tpu.ops.ulysses import (
+            ulysses_attention,
+            ulysses_attention_sharded,
+        )
 
+        if mesh is not None:
+            return ulysses_attention_sharded(
+                q, k, v, mesh, causal=causal, scale=scale, axis_name=seq_axis
+            )
         return ulysses_attention(
             q, k, v, causal=causal, scale=scale, axis_name=seq_axis
         )

@@ -1,0 +1,330 @@
+"""Blockwise (flash) attention as a pallas TPU kernel.
+
+New TPU-first capability with no reference analogue (the reference
+delegated all compute to TensorFlow, SURVEY.md §2 'Native-code reality
+check'; long-context support is absent there, SURVEY.md §5).  This is
+the single-chip building block that :mod:`.ring_attention` composes into
+sequence parallelism.
+
+Algorithm: FlashAttention-2-style online softmax.  The forward kernel
+streams key/value blocks through VMEM against a resident query block,
+keeping a running max ``m``, normalizer ``l``, and accumulator — O(seq)
+memory instead of the O(seq²) logits matrix.  The backward pass is two
+more pallas kernels (dq, and dk/dv) that recompute probabilities from
+the saved log-sum-exp rather than storing them.
+
+TPU mapping:
+- grid = (batch, heads, q-blocks); the q/k/v matmuls hit the MXU with
+  ``preferred_element_type=f32`` (bf16 operands stay MXU-native);
+- block sizes default to 512×512 — multiples of the (8,128) f32 /
+  (16,128) bf16 tile shapes;
+- off-TPU (CPU tests) the same kernels run under ``interpret=True`` so
+  numerics are verified against :func:`..attention.dot_attention`
+  without TPU hardware (mirrors the reference's shrink-don't-mock test
+  stance, SURVEY.md §4).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30  # finite mask sentinel: keeps exp() at 0 without NaNs
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
+
+    m = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros(q.shape, jnp.float32)
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # last k block the diagonal touches for this q block
+        upper = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        upper = jnp.minimum(upper, num_k_blocks)
+    else:
+        upper = num_k_blocks
+
+    def body(kj, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [block_q, block_k]
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l_safe)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, seq_len):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]  # [block_q]
+    delta = delta_ref[0, 0]  # [block_q]
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        upper = jnp.minimum(
+            jax.lax.div((qi + 1) * block_q + block_k - 1, block_k),
+            num_k_blocks,
+        )
+    else:
+        upper = num_k_blocks
+
+    def body(kj, dq):
+        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros(q.shape, jnp.float32)
+    )
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len):
+    kj = pl.program_id(2)
+    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
+    v = v_ref[0, 0].astype(jnp.float32)
+
+    num_q_blocks = seq_len // block_q
+    if causal:
+        # first q block the diagonal touches for this k block
+        lower = jax.lax.div(kj * block_k, block_q)
+    else:
+        lower = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            kpos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(
+        lower, num_q_blocks, body,
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+    )
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _block_sizes(seq_len, block_q, block_k):
+    bq, bk = min(block_q, seq_len), min(block_k, seq_len)
+    if seq_len % bq or seq_len % bk:
+        raise ValueError(
+            "flash attention needs seq_len {0} divisible by block sizes "
+            "({1}, {2}); pad the sequence or pass block_q/block_k".format(
+                seq_len, bq, bk
+            )
+        )
+    return bq, bk
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    b, s, h, d = q.shape
+    bq, bk = _block_sizes(s, block_q, block_k)
+    # [B,S,H,D] -> [B,H,S,D]: heads become a grid dim, seq stays blocked
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    grid = (b, h, s // bq)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, seq_len=s,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2), (q, k, v, jnp.swapaxes(out, 1, 2), lse)
+
+
+def _bwd(scale, causal, block_q, block_k, residuals, dout):
+    q, k, v, out, lse = residuals
+    b, s, h, d = q.shape
+    bq, bk = _block_sizes(s, block_q, block_k)
+    qt, kt, vt, ot, dot_ = (
+        jnp.swapaxes(x, 1, 2) for x in (q, k, v, out, dout)
+    )
+    # delta_i = rowsum(dout * out): the softmax-jacobian correction term
+    delta = jnp.sum(
+        dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
+    )  # [B,H,S]
+
+    dq_kernel = functools.partial(
+        _dq_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, seq_len=s,
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, s // bq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        interpret=_interpret(),
+    )(qt, kt, vt, dot_, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _dkv_kernel, scale=scale, causal=causal,
+        block_q=bq, block_k=bk, seq_len=s,
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, s // bk),
+        in_specs=[
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, kj: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, s), lambda bi, hi, kj: (bi, hi, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(qt, kt, vt, dot_, lse, delta)
+
+    return (
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
+    )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
+    return _fwd(q, k, v, scale, causal, block_q, block_k)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_q=512,
+                    block_k=512):
+    """Flash attention on ``[B, S, H, D]`` tensors (self-attention:
+    q/k/v share the sequence length).
+
+    Differentiable via custom pallas backward kernels.  ``seq_len`` must
+    divide by the (clamped) block sizes — pad upstream if not.
+    """
+    if q.shape != k.shape or k.shape != v.shape:
+        raise ValueError(
+            "flash attention is self-attention-shaped: q/k/v must match, "
+            "got {0} {1} {2}".format(q.shape, k.shape, v.shape)
+        )
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash(q, k, v, float(scale), bool(causal), block_q, block_k)

@@ -1,0 +1,93 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism via all-to-all.
+
+New TPU-first capability with no reference analogue (SURVEY.md §5
+'Long-context / sequence parallelism: absent').
+
+Idea: attention is independent across *heads* but global across
+*sequence*.  So flip the sharding just around the attention op:
+
+    [B, S/P, H, D]  --all_to_all-->  [B, S, H/P, D]   (heads sharded)
+          attend over the full sequence locally
+    [B, S, H/P, D]  --all_to_all-->  [B, S/P, H, D]   (seq sharded)
+
+Two all-to-alls per layer ride the ICI all-to-all bandwidth (cheaper
+than a full ring when H >= P); the local attention uses the flash
+kernel on TPU, so the composition is "Ulysses outside, flash inside".
+
+Requires ``num_heads % axis_size == 0``; otherwise use
+:mod:`.ring_attention` (which has no head-count constraint).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from tensorflowonspark_tpu.ops.attention import dot_attention
+
+
+def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
+                      local_impl="dot", block_q=512, block_k=512):
+    """Attention over sequence shards; call under ``shard_map``.
+
+    Args:
+      q, k, v: local shards ``[B, S_local, H, D]``.
+      local_impl: attention used on the re-sharded full sequence:
+        ``"dot"`` (XLA) or ``"flash"`` (pallas kernel).
+    Returns the local ``[B, S_local, H, D]`` output shard.
+    """
+    p = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % p != 0:
+        raise ValueError(
+            "ulysses needs heads ({0}) divisible by the seq axis size "
+            "({1}); use ring attention instead".format(h, p)
+        )
+
+    def seq_to_heads(x):
+        # [B, S/P, H, D] -> [B, S, H/P, D]
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if local_impl == "flash":
+        from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
+    else:
+        out = dot_attention(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+def ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=None,
+                              axis_name="seq", local_impl="dot"):
+    """Global-array entry point: shard_map wrapper usable inside jit
+    (sequence dim sharded on ``axis_name``, batch on the data axes)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    batch_axes = tuple(
+        a for a in ("data", "fsdp") if mesh.shape.get(a, 1) > 1
+    ) or None
+    spec = P(batch_axes, axis_name, None, None)
+
+    def _local(ql, kl, vl):
+        return ulysses_attention(
+            ql, kl, vl, causal=causal, scale=scale, axis_name=axis_name,
+            local_impl=local_impl,
+        )
+
+    return jax.shard_map(
+        _local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

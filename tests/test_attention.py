@@ -1,0 +1,172 @@
+"""Numerics tests: every attention impl against the dot reference.
+
+Mirrors the reference's shrink-don't-mock strategy (SURVEY.md §4): tiny
+shapes, real kernels — pallas in interpret mode, ring/ulysses on the
+virtual 8-CPU-device mesh from conftest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.ops.attention import attention, dot_attention
+from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+from tensorflowonspark_tpu.ops.ring_attention import ring_attention_sharded
+from tensorflowonspark_tpu.ops.ulysses import ulysses_attention_sharded
+from tensorflowonspark_tpu.parallel.mesh import build_mesh
+
+
+def _qkv(b=1, s=128, h=2, d=32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(  # noqa: E731
+        rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    )
+    return mk(), mk(), mk()
+
+
+def _grads(fn, q, k, v):
+    def loss(q, k, v):
+        return jnp.sum(jnp.sin(fn(q, k, v)))
+
+    return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+
+class TestDotAttention:
+    def test_matches_naive_softmax(self):
+        q, k, v = _qkv(s=16)
+        out = dot_attention(q, k, v, causal=False)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (32 ** -0.5)
+        ref = jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), v
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+
+    def test_causal_masks_future(self):
+        q, k, v = _qkv(s=16)
+        out = dot_attention(q, k, v, causal=True)
+        # first position attends only to itself -> output == v[0]
+        np.testing.assert_allclose(out[:, 0], v[:, 0], atol=1e-5)
+
+    def test_decode_step_alignment(self):
+        # sq=1 against sk=16 must equal the last row of full attention
+        q, k, v = _qkv(s=16)
+        full = dot_attention(q, k, v, causal=True)
+        step = dot_attention(q[:, -1:], k, v, causal=True)
+        np.testing.assert_allclose(step[:, 0], full[:, -1], atol=1e-5)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_dot(self, causal):
+        q, k, v = _qkv(s=128)
+        ref = dot_attention(q, k, v, causal=causal)
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_uneven_blocks_clamp_to_seq(self):
+        q, k, v = _qkv(s=64)
+        out = flash_attention(q, k, v, causal=True)  # blocks clamp 512->64
+        ref = dot_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_dot(self, causal):
+        q, k, v = _qkv(s=64)
+        ref = _grads(
+            lambda q, k, v: dot_attention(q, k, v, causal=causal), q, k, v
+        )
+        got = _grads(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, block_q=32, block_k=32
+            ),
+            q, k, v,
+        )
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r, atol=5e-3, rtol=5e-3)
+
+    def test_rejects_indivisible_seq(self):
+        q, k, v = _qkv(s=48)
+        with pytest.raises(ValueError, match="divisible"):
+            flash_attention(q, k, v, block_q=32, block_k=32)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dot(self, causal):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=64, h=2, d=16)
+        ref = dot_attention(q, k, v, causal=causal)
+        out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_gradients_match_dot(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=32, h=2, d=16)
+        ref = _grads(
+            lambda q, k, v: dot_attention(q, k, v, causal=True), q, k, v
+        )
+        got = _grads(
+            lambda q, k, v: ring_attention_sharded(
+                q, k, v, mesh, causal=True
+            ),
+            q, k, v,
+        )
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r, atol=1e-4, rtol=1e-4)
+
+    def test_under_jit(self):
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv(s=64, h=2, d=16)
+        fn = jax.jit(
+            lambda q, k, v: ring_attention_sharded(q, k, v, mesh)
+        )
+        np.testing.assert_allclose(
+            fn(q, k, v), dot_attention(q, k, v), atol=2e-4, rtol=2e-4
+        )
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dot(self, causal):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=64, h=4, d=16)
+        ref = dot_attention(q, k, v, causal=causal)
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_gradients_match_dot(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=32, h=4, d=16)
+        ref = _grads(
+            lambda q, k, v: dot_attention(q, k, v, causal=True), q, k, v
+        )
+        got = _grads(
+            lambda q, k, v: ulysses_attention_sharded(
+                q, k, v, mesh, causal=True
+            ),
+            q, k, v,
+        )
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r, atol=1e-5, rtol=1e-5)
+
+    def test_head_divisibility_enforced(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=32, h=2, d=16)  # 2 heads, 4-way seq axis
+        with pytest.raises(Exception, match="divisible|ring"):
+            ulysses_attention_sharded(q, k, v, mesh)
+
+
+class TestDispatcher:
+    def test_dispatch_dot(self):
+        q, k, v = _qkv(s=16)
+        np.testing.assert_allclose(
+            attention(q, k, v, impl="dot"),
+            dot_attention(q, k, v),
+            atol=1e-6,
+        )
+
+    def test_unknown_impl(self):
+        q, k, v = _qkv(s=16)
+        with pytest.raises(ValueError, match="unknown attention impl"):
+            attention(q, k, v, impl="nope")
