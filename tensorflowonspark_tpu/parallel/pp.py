@@ -1,0 +1,337 @@
+"""Pipeline parallelism — microbatched stage loop over the ``pipe`` axis.
+
+New TPU-first capability; the reference has no pipeline parallelism
+(SURVEY.md §2.3: 'Tensor/Pipeline/... parallelism: absent').
+
+Design (GPipe-style, the scaling-book recipe):
+
+- layer parameters are *stacked* with a leading stage dimension and
+  sharded over the ``pipe`` mesh axis, so each device holds one stage's
+  layers and XLA never materializes the full model anywhere;
+- the batch is split into M microbatches; a ``lax.scan`` runs
+  ``M + P - 1`` ticks, each tick = one stage compute + one
+  ``ppermute`` handing activations to the next stage (XLA lowers the
+  permute onto neighbor ICI links, and overlaps it with the next tick's
+  compute);
+- stage 0 injects microbatch ``t`` at tick ``t``; the last stage's
+  output for tick ``t`` is microbatch ``t - (P-1)``;
+- reverse-mode AD through scan + ppermute *is* the backward pipeline
+  (ppermute's transpose is the inverse permutation) — no hand-written
+  backward schedule;
+- bubble fraction is the usual ``(P-1)/(M+P-1)``: choose
+  ``num_microbatches >= 4*P`` to amortize.
+
+Two layers of API:
+
+- :func:`pipeline` — the raw primitive, called under ``shard_map``
+  (composes with TP/DP axes in the same mesh);
+- :class:`PipelineTrainer` — a jitted training loop for stacked-block
+  models (first/last-stage extras like embedding and loss heads handled
+  via ``first_stage_fn``/``last_stage_fn``).
+"""
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+logger = logging.getLogger(__name__)
+
+
+def pipeline(stage_fn, stage_params, microbatches, axis_name="pipe",
+             broadcast_result=True):
+    """GPipe microbatch loop; call under ``shard_map``.
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x) -> y`` — one stage's compute
+        on one microbatch (same output/input shape so activations can
+        flow stage to stage).
+      stage_params: this device's stage parameters (the local shard of a
+        stacked-parameter pytree).
+      microbatches: ``[M, mb, ...]`` microbatched input.  Only stage 0
+        reads it (other stages may pass the same array; it is ignored).
+      broadcast_result: if True, psum-broadcast the last stage's results
+        to every stage (convenient for inference).  Training code that
+        derives a *loss* from the result must pass False and mask to the
+        last stage itself — a loss computed from the broadcast copy on
+        every stage would backprop P cotangents through the psum and
+        scale all gradients by the stage count.
+    Returns ``[M, mb, ...]`` outputs: on the last stage (or everywhere
+    with ``broadcast_result``) the pipelined results; zeros elsewhere.
+    """
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    m = microbatches.shape[0]
+    total = m + p - 1
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    buf0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    out0 = jnp.zeros(microbatches.shape, microbatches.dtype)
+
+    def tick(carry, t):
+        buf, outs = carry
+        # stage 0 injects microbatch t (clamped index keeps the gather
+        # in-bounds on the drain ticks where t >= m)
+        inj = microbatches[jnp.minimum(t, m - 1)]
+        x = jnp.where(idx == 0, inj, buf)
+        y = stage_fn(stage_params, x)
+        # last stage banks microbatch t-(p-1) during the valid window
+        mb_idx = jnp.clip(t - (p - 1), 0, m - 1)
+        is_valid = jnp.logical_and(idx == p - 1, t >= p - 1)
+        outs = lax.dynamic_update_index_in_dim(
+            outs,
+            jnp.where(is_valid, y, outs[mb_idx]),
+            mb_idx,
+            axis=0,
+        )
+        # hand activations to the next stage (wraparound write into
+        # stage 0 is overwritten by injection next tick)
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outs), None
+
+    (_, outs), _ = lax.scan(tick, (buf0, out0), jnp.arange(total))
+    # banked outputs live on the last stage; zero the other stages'
+    # buffers (they hold stale intermediates)
+    outs = jnp.where(idx == p - 1, outs, jnp.zeros_like(outs))
+    if broadcast_result:
+        outs = lax.psum(outs, axis_name)
+    return outs
+
+
+def stack_stage_params(per_layer_params, num_stages):
+    """Stack an L-element list of per-layer param pytrees into a
+    ``[num_stages, L/num_stages, ...]`` pytree (leading stage dim for
+    ``pipe`` sharding, second dim scanned within a stage)."""
+    n = len(per_layer_params)
+    if n % num_stages != 0:
+        raise ValueError(
+            "num_layers ({0}) must divide by num_stages ({1})".format(
+                n, num_stages
+            )
+        )
+    per_stage = n // num_stages
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
+    return jax.tree.map(
+        lambda x: x.reshape((num_stages, per_stage) + x.shape[1:]), stacked
+    )
+
+
+def local_stage(stacked_local):
+    """Drop the size-1 stage dim a ``P('pipe')``-sharded stacked-param
+    pytree carries inside ``shard_map`` (local shard ``[1, L/P, ...]`` →
+    ``[L/P, ...]``)."""
+    return jax.tree.map(lambda x: x[0], stacked_local)
+
+
+def _layers_scan(layer_fn, stage_params, x):
+    """Apply a stage's stacked layers sequentially via ``lax.scan``
+    (single compiled layer body regardless of depth)."""
+
+    def body(h, layer_params):
+        return layer_fn(layer_params, h), None
+
+    out, _ = lax.scan(body, x, stage_params)
+    return out
+
+
+class PipelineTrainer(object):
+    """Jitted pipeline-parallel training over a mesh with a ``pipe`` axis
+    (optionally combined with ``data`` for 2D pp x dp).
+
+    The model contract mirrors how deep nets factor naturally:
+
+    - ``layer_fn(layer_params, h) -> h`` — the repeated block;
+    - ``first_stage_fn(extra_params, batch) -> h0`` — embedding/stem,
+      runs only on stage 0 (params replicated, unused elsewhere);
+    - ``last_stage_fn(extra_params, h, batch) -> (loss, metrics)`` —
+      head + loss, runs only on the last stage;
+    - optimizer: optax transformation applied to the whole param tree.
+
+    Parameters are a dict ``{"stages": stacked [P, L/P, ...] pytree,
+    "first": ..., "last": ...}``; ``stages`` is sharded over ``pipe``,
+    the extras are replicated.
+    """
+
+    def __init__(
+        self,
+        layer_fn,
+        first_stage_fn,
+        last_stage_fn,
+        optimizer,
+        mesh,
+        num_microbatches,
+        axis_name="pipe",
+        data_axes=("data", "fsdp"),
+    ):
+        if mesh.shape.get(axis_name, 1) < 2:
+            raise ValueError(
+                "PipelineTrainer needs a mesh with a >=2-wide {0!r} axis, "
+                "got {1}".format(axis_name, dict(mesh.shape))
+            )
+        self.layer_fn = layer_fn
+        self.first_stage_fn = first_stage_fn
+        self.last_stage_fn = last_stage_fn
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.axis_name = axis_name
+        self.data_axes = tuple(
+            a for a in data_axes if mesh.shape.get(a, 1) > 1
+        )
+        self._step = self._build_step()
+
+    # -- sharding ------------------------------------------------------
+
+    def _param_shardings(self, params):
+        pipe = self.axis_name
+
+        def _stage_spec(x):
+            return NamedSharding(self.mesh, P(pipe))
+
+        return {
+            "stages": jax.tree.map(_stage_spec, params["stages"]),
+            "first": jax.tree.map(
+                lambda x: NamedSharding(self.mesh, P()), params["first"]
+            ),
+            "last": jax.tree.map(
+                lambda x: NamedSharding(self.mesh, P()), params["last"]
+            ),
+        }
+
+    def create_state(self, params):
+        """``params = {"stages": [P, L/P, ...], "first": ..., "last"}``
+        (see :func:`stack_stage_params`)."""
+        from tensorflowonspark_tpu.parallel.dp import TrainState
+
+        shardings = self._param_shardings(params)
+        params = jax.tree.map(jax.device_put, params, shardings)
+        # optax states mirror the param tree, so jitted init inherits the
+        # params' shardings (stage slots stay on their stage's devices)
+        opt_state = jax.jit(self.optimizer.init)(params)
+        step = jax.device_put(
+            jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
+        )
+        return TrainState(step, params, opt_state)
+
+    # -- the step ------------------------------------------------------
+
+    def _build_step(self):
+        layer_fn = self.layer_fn
+        first_fn = self.first_stage_fn
+        last_fn = self.last_stage_fn
+        optimizer = self.optimizer
+        pipe = self.axis_name
+        m = self.num_microbatches
+        data_axes = self.data_axes
+        mesh = self.mesh
+
+        batch_spec = P(data_axes if data_axes else None)
+        param_specs = {
+            "stages": P(pipe),
+            "first": P(),
+            "last": P(),
+        }
+
+        def local_loss(params, batch):
+            """Runs under shard_map: params['stages'] is the local stage,
+            batch is the local data shard."""
+            p = lax.axis_size(pipe)
+            idx = lax.axis_index(pipe)
+
+            h0 = first_fn(params["first"], batch)  # [B_local, ...]
+            b = h0.shape[0]
+            if b % m != 0:
+                raise ValueError(
+                    "local batch {0} not divisible by num_microbatches "
+                    "{1}".format(b, m)
+                )
+            mb = b // m
+            micro = h0.reshape((m, mb) + h0.shape[1:])
+
+            stage = functools.partial(_layers_scan, layer_fn)
+            # banked results: valid on the last stage only (see the
+            # broadcast_result gradient note in `pipeline`)
+            outs = pipeline(
+                stage, local_stage(params["stages"]), micro, axis_name=pipe,
+                broadcast_result=False,
+            )
+            h_out = outs.reshape((b,) + outs.shape[2:])
+            loss_l, metrics_l = last_fn(params["last"], h_out, batch)
+            # Return the MASKED local loss (real on the last stage, 0
+            # elsewhere) with no collective: under check_vma=False a
+            # psum inside the differentiated region transposes to
+            # another psum and scales every gradient by the axis size.
+            # All sharing/averaging collectives run on the grads and
+            # metrics outside autodiff (grad_fn below).
+            is_last = idx == p - 1
+            loss = jnp.where(is_last, loss_l, 0.0)
+            metrics = jax.tree.map(
+                lambda x: jnp.where(is_last, x, jnp.zeros_like(x)),
+                metrics_l,
+            )
+            return loss, metrics
+
+        @functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(param_specs, batch_spec),
+            out_specs=(param_specs, P()),
+            check_vma=False,
+        )
+        def grad_fn(params, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(params, batch)
+            # Post-autodiff reductions (always transpose-safe out here):
+            # - each device's grads are d(its data shard's loss)/d(its
+            #   params): mean over the data axes gives the global-batch
+            #   gradient (per-shard losses are already shard means);
+            # - first/last grads are nonzero only on the first/last
+            #   stage: psum over pipe shares them to every stage's
+            #   replicated copy.
+            def _dmean(g):
+                return lax.pmean(g, data_axes) if data_axes else g
+
+            grads = {
+                "stages": jax.tree.map(_dmean, grads["stages"]),
+                "first": jax.tree.map(
+                    lambda g: _dmean(lax.psum(g, pipe)), grads["first"]
+                ),
+                "last": jax.tree.map(
+                    lambda g: _dmean(lax.psum(g, pipe)), grads["last"]
+                ),
+            }
+            # loss/metrics are masked to the last stage: share + average
+            metrics = dict(metrics)
+            metrics["loss"] = loss
+            metrics = jax.tree.map(
+                lambda x: _dmean(lax.psum(x, pipe)), metrics
+            )
+            return grads, metrics
+
+        def train_step(state, batch):
+            grads, metrics = grad_fn(state.params, batch)
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            import optax
+
+            params = optax.apply_updates(state.params, updates)
+            from tensorflowonspark_tpu.parallel.dp import TrainState
+
+            return TrainState(state.step + 1, params, opt_state), metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    def step(self, state, batch):
+        """One pipelined step on a host-local batch pytree."""
+        from tensorflowonspark_tpu.parallel import sharding as sh
+
+        device_batch = sh.shard_batch(
+            batch, self.mesh, self.data_axes or ("data",)
+        )
+        return self._step(state, device_batch)
