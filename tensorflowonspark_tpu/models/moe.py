@@ -1,0 +1,110 @@
+"""Mixture-of-Experts feed-forward layer (expert parallelism).
+
+New TPU-first capability with no reference analogue (SURVEY.md §2.3).
+Expert weights are *stacked* ``[E, ...]`` and annotated with the
+``expert`` logical axis; under a mesh with an ``expert`` axis the
+dispatch/combine einsums against those weights make XLA insert the
+expert all-to-alls over ICI — no hand-written routing collectives.
+Composes with TP (``expert_mlp`` logical axis → ``model`` mesh axis)
+and DP/FSDP through the same rule sets as every other layer.
+
+Aux losses are reported through flax's ``sow`` under the ``"losses"``
+collection; :func:`moe_loss_fn` collects them.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from tensorflowonspark_tpu.ops import moe as moe_ops
+
+
+class MoEMLP(nn.Module):
+    """Gated-SiLU expert FFN with top-k capacity routing.
+
+    Drop-in for the dense MLP on ``[B, S, D]`` activations; sows the
+    load-balancing aux loss as ``losses/moe_aux``.
+    """
+
+    num_experts: int
+    mlp_dim: int
+    embed_dim: int
+    k: int = 2
+    capacity_factor: float = 1.25
+    dtype: str = "bfloat16"
+
+    @nn.compact
+    def __call__(self, x):
+        e, m, d = self.num_experts, self.mlp_dim, self.embed_dim
+        jdtype = jnp.dtype(self.dtype)
+        b, s, _ = x.shape
+        g = b * s
+        xf = x.reshape(g, d)
+
+        # router runs in f32: tiny matmul, and routing decisions are
+        # sensitive to logit precision
+        router = self.param(
+            "router", nn.initializers.normal(stddev=0.02), (d, e)
+        )
+        logits = xf.astype(jnp.float32) @ router.astype(jnp.float32)
+        cap = moe_ops.expert_capacity(
+            g, e, capacity_factor=self.capacity_factor, k=self.k
+        )
+        dispatch, combine, aux = moe_ops.top_k_gating(
+            logits, e, cap, k=self.k
+        )
+        self.sow("losses", "moe_aux", aux)
+
+        init = nn.initializers.variance_scaling(1.0, "fan_in", "normal")
+        wi = self.param("wi", init, (e, d, m))
+        wg = self.param("wg", init, (e, d, m))
+        wo = self.param("wo", init, (e, m, d))
+
+        # dispatch: [G,E,C] x [G,D] -> expert batches [E,C,D]
+        xe = jnp.einsum(
+            "gec,gd->ecd", dispatch.astype(jdtype), xf.astype(jdtype)
+        )
+        h = jnp.einsum("ecd,edm->ecm", xe, wi.astype(jdtype))
+        hg = jnp.einsum("ecd,edm->ecm", xe, wg.astype(jdtype))
+        ye = jnp.einsum(
+            "ecm,emd->ecd", nn.silu(hg) * h, wo.astype(jdtype)
+        )
+        # combine: weighted return to token order [G,D]
+        y = jnp.einsum("gec,ecd->gd", combine.astype(jdtype), ye)
+        return y.reshape(b, s, d).astype(x.dtype)
+
+
+#: path-regex → logical axes for MoE params (merged into the
+#: transformer's rules by models.transformer.LOGICAL_AXES_RULES)
+MOE_LOGICAL_AXES_RULES = (
+    (r"router$", ("embed", None)),
+    (r"moe/(wi|wg)$", ("expert", "embed", "expert_mlp")),
+    (r"moe/wo$", ("expert", "expert_mlp", "embed")),
+)
+
+
+def moe_loss_fn(model, aux_weight=0.01):
+    """Next-token CE + weighted MoE load-balance aux losses.
+
+    Same contract as ``transformer.loss_fn`` (batch = dict(tokens));
+    works for any model that sows into the ``"losses"`` collection.
+    """
+
+    def _loss(params, batch, rng):
+        tokens = batch["tokens"]
+        logits, variables = model.apply(
+            {"params": params}, tokens, mutable=["losses"]
+        )
+        targets = tokens[:, 1:]
+        logits = logits[:, :-1]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        ce = jnp.mean(nll)
+        aux_leaves = jax.tree.leaves(variables.get("losses", {}))
+        aux = (
+            sum(jnp.sum(a) for a in aux_leaves)
+            if aux_leaves else jnp.zeros((), jnp.float32)
+        )
+        return ce + aux_weight * aux, {"ce": ce, "moe_aux": aux}
+
+    return _loss

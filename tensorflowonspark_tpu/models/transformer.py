@@ -40,6 +40,11 @@ class TransformerConfig:
     dtype: str = "bfloat16"
     attention_impl: str = "dot"  # dot | flash | ring | ulysses
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
+    # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
+    # MoE FFN (models/moe.py) in every block
+    num_experts: int = 0
+    expert_k: int = 2
+    capacity_factor: float = 1.25
 
     @property
     def jdtype(self):
@@ -118,11 +123,26 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions):
-        x = x + Attention(self.cfg, name="attn")(
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(
             RMSNorm(name="ln1")(x), positions
         )
-        x = x + MLP(self.cfg, name="mlp")(RMSNorm(name="ln2")(x))
-        return x
+        h = RMSNorm(name="ln2")(x)
+        if cfg.num_experts > 0:
+            from tensorflowonspark_tpu.models.moe import MoEMLP
+
+            ff = MoEMLP(
+                num_experts=cfg.num_experts,
+                mlp_dim=cfg.mlp_dim,
+                embed_dim=cfg.embed_dim,
+                k=cfg.expert_k,
+                capacity_factor=cfg.capacity_factor,
+                dtype=cfg.dtype,
+                name="moe",
+            )(h)
+        else:
+            ff = MLP(cfg, name="mlp")(h)
+        return x + ff
 
 
 class Transformer(nn.Module):
@@ -165,6 +185,10 @@ LOGICAL_AXES_RULES = (
     (r"mlp/wo/kernel", ("mlp", "embed")),
     (r"lm_head/kernel", ("embed", "vocab")),
     (r"(ln1|ln2|ln_f)/scale", None),
+    # MoE blocks (models/moe.py)
+    (r"moe/router$", ("embed", None)),
+    (r"moe/(wi|wg)$", ("expert", "embed", "expert_mlp")),
+    (r"moe/wo$", ("expert", "expert_mlp", "embed")),
 )
 
 

@@ -1,0 +1,96 @@
+"""Mixture-of-Experts routing: top-k gating with capacity.
+
+New TPU-first capability; the reference has no expert parallelism
+(SURVEY.md §2.3 'Tensor/Pipeline/Sequence/Expert/Context parallelism:
+absent').
+
+Design (Switch/GShard-style dense dispatch): routing produces a
+``dispatch`` one-hot tensor ``[G, E, C]`` (token -> expert slot) and a
+``combine`` tensor of gate weights.  Expert compute is then two einsums
+against expert-stacked weights ``[E, ...]`` — *static shapes*, which is
+the whole trick on TPU: token counts per expert vary at runtime, but
+capacity ``C`` fixes the tensor shapes so XLA can tile the MXU and
+insert the expert-axis all-to-alls itself when ``E`` is sharded on the
+``expert`` mesh axis.  Tokens over capacity are dropped (standard
+Switch behavior); the auxiliary load-balancing loss pushes the router
+toward uniform load so drops stay rare.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def top_k_gating(router_logits, num_experts, capacity, k=2, rng=None,
+                 jitter_eps=0.0):
+    """Compute dispatch/combine tensors for top-k routing.
+
+    Args:
+      router_logits: ``[G, E]`` per-token expert scores (G = flattened
+        tokens).
+      capacity: per-expert slot count ``C``.
+      k: number of experts per token (1 = Switch, 2 = GShard default).
+      rng, jitter_eps: optional multiplicative logit jitter for
+        exploration during training.
+
+    Returns ``(dispatch [G, E, C] float, combine [G, E, C] float,
+    aux_loss scalar)``.  ``sum(combine, axis=(1, 2))`` is each token's
+    total gate weight (< 1 when some of its experts overflowed).
+    """
+    g, e = router_logits.shape
+    if rng is not None and jitter_eps > 0:
+        noise = jax.random.uniform(
+            rng, router_logits.shape, minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps,
+        )
+        router_logits = router_logits * noise
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    # aux load-balance loss (Switch eq. 4): E * sum_e f_e * p_e, where
+    # f_e = fraction of tokens whose top-1 is e, p_e = mean router prob
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(f * p)
+
+    dispatch = jnp.zeros((g, e, capacity), jnp.float32)
+    combine = jnp.zeros((g, e, capacity), jnp.float32)
+    remaining = probs
+    # experts fill in priority order: k-th choices only take slots the
+    # earlier choices left (cumsum position accounting per expert)
+    used = jnp.zeros((e,), jnp.int32)  # slots consumed by earlier choices
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)  # [G]
+        gate = jnp.take_along_axis(
+            remaining, choice[:, None], axis=-1
+        )[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [G, E]
+        # position of each token within its chosen expert's queue
+        pos_within = (
+            jnp.cumsum(onehot, axis=0) - onehot
+        )  # [G, E]: tokens ahead of me with same choice
+        pos = jnp.sum(pos_within * onehot, axis=-1).astype(jnp.int32) + (
+            used[choice]
+        )
+        fits = pos < capacity
+        slot = jnp.clip(pos, 0, capacity - 1)
+        slot_onehot = jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+        mask = (fits[:, None, None].astype(jnp.float32) *
+                onehot[..., None] * slot_onehot[:, None, :])  # [G, E, C]
+        dispatch = dispatch + mask
+        combine = combine + mask * gate[:, None, None]
+        used = used + jnp.sum(
+            onehot * fits[:, None].astype(jnp.float32), axis=0
+        ).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)  # mask chosen expert out
+
+    # renormalize combine over the k gates a token actually landed
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return dispatch, combine, aux_loss
+
+
+def expert_capacity(num_tokens, num_experts, capacity_factor=1.25, k=2):
+    """Standard capacity formula: ``ceil(k * G / E * factor)``, rounded
+    up to a multiple of 8 (TPU sublane alignment)."""
+    cap = int(num_tokens * k * capacity_factor / num_experts) + 1
+    return ((cap + 7) // 8) * 8

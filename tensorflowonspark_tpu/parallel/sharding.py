@@ -34,6 +34,7 @@ RULES_TP = RULES_DP + (
     ("heads", "model"),
     ("kv_heads", "model"),
     ("vocab", "model"),
+    ("expert", "expert"),
     ("expert_mlp", "model"),
 )
 RULES_TP_FSDP = RULES_DP + (
@@ -42,14 +43,16 @@ RULES_TP_FSDP = RULES_DP + (
     ("kv_heads", "model"),
     ("vocab", "model"),
     ("embed", "fsdp"),
+    ("expert", "expert"),
     ("expert_mlp", "model"),
 )
 RULES_SEQ = (
     ("batch", ("data", "fsdp")),
     ("seq", "seq"),
 )
-RULES_EP = (
+RULES_EP = RULES_DP + (
     ("expert", "expert"),
+    ("expert_mlp", "model"),
 )
 
 
