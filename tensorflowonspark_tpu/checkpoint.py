@@ -1,0 +1,174 @@
+"""Sharded checkpoint / resume — the framework's recovery story.
+
+The reference delegated checkpointing entirely to TensorFlow in user
+code (Keras ModelCheckpoint / estimator save_checkpoints_steps /
+SavedModel export, SURVEY.md §5 'Checkpoint / resume'); its framework
+touchpoints were only ``model_dir``/``export_dir`` params and the
+``grace_secs`` window so the chief could finish exporting after the
+feed ended (reference: TFCluster.py:125, pipeline.py:88-98).
+
+Here checkpointing is first-class: orbax writes each shard of a
+``TrainState`` from the process that owns it (multi-host safe, no
+gather to host 0), and restore places shards directly onto the target
+mesh — resume never materializes the full model on one host.
+
+API surface kept deliberately small:
+
+- :class:`Checkpointer` — save/restore/latest/all_steps over a
+  directory (local or any fsspec-reachable store);
+- :func:`save_for_serving` / :func:`load_for_serving` — params-only
+  export, the SavedModel-role analogue consumed by the serving path
+  (reference analogue: TFNode.export_saved_model, TFNode.py:159-208).
+"""
+
+import logging
+import os
+
+import jax
+
+logger = logging.getLogger(__name__)
+
+
+class Checkpointer(object):
+    """Orbax-backed train-state checkpointing with retention.
+
+    Args:
+      directory: checkpoint root (created if missing; absolute paths
+        required by orbax — relative inputs are resolved).
+      max_to_keep: retention window (None = keep all).
+      save_interval_steps: minimum step spacing between accepted saves
+        (the reference's analogue was estimator save_checkpoints_steps,
+        examples/mnist/estimator/mnist_spark.py:98).
+    """
+
+    def __init__(self, directory, max_to_keep=3, save_interval_steps=1):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        directory = os.path.abspath(os.fspath(directory))
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            create=True,
+        )
+        self._mgr = ocp.CheckpointManager(directory, options=options)
+        self.directory = directory
+
+    # -- train-state ---------------------------------------------------
+
+    def save(self, step, state, wait=False):
+        """Save a pytree (e.g. ``TrainState``) at ``step``.  Async by
+        default: the train loop keeps running while shards stream out;
+        ``wait=True`` blocks (use before shutdown)."""
+        saved = self._mgr.save(
+            int(step), args=self._ocp.args.StandardSave(state)
+        )
+        if wait:
+            self._mgr.wait_until_finished()
+        return saved
+
+    def restore(self, state_like, step=None):
+        """Restore into the structure/shardings of ``state_like``.
+
+        ``state_like`` may be a concrete pytree (its shardings are
+        reused — pass the freshly-initialized sharded state to resume
+        in place) or a pytree of ``jax.ShapeDtypeStruct`` with
+        ``sharding`` set.  ``step=None`` restores the latest.
+        """
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(
+                    "no checkpoint found under {0}".format(self.directory)
+                )
+        abstract = jax.tree.map(_abstractify, state_like)
+        restored = self._mgr.restore(
+            int(step), args=self._ocp.args.StandardRestore(abstract)
+        )
+        # Belt-and-braces placement: orbax restores sharded arrays in
+        # place, but leaves whose template carried no byte-level shards
+        # (e.g. replicated scalars like opt-state counts) can come back
+        # single-device; re-commit everything to the template shardings.
+        def _place(tmpl, got):
+            s = getattr(tmpl, "sharding", None)
+            if s is not None and getattr(got, "sharding", None) != s:
+                return jax.device_put(got, s)
+            return got
+
+        return jax.tree.map(_place, state_like, restored)
+
+    def latest_step(self):
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return list(self._mgr.all_steps())
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    def close(self):
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def _abstractify(x):
+    """Concrete array -> ShapeDtypeStruct carrying its sharding (so
+    restore places each shard straight onto its devices); abstract
+    leaves and non-arrays pass through."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        )
+    return x
+
+
+# ----------------------------------------------------------------------
+# Params-only export for serving (the SavedModel role)
+# ----------------------------------------------------------------------
+
+
+def save_for_serving(directory, params, extra_metadata=None):
+    """Export inference params (+ JSON metadata) — the role the
+    reference filled with SavedModel export (TFNode.py:159-208,
+    compat.py:10-17: chief exports, workers write to a dummy dir; here
+    non-zero processes simply skip)."""
+    import json
+
+    import orbax.checkpoint as ocp
+
+    if jax.process_index() != 0 and jax.process_count() > 1:
+        # orbax saves distributed arrays cooperatively; for the common
+        # replicated-params serving export, process 0 alone suffices
+        # and avoids the dummy-dir dance the reference needed
+        params = jax.tree.map(lambda x: x, params)
+    directory = os.path.abspath(os.fspath(directory))
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(directory, "params"), params, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+    if jax.process_index() == 0:
+        meta = dict(extra_metadata or {})
+        with open(os.path.join(directory, "metadata.json"), "w") as f:
+            json.dump(meta, f)
+    logger.info("serving export written to %s", directory)
+    return directory
+
+
+def load_for_serving(directory):
+    """Load a serving export; returns ``(params, metadata dict)``."""
+    import json
+
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(os.fspath(directory))
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(os.path.join(directory, "params"))
+    ckptr.close()
+    meta_path = os.path.join(directory, "metadata.json")
+    meta = {}
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+    return params, meta

@@ -106,6 +106,7 @@ class SyncTrainer(object):
         matching sharding (optax states mirror the param tree)."""
         params = sh.shard_params(params, self.rules, self.mesh, self.annotations)
         opt_state = jax.jit(self.optimizer.init)(params)
+        opt_state = sh.canonicalize_on_mesh(opt_state, self.mesh)
         step = jax.device_put(jnp.zeros((), jnp.int32), sh.replicated(self.mesh))
         if model_state is not None:
             model_state = jax.tree.map(

@@ -208,10 +208,20 @@ class PipelineTrainer(object):
         from tensorflowonspark_tpu.parallel.dp import TrainState
 
         shardings = self._param_shardings(params)
-        params = jax.tree.map(jax.device_put, params, shardings)
+        # jnp.array copy: device_put may alias source buffers into the
+        # placed shards, and the donated train step would then delete
+        # the caller's originals (see sharding.shard_params)
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(jnp.array(p), s), params, shardings
+        )
         # optax states mirror the param tree, so jitted init inherits the
-        # params' shardings (stage slots stay on their stage's devices)
-        opt_state = jax.jit(self.optimizer.init)(params)
+        # params' shardings (stage slots stay on their stage's devices);
+        # input-independent scalars need re-placing onto the mesh
+        from tensorflowonspark_tpu.parallel import sharding as sh
+
+        opt_state = sh.canonicalize_on_mesh(
+            jax.jit(self.optimizer.init)(params), self.mesh
+        )
         step = jax.device_put(
             jnp.zeros((), jnp.int32), NamedSharding(self.mesh, P())
         )

@@ -15,6 +15,7 @@ given array).
 import logging
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -140,15 +141,44 @@ def param_specs(abstract_params, rules, mesh=None, annotations=None):
 
 
 def shard_params(params, rules, mesh, annotations=None):
-    """Place a parameter pytree onto the mesh per the rules."""
+    """Place a parameter pytree onto the mesh per the rules.
+
+    Always copies: ``device_put`` may alias the source buffer into a
+    shard of the placed array, and trainers *donate* the placed state —
+    aliased donation would silently delete the caller's original params
+    (e.g. re-using the same init params for a second trainer).
+    """
     specs = param_specs(params, rules, mesh, annotations)
     return jax.tree.map(
-        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
+        lambda p, s: jax.device_put(
+            jnp.array(p), NamedSharding(mesh, s)
+        ),
+        params,
+        specs,
     )
 
 
 def replicated(mesh):
     return NamedSharding(mesh, PartitionSpec())
+
+
+def canonicalize_on_mesh(tree, mesh):
+    """Ensure every leaf lives on ``mesh``.  Leaves XLA left on a single
+    device (jit outputs with no input dependence — e.g. optax ``count``
+    scalars) are re-placed replicated; mesh-sharded leaves pass through.
+    A state that mixes single-device and mesh arrays fails at the next
+    jitted step with 'incompatible devices', and checkpoint templates
+    built from it restore to the same broken placement."""
+
+    def _fix(x):
+        s = getattr(x, "sharding", None)
+        if s is None or not hasattr(x, "shape"):
+            return x
+        if isinstance(s, NamedSharding) and s.mesh == mesh:
+            return x
+        return jax.device_put(x, replicated(mesh))
+
+    return jax.tree.map(_fix, tree)
 
 
 def batch_sharding(mesh, data_axes=("data", "fsdp")):
