@@ -1,0 +1,185 @@
+// TFRecord framing codec — the native storage layer.
+//
+// Role parity: the reference shipped a prebuilt Java jar
+// (lib/tensorflow-hadoop-1.0-SNAPSHOT.jar) whose
+// TFRecordFileInputFormat/OutputFormat implemented this exact framing
+// for Spark (used from dfutil.py:39,63 and DFUtil.scala:38,192).  This
+// C++ implementation is the TPU build's equivalent, reached from
+// Python via ctypes (no pybind11 in the image).
+//
+// Wire format (TensorFlow's tfrecord):
+//   uint64 length           (little-endian)
+//   uint32 masked_crc32c(length bytes)
+//   byte   data[length]
+//   uint32 masked_crc32c(data)
+// masked = ((crc >> 15) | (crc << 17)) + 0xa282ead8
+//
+// CRC32C (Castagnoli) in software, slice-by-8: ~1-2 GB/s/core, enough
+// to saturate typical storage; the framing layer is never the
+// bottleneck against HBM-bound training.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32c
+
+uint32_t kCrcTable[8][256];
+bool kTableInit = false;
+
+void InitTables() {
+  if (kTableInit) return;
+  const uint32_t poly = 0x82f63b78u;  // reflected Castagnoli
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; j++)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    kCrcTable[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t crc = kCrcTable[0][i];
+    for (int t = 1; t < 8; t++) {
+      crc = kCrcTable[0][crc & 0xff] ^ (crc >> 8);
+      kCrcTable[t][i] = crc;
+    }
+  }
+  kTableInit = true;
+}
+
+uint32_t Crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  InitTables();
+  crc = ~crc;
+  // slice-by-8 main loop
+  while (n >= 8) {
+    uint64_t chunk;
+    memcpy(&chunk, data, 8);
+    chunk ^= crc;  // fold current crc into the low 4 bytes
+    crc = kCrcTable[7][chunk & 0xff] ^
+          kCrcTable[6][(chunk >> 8) & 0xff] ^
+          kCrcTable[5][(chunk >> 16) & 0xff] ^
+          kCrcTable[4][(chunk >> 24) & 0xff] ^
+          kCrcTable[3][(chunk >> 32) & 0xff] ^
+          kCrcTable[2][(chunk >> 40) & 0xff] ^
+          kCrcTable[1][(chunk >> 48) & 0xff] ^
+          kCrcTable[0][(chunk >> 56) & 0xff];
+    data += 8;
+    n -= 8;
+  }
+  while (n--) crc = kCrcTable[0][(crc ^ *data++) & 0xff] ^ (crc >> 8);
+  return ~crc;
+}
+
+inline uint32_t Mask(uint32_t crc) {
+  return ((crc >> 15) | (crc << 17)) + 0xa282ead8u;
+}
+
+inline uint32_t Unmask(uint32_t masked) {
+  uint32_t rot = masked - 0xa282ead8u;
+  return (rot >> 17) | (rot << 15);
+}
+
+// ---------------------------------------------------------------- writer
+
+struct Writer {
+  FILE* f;
+};
+
+struct Reader {
+  FILE* f;
+  std::vector<uint8_t> buf;
+  std::string error;
+};
+
+}  // namespace
+
+extern "C" {
+
+// crc utilities exposed for tests / python fallback validation
+uint32_t tfr_crc32c(const uint8_t* data, uint64_t len) {
+  return Crc32c(data, len);
+}
+uint32_t tfr_masked_crc(const uint8_t* data, uint64_t len) {
+  return Mask(Crc32c(data, len));
+}
+
+void* tfr_writer_open(const char* path) {
+  FILE* f = fopen(path, "wb");
+  if (!f) return nullptr;
+  return new Writer{f};
+}
+
+// append one record; returns 0 on success
+int tfr_writer_write(void* handle, const uint8_t* data, uint64_t len) {
+  Writer* w = static_cast<Writer*>(handle);
+  uint64_t len_le = len;  // assume little-endian host (x86/arm TPU VMs)
+  uint32_t len_crc = Mask(Crc32c(reinterpret_cast<uint8_t*>(&len_le), 8));
+  uint32_t data_crc = Mask(Crc32c(data, len));
+  if (fwrite(&len_le, 8, 1, w->f) != 1) return -1;
+  if (fwrite(&len_crc, 4, 1, w->f) != 1) return -1;
+  if (len && fwrite(data, 1, len, w->f) != len) return -1;
+  if (fwrite(&data_crc, 4, 1, w->f) != 1) return -1;
+  return 0;
+}
+
+int tfr_writer_flush(void* handle) {
+  return fflush(static_cast<Writer*>(handle)->f);
+}
+
+void tfr_writer_close(void* handle) {
+  Writer* w = static_cast<Writer*>(handle);
+  fclose(w->f);
+  delete w;
+}
+
+void* tfr_reader_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new Reader{f, {}, {}};
+}
+
+// read next record into the reader's buffer.
+// returns length >= 0 on success, -1 on EOF, -2 on corruption.
+// data pointer is returned via *out (valid until the next call).
+int64_t tfr_reader_next(void* handle, const uint8_t** out) {
+  Reader* r = static_cast<Reader*>(handle);
+  uint64_t len;
+  size_t got = fread(&len, 1, 8, r->f);
+  if (got == 0) return -1;  // clean EOF
+  if (got != 8) { r->error = "truncated length"; return -2; }
+  uint32_t len_crc;
+  if (fread(&len_crc, 4, 1, r->f) != 1) { r->error = "truncated length crc"; return -2; }
+  if (Unmask(len_crc) != Crc32c(reinterpret_cast<uint8_t*>(&len), 8)) {
+    r->error = "length crc mismatch";
+    return -2;
+  }
+  if (len > (1ull << 40)) { r->error = "absurd record length"; return -2; }
+  r->buf.resize(len);
+  if (len && fread(r->buf.data(), 1, len, r->f) != len) {
+    r->error = "truncated data";
+    return -2;
+  }
+  uint32_t data_crc;
+  if (fread(&data_crc, 4, 1, r->f) != 1) { r->error = "truncated data crc"; return -2; }
+  if (Unmask(data_crc) != Crc32c(r->buf.data(), len)) {
+    r->error = "data crc mismatch";
+    return -2;
+  }
+  *out = r->buf.data();
+  return static_cast<int64_t>(len);
+}
+
+const char* tfr_reader_error(void* handle) {
+  return static_cast<Reader*>(handle)->error.c_str();
+}
+
+void tfr_reader_close(void* handle) {
+  Reader* r = static_cast<Reader*>(handle);
+  fclose(r->f);
+  delete r;
+}
+
+}  // extern "C"

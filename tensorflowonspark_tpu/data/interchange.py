@@ -1,0 +1,254 @@
+"""Row/column ↔ TFRecord interchange (the dfutil/DFUtil equivalent).
+
+Re-designed from the reference's ``dfutil.py`` (Python) and
+``DFUtil.scala``/``SimpleTypeParser.scala`` (JVM): save rows as
+TFRecord shards of ``tf.train.Example``, load them back with schema
+inference from the first record (reference: dfutil.py:44-81,134-168)
+incl. the ``binary_features`` hint disambiguating bytes vs string
+(reference: dfutil.py:134-168), and a ``struct<name:type,...>`` schema
+string grammar (reference: SimpleTypeParser.scala:36-63).
+
+Rows are plain dicts (the engine-agnostic representation the data
+plane feeds anyway); the Spark adapter in
+:mod:`tensorflowonspark_tpu.data.spark_io` maps DataFrames onto this.
+"""
+
+import glob as _glob
+import logging
+import os
+import re
+
+import numpy as np
+
+from tensorflowonspark_tpu.data import example as ex
+from tensorflowonspark_tpu.data import tfrecord as tfr
+
+logger = logging.getLogger(__name__)
+
+#: scalar schema types (the SimpleTypeParser base-type set,
+#: SimpleTypeParser.scala:42-55)
+SCALAR_TYPES = (
+    "binary", "boolean", "double", "float", "int", "long", "string",
+    "short",
+)
+
+
+# ----------------------------------------------------------------------
+# schema strings:  struct<name:type,...>  with  array<base>
+# ----------------------------------------------------------------------
+
+_STRUCT_RE = re.compile(r"^\s*struct\s*<(.*)>\s*$", re.S)
+
+
+def parse_schema(text):
+    """Parse ``struct<a:int,b:array<float>,c:string>`` → ordered
+    ``[(name, type)]`` (type is ``"base"`` or ``"array<base>"``)."""
+    m = _STRUCT_RE.match(text)
+    if not m:
+        raise ValueError("schema must look like struct<name:type,...>: "
+                         "{0!r}".format(text))
+    body = m.group(1)
+    fields = []
+    depth, start = 0, 0
+    parts = []
+    for i, ch in enumerate(body):
+        if ch == "<":
+            depth += 1
+        elif ch == ">":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            parts.append(body[start:i])
+            start = i + 1
+    parts.append(body[start:])
+    for part in parts:
+        part = part.strip()
+        if not part:
+            continue
+        if ":" not in part:
+            raise ValueError("field {0!r} missing ':'".format(part))
+        name, typ = part.split(":", 1)
+        fields.append((name.strip(), _check_type(typ.strip())))
+    if not fields:
+        raise ValueError("empty struct schema")
+    return fields
+
+
+def _check_type(typ):
+    inner = typ
+    m = re.match(r"^array\s*<(.*)>$", typ)
+    if m:
+        inner = m.group(1).strip()
+        if inner not in SCALAR_TYPES:
+            raise ValueError("unsupported array element type "
+                             "{0!r}".format(inner))
+        return "array<{0}>".format(inner)
+    if typ not in SCALAR_TYPES:
+        raise ValueError("unsupported type {0!r}".format(typ))
+    return typ
+
+
+def format_schema(fields):
+    return "struct<{0}>".format(
+        ",".join("{0}:{1}".format(n, t) for n, t in fields)
+    )
+
+
+# ----------------------------------------------------------------------
+# schema inference from decoded examples
+# ----------------------------------------------------------------------
+
+
+def infer_schema(feature_dict, binary_features=()):
+    """Infer ``[(name, type)]`` from one decoded example (reference:
+    dfutil.py:134-168 — first record wins; single-element lists become
+    scalars, longer lists arrays; bytes are string unless listed in
+    ``binary_features``)."""
+    fields = []
+    for name in sorted(feature_dict):
+        kind, values = feature_dict[name]
+        if kind == ex.KIND_INT64:
+            base = "long"
+        elif kind == ex.KIND_FLOAT:
+            base = "float"
+        else:
+            base = "binary" if name in binary_features else "string"
+        if len(values) > 1:
+            fields.append((name, "array<{0}>".format(base)))
+        else:
+            fields.append((name, base))
+    return fields
+
+
+# ----------------------------------------------------------------------
+# rows <-> examples
+# ----------------------------------------------------------------------
+
+_KIND_OF_BASE = {
+    "binary": ex.KIND_BYTES,
+    "string": ex.KIND_BYTES,
+    "boolean": ex.KIND_INT64,
+    "short": ex.KIND_INT64,
+    "int": ex.KIND_INT64,
+    "long": ex.KIND_INT64,
+    "float": ex.KIND_FLOAT,
+    "double": ex.KIND_FLOAT,
+}
+
+
+def _base_of(typ):
+    m = re.match(r"^array<(.*)>$", typ)
+    return (m.group(1), True) if m else (typ, False)
+
+
+def row_to_example(row, schema=None):
+    """Encode one dict row.  With a schema, fields are coerced to their
+    declared kinds; without, kinds are inferred per value."""
+    if schema is None:
+        return ex.encode_example(row)
+    feats = {}
+    for name, typ in schema:
+        if name not in row:
+            raise KeyError("row missing field {0!r}".format(name))
+        base, is_array = _base_of(typ)
+        kind = _KIND_OF_BASE[base]
+        value = row[name]
+        if not is_array and not isinstance(value, (list, tuple, np.ndarray)):
+            value = [value]
+        if kind == ex.KIND_BYTES:
+            value = [
+                v.encode("utf-8") if isinstance(v, str) else bytes(v)
+                for v in value
+            ]
+        elif kind == ex.KIND_INT64:
+            value = [int(v) for v in value]
+        else:
+            value = [float(v) for v in value]
+        feats[name] = (kind, value)
+    return ex.encode_example(feats)
+
+
+def example_to_row(record, schema):
+    """Decode example bytes into a dict per the schema (reference:
+    dfutil.py:171-212 fromTFExample)."""
+    decoded = ex.decode_example(record)
+    row = {}
+    for name, typ in schema:
+        base, is_array = _base_of(typ)
+        if name not in decoded:
+            row[name] = [] if is_array else None
+            continue
+        kind, values = decoded[name]
+        if base == "string":
+            values = [
+                v.decode("utf-8") if isinstance(v, bytes) else v
+                for v in values
+            ]
+        elif base == "boolean":
+            values = [bool(v) for v in values]
+        elif base in ("int", "short"):
+            values = [int(v) for v in values]
+        elif base == "double":
+            values = [float(v) for v in values]
+        row[name] = values if is_array else (values[0] if values else None)
+    return row
+
+
+# ----------------------------------------------------------------------
+# files
+# ----------------------------------------------------------------------
+
+
+def save_as_tfrecords(rows, path, schema=None, num_shards=1):
+    """Write rows to ``path`` (a directory of ``part-rNNNNN`` shards —
+    the Hadoop OutputFormat layout the reference produced via Spark,
+    dfutil.py:29-41).  Returns the number of records written."""
+    os.makedirs(path, exist_ok=True)
+    writers = [
+        tfr.TFRecordWriter(
+            os.path.join(path, "part-r-{0:05d}".format(i))
+        )
+        for i in range(num_shards)
+    ]
+    count = 0
+    try:
+        for row in rows:
+            writers[count % num_shards].write(row_to_example(row, schema))
+            count += 1
+    finally:
+        for w in writers:
+            w.close()
+    logger.info("wrote %d records to %s (%d shards)", count, path, num_shards)
+    return count
+
+
+def _record_files(path):
+    if os.path.isdir(path):
+        files = sorted(
+            f
+            for f in _glob.glob(os.path.join(path, "*"))
+            if os.path.isfile(f) and not os.path.basename(f).startswith(
+                ("_", ".")
+            )
+        )
+        if not files:
+            raise FileNotFoundError("no record files under {0}".format(path))
+        return files
+    return [path]
+
+
+def load_tfrecords(path, schema=None, binary_features=()):
+    """Load a TFRecord file/dir → ``(rows, schema)``.  ``schema`` may
+    be a ``struct<...>`` string or ``[(name, type)]``; inferred from
+    the first record when absent (reference: dfutil.py:44-81)."""
+    if isinstance(schema, str):
+        schema = parse_schema(schema)
+    files = _record_files(path)
+    rows = []
+    for f in files:
+        for record in tfr.read_records(f):
+            if schema is None:
+                schema = infer_schema(
+                    ex.decode_example(record), binary_features
+                )
+            rows.append(example_to_row(record, schema))
+    return rows, schema
