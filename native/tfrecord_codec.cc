@@ -21,6 +21,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -29,10 +30,8 @@ namespace {
 // ---------------------------------------------------------------- crc32c
 
 uint32_t kCrcTable[8][256];
-bool kTableInit = false;
 
-void InitTables() {
-  if (kTableInit) return;
+void InitTablesImpl() {
   const uint32_t poly = 0x82f63b78u;  // reflected Castagnoli
   for (uint32_t i = 0; i < 256; i++) {
     uint32_t crc = i;
@@ -47,7 +46,13 @@ void InitTables() {
       kCrcTable[t][i] = crc;
     }
   }
-  kTableInit = true;
+}
+
+// thread-safe one-time init: ctypes releases the GIL during calls, so
+// two threads' first CRC computations may race here
+void InitTables() {
+  static std::once_flag once;
+  std::call_once(once, InitTablesImpl);
 }
 
 uint32_t Crc32c(const uint8_t* data, size_t n, uint32_t crc = 0) {

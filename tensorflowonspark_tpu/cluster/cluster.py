@@ -166,8 +166,17 @@ class TPUCluster(object):
                         m.get_queue(qname).put(None, block=True)
                     except Exception:  # noqa: BLE001 - role may lack queue
                         pass
-            if grace_secs > 0:
-                time.sleep(grace_secs)
+            # Wait for each worker's compute process to report completion
+            # ('compute_state' set by _compute_process_main) instead of the
+            # reference's blind grace_secs sleep (TFCluster.py:125):
+            # post-feed work like the chief's serving export always
+            # finishes, and finished clusters shut down immediately.  The
+            # wait window is max(grace_secs, 60s) — a wedged compute
+            # process delays shutdown by at most that; raise grace_secs
+            # above 60 for exports that legitimately take longer.
+            self._await_compute_done(
+                workers, min(deadline, time.monotonic() + max(grace_secs, 60))
+            )
 
         # error check: peek-and-requeue per node so later checks still see
         # the failure (reference: TFSparkNode.py:612-618, TFCluster.py:178-183)
@@ -219,6 +228,28 @@ class TPUCluster(object):
                 )
             )
         logger.info("cluster shutdown complete")
+
+    def _await_compute_done(self, workers, deadline):
+        pending = {w["executor_id"]: w for w in workers}
+        while pending:
+            for eid, w in list(pending.items()):
+                try:
+                    state = self._connect(w).get("compute_state")._getvalue()
+                except Exception:  # noqa: BLE001 - transient: retry until
+                    continue  # the deadline (managers outlive compute)
+                if state in ("finished", "failed"):
+                    pending.pop(eid)
+            if not pending:
+                return
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "compute processes on executors %s did not report "
+                    "completion within the grace window; proceeding with "
+                    "shutdown",
+                    sorted(pending),
+                )
+                return
+            time.sleep(0.2)
 
     def _await_worker_states(self, workers, deadline):
         pending = {w["executor_id"] for w in workers}

@@ -265,9 +265,18 @@ def _compute_process_main(fn_bytes, args, ctx):
         logger.error("compute process failed:\n%s", tb)
         try:
             ctx.mgr.get_queue("error").put(tb)
+            ctx.mgr.set("compute_state", "failed")
         except Exception:  # noqa: BLE001 - best effort error reporting
             logger.exception("unable to report error to manager")
         raise
+    # Completion signal: shutdown() polls this instead of the reference's
+    # blind grace_secs sleep (TFCluster.py:125), so the chief's post-feed
+    # export always finishes before teardown.  Outside the user-fn try: a
+    # failure to *signal* must not be reported as a compute failure.
+    try:
+        ctx.mgr.set("compute_state", "finished")
+    except Exception:  # noqa: BLE001 - shutdown falls back to its window
+        logger.exception("unable to report completion to manager")
 
 
 def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):

@@ -84,12 +84,15 @@ def _encode_feature(kind, values):
             _write_len_delimited(inner, 1, bytes(v))
     elif kind == _FLOAT:
         packed = np.asarray(values, dtype="<f4").tobytes()
-        _write_len_delimited(inner, 1, packed)
+        # TF omits an empty packed field entirely (byte-compatibility)
+        if packed:
+            _write_len_delimited(inner, 1, packed)
     elif kind == _INT64:
         packed = bytearray()
         for v in values:
             _write_varint(packed, int(v))
-        _write_len_delimited(inner, 1, packed)
+        if packed:
+            _write_len_delimited(inner, 1, packed)
     else:
         raise ValueError("unknown feature kind {0}".format(kind))
     feat = bytearray()

@@ -1,0 +1,63 @@
+"""Gated pyspark DataFrame adapter over the row interchange layer.
+
+The reference's ``dfutil.py`` operated directly on Spark DataFrames
+(reference: tensorflowonspark/dfutil.py:29-81); here the core codec is
+engine-agnostic (:mod:`tensorflowonspark_tpu.data.interchange` on dict
+rows) and this module is the thin Spark veneer — imported only when a
+DataFrame actually shows up, so the framework never requires pyspark.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def _require_pyspark():
+    try:
+        import pyspark  # noqa: F401
+    except ImportError as e:  # pragma: no cover - pyspark not in test env
+        raise ImportError(
+            "pyspark is required for DataFrame interop; install it or "
+            "pass plain dict rows instead"
+        ) from e
+
+
+def dataframe_to_rows(df):
+    """DataFrame → list of dict rows (driver-side collect; the engine
+    re-partitions for executor fan-out)."""
+    _require_pyspark()
+    return [row.asDict() for row in df.collect()]
+
+
+def rows_to_dataframe(spark, rows, schema=None):
+    """Dict rows → DataFrame (``schema`` is an interchange schema list
+    or struct string, converted to column order)."""
+    _require_pyspark()
+    from tensorflowonspark_tpu.data import interchange
+
+    if isinstance(schema, str):
+        schema = interchange.parse_schema(schema)
+    if schema:
+        cols = [name for name, _ in schema]
+        rows = [{c: r.get(c) for c in cols} for r in rows]
+    return spark.createDataFrame(rows)
+
+
+def save_df_as_tfrecords(df, path, num_shards=1):
+    """DataFrame → TFRecord shards via the native codec
+    (reference: dfutil.py:29-41 saveAsTFRecords)."""
+    from tensorflowonspark_tpu.data import interchange
+
+    return interchange.save_as_tfrecords(
+        dataframe_to_rows(df), path, num_shards=num_shards
+    )
+
+
+def load_tfrecords_df(spark, path, schema=None, binary_features=()):
+    """TFRecords → DataFrame (reference: dfutil.py:44-81 loadTFRecords)."""
+    from tensorflowonspark_tpu.data import interchange
+
+    rows, schema = interchange.load_tfrecords(
+        path, schema=schema, binary_features=binary_features
+    )
+    return rows_to_dataframe(spark, rows, schema)

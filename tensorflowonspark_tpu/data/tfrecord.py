@@ -220,13 +220,19 @@ class TFRecordReader(object):
         if len(header) != 8:
             raise CorruptRecordError("truncated length")
         (length,) = struct.unpack("<Q", header)
-        (len_crc,) = struct.unpack("<I", self._f.read(4))
+        len_crc_bytes = self._f.read(4)
+        if len(len_crc_bytes) != 4:
+            raise CorruptRecordError("truncated length crc")
+        (len_crc,) = struct.unpack("<I", len_crc_bytes)
         if len_crc != masked_crc(header):
             raise CorruptRecordError("length crc mismatch")
         data = self._f.read(length)
         if len(data) != length:
             raise CorruptRecordError("truncated data")
-        (data_crc,) = struct.unpack("<I", self._f.read(4))
+        data_crc_bytes = self._f.read(4)
+        if len(data_crc_bytes) != 4:
+            raise CorruptRecordError("truncated data crc")
+        (data_crc,) = struct.unpack("<I", data_crc_bytes)
         if data_crc != masked_crc(data):
             raise CorruptRecordError("data crc mismatch")
         return data

@@ -1,0 +1,50 @@
+"""Minimal linear-regression model + serving builder.
+
+The pipeline-API acceptance model: the reference's ``test_pipeline.py``
+validated TFEstimator/TFModel end-to-end with a known-weights linear
+regression (features · [3.14, 1.618], reference: test/test_pipeline.py:91-170).
+This module is that workload's TPU home, and doubles as the smallest
+example of the serving-export contract
+(:mod:`tensorflowonspark_tpu.serving`): ``serving_builder`` is the
+``model_ref`` target a serving export names in its metadata.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(dim, rng=None):
+    """Zero-initialized weights/bias for ``dim`` input features."""
+    del rng  # deterministic init; linear least squares is convex
+    return {"w": jnp.zeros((dim,), jnp.float32), "b": jnp.zeros((), jnp.float32)}
+
+
+def apply(params, x):
+    """``x @ w + b`` for a ``[batch, dim]`` feature matrix."""
+    return jnp.dot(x, params["w"]) + params["b"]
+
+
+def loss_fn(params, batch):
+    """Mean-squared error over ``{"features", "label"}`` columns."""
+    pred = apply(params, batch["features"])
+    label = jnp.reshape(batch["label"], pred.shape)
+    return jnp.mean((pred - label) ** 2)
+
+
+def serving_builder(params, config):
+    """``model_ref`` target: build ``predict(batch) -> outputs`` from
+    exported params (see serving.load_predictor).  ``config`` may name
+    the feature input column (default ``"features"``)."""
+    feature_key = config.get("input_name", "features")
+    params = jax.tree.map(jnp.asarray, params)
+
+    @jax.jit
+    def _predict(x):
+        return apply(params, x.astype(jnp.float32))
+
+    def predict(batch):
+        out = _predict(jnp.asarray(batch[feature_key]))
+        return {"prediction": np.asarray(out)}
+
+    return predict

@@ -1,0 +1,154 @@
+"""Pipeline API tests (reference: test/test_pipeline.py).
+
+Unit half: Namespace / param merging (reference: test_pipeline.py:48-89).
+Integration half: the reference's known-weights linear-regression
+end-to-end — fit a TFEstimator on features·[3.14, 1.618] over a real
+2-executor cluster, export for serving, transform with the TFModel and
+check predictions (reference: test_pipeline.py:91-170).
+"""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.pipeline import (
+    Namespace,
+    TFEstimator,
+    TFModel,
+    TFParams,
+)
+
+W_TRUE = np.array([3.14, 1.618], np.float32)
+
+
+# --- unit: Namespace / params ------------------------------------------
+
+
+def test_namespace_from_dict_and_kwargs():
+    n = Namespace({"a": 1, "b": 2}, c=3)
+    assert n.a == 1 and n.b == 2 and n.c == 3
+    assert "a" in n and "z" not in n
+    assert sorted(n) == ["a", "b", "c"]
+
+
+def test_namespace_from_namespace():
+    import argparse
+
+    src = argparse.Namespace(x=10)
+    n = Namespace(src)
+    assert n.x == 10
+    assert Namespace({"x": 10}) == n
+
+
+def test_namespace_rejects_garbage():
+    with pytest.raises(ValueError):
+        Namespace(42)
+
+
+def test_param_setters_chain_and_merge():
+    est = TFEstimator(lambda a, c: None, {"base": 1, "epochs": 99})
+    out = est.setEpochs(3).setBatchSize(16).setClusterSize(2)
+    assert out is est
+    assert est.getEpochs() == 3
+    assert est.getBatchSize() == 16
+    args = est.merge_args_params()
+    # params override user args (reference: pipeline.py:343-348)
+    assert args.epochs == 3 and args.base == 1
+    # defaults fill unset params
+    assert args.num_ps == 0 and args.reservation_timeout == 600
+
+
+def test_merge_does_not_mutate_source_args():
+    est = TFEstimator(lambda a, c: None, {"epochs": 99})
+    est.setEpochs(5)
+    est.merge_args_params()
+    assert est.args.epochs == 99
+
+
+def test_model_requires_export_dir_and_mapping():
+    m = TFModel({})
+    with pytest.raises(ValueError):
+        m.transform([{"x": 1}])
+    m.setExportDir("/tmp/nope")
+    with pytest.raises(ValueError):
+        m.transform([{"x": 1}])
+
+
+# --- integration: known-weights linear regression ----------------------
+
+
+def _linreg_train_fn(args, ctx):
+    """Consume the feed, SGD a linear model to the known weights, and
+    export for serving from worker:0 (the chief role,
+    reference: test_pipeline.py:106-140)."""
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import save_for_serving
+    from tensorflowonspark_tpu.models import linear
+
+    feed = ctx.get_data_feed(
+        train_mode=True, input_mapping=args.input_mapping
+    )
+    params = linear.init_params(2)
+    tx = optax.adam(0.1)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(linear.loss_fn)(params, batch)
+        updates, opt_state = tx.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    for batch in feed.batches(args.batch_size):
+        data = {
+            "features": batch["x"].astype("float32"),
+            "label": batch["y"].astype("float32"),
+        }
+        params, opt_state, loss = step(params, opt_state, data)
+
+    if ctx.job_name == "worker" and ctx.task_index == 0:
+        save_for_serving(
+            args.export_dir,
+            jax.tree.map(np.asarray, params),
+            extra_metadata={
+                "model_ref": "tensorflowonspark_tpu.models.linear:serving_builder",
+                "model_config": {"input_name": "features"},
+            },
+        )
+
+
+def test_estimator_fit_then_model_transform(tmp_path):
+    rng = np.random.RandomState(0)
+    feats = rng.uniform(-1, 1, size=(512, 2)).astype(np.float32)
+    labels = feats @ W_TRUE
+    rows = [
+        {"x": feats[i].tolist(), "y": [float(labels[i])]}
+        for i in range(len(feats))
+    ]
+
+    export_dir = str(tmp_path / "export")
+    est = (
+        TFEstimator(_linreg_train_fn, {"user_arg": 1})
+        .setInputMapping({"x": "features", "y": "label"})
+        .setClusterSize(2)
+        .setEpochs(10)
+        .setBatchSize(32)
+        .setExportDir(export_dir)
+        .setGraceSecs(1)
+        .setFeedTimeout(120)
+    )
+    model = est.fit(rows)
+    assert isinstance(model, TFModel)
+    assert model.getExportDir() == export_dir
+
+    # transform: features [1, 1] → 3.14 + 1.618 = 4.758
+    # (the reference's exact acceptance value, test_pipeline.py:168-170)
+    test_rows = [{"x": [1.0, 1.0]}, {"x": [2.0, 0.0]}, {"x": [0.0, 1.0]}]
+    model.setInputMapping({"x": "features"})
+    model.setOutputMapping({"prediction": "pred"})
+    out = model.transform(test_rows)
+    assert len(out) == 3
+    preds = [float(np.ravel(r["pred"])[0]) for r in out]
+    assert preds[0] == pytest.approx(4.758, abs=0.05)
+    assert preds[1] == pytest.approx(6.28, abs=0.1)
+    assert preds[2] == pytest.approx(1.618, abs=0.05)
