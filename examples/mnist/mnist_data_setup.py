@@ -1,0 +1,84 @@
+"""Prepare MNIST-shaped data as TFRecords (+ optional CSV).
+
+Role parity with the reference's ``examples/mnist/mnist_data_setup.py``
+(reference: examples/mnist/mnist_data_setup.py:38-62), which pulled
+MNIST via tfds on the Spark driver and wrote CSV + TFRecords to HDFS.
+This environment has no egress, so the default is a *synthetic*
+learnable MNIST stand-in (class-dependent bright patch + noise) — the
+same role as the reference resnet example's synthetic-data path
+(reference: examples/resnet/common.py:315-363).  Real MNIST arrays can
+be supplied with ``--from_npz`` (a local ``mnist.npz``).
+
+Output layout: ``<output>/train`` and ``<output>/test`` directories of
+TFRecord shards with features ``image: array<float>[784]``,
+``label: long``.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+from tensorflowonspark_tpu.data import interchange  # noqa: E402
+
+
+def synthetic_mnist(n, seed=0):
+    """Learnable synthetic digits: label k lights a 7x4 patch at column
+    block k of the 28x28 canvas, plus noise."""
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 10, size=n).astype(np.int64)
+    images = rng.uniform(0.0, 0.3, size=(n, 28, 28)).astype(np.float32)
+    for i, k in enumerate(labels):
+        r, c = divmod(int(k), 5)
+        images[i, 7 + r * 10 : 14 + r * 10, c * 5 : c * 5 + 4] += 0.7
+    return images.reshape(n, 784), labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--output", default="data/mnist")
+    p.add_argument("--num_train", type=int, default=10000)
+    p.add_argument("--num_test", type=int, default=1000)
+    p.add_argument("--num_shards", type=int, default=10)
+    p.add_argument("--from_npz", default=None,
+                   help="path to a local mnist.npz (x_train/y_train/x_test/y_test)")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+
+    if args.from_npz:
+        with np.load(args.from_npz) as d:
+            splits = {
+                "train": (
+                    d["x_train"].reshape(len(d["x_train"]), 784) / 255.0,
+                    d["y_train"].astype(np.int64),
+                ),
+                "test": (
+                    d["x_test"].reshape(len(d["x_test"]), 784) / 255.0,
+                    d["y_test"].astype(np.int64),
+                ),
+            }
+    else:
+        splits = {
+            "train": synthetic_mnist(args.num_train, args.seed),
+            "test": synthetic_mnist(args.num_test, args.seed + 1),
+        }
+
+    for split, (x, y) in splits.items():
+        rows = (
+            {"image": x[i].astype(np.float32), "label": int(y[i])}
+            for i in range(len(x))
+        )
+        out = os.path.join(args.output, split)
+        n = interchange.save_as_tfrecords(
+            rows, out, num_shards=args.num_shards
+        )
+        print("wrote {0} records to {1}".format(n, out))
+
+
+if __name__ == "__main__":
+    main()

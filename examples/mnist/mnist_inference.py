@@ -1,0 +1,95 @@
+"""Parallel batch inference: N independent single-node instances.
+
+Reference-parity app for ``examples/mnist/keras/mnist_inference.py``
+(reference: examples/mnist/keras/mnist_inference.py:79 uses
+``TFParallel.run`` to fan independent SavedModel sessions across
+executors).  Here each instance loads the serving export, predicts its
+slice of the TFRecord shards, and writes a part file.
+
+Run (after mnist_data_setup.py and one of the training examples):
+    JAX_PLATFORMS=cpu python examples/mnist/mnist_inference.py \
+        --cluster_size 2 --export_dir mnist_export
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+
+def main_fun(args, ctx):
+    import glob
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.data import interchange
+
+    files = sorted(glob.glob(os.path.join(args.images_labels, "*")))
+    files = [
+        f
+        for i, f in enumerate(files)
+        if i % args.cluster_size == ctx.executor_id
+    ]
+    if not files:
+        return 0
+
+    predict = serving.load_predictor(args.export_dir)
+    os.makedirs(args.output, exist_ok=True)
+    out_path = os.path.join(
+        args.output, "part-{0:05d}".format(ctx.executor_id)
+    )
+    total = correct = 0
+    with open(out_path, "w") as f:
+        for path in files:
+            rows, _ = interchange.load_tfrecords(path)
+            for out in serving.predict_rows(
+                predict,
+                rows,
+                input_mapping={"image": "image"},
+                output_mapping={"prediction": "prediction"},
+                batch_size=args.batch_size,
+            ):
+                f.write("{0}\n".format(int(out["prediction"])))
+            labels = [int(np.ravel(r["label"])[0]) for r in rows]
+            preds = [
+                int(o["prediction"])
+                for o in serving.predict_rows(
+                    predict, rows, {"image": "image"},
+                    {"prediction": "prediction"}, args.batch_size,
+                )
+            ]
+            correct += sum(int(a == b) for a, b in zip(preds, labels))
+            total += len(labels)
+    acc = correct / max(1, total)
+    print("instance %d: %d records, accuracy %.3f" % (ctx.executor_id, total, acc))
+    return acc
+
+
+def main():
+    from tensorflowonspark_tpu import setup_logging
+    from tensorflowonspark_tpu.cluster import parallel_run
+
+    setup_logging()
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--images_labels", default="data/mnist/test")
+    p.add_argument("--export_dir", default="mnist_export")
+    p.add_argument("--output", default="mnist_predictions")
+    args = p.parse_args()
+    args.images_labels = os.path.abspath(args.images_labels)
+    args.export_dir = os.path.abspath(args.export_dir)
+    args.output = os.path.abspath(args.output)
+
+    results = parallel_run.run(
+        args.cluster_size, main_fun, args, num_executors=args.cluster_size
+    )
+    print("per-instance accuracies:", results)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,100 @@
+"""MNIST with asynchronous parameter-server training.
+
+Reference-parity app for the async-PS configuration of
+``examples/mnist/estimator/mnist_spark_streaming.py`` (reference:
+examples/mnist/estimator/mnist_spark_streaming.py:88,141-144 —
+``ParameterServerStrategy`` with ``num_ps=1``).  TPUs have no PS
+runtime, so this drives the framework's own
+:mod:`tensorflowonspark_tpu.parallel.ps`: ps nodes host parameter
+shards + the optimizer; workers compute grads on their chips and
+push/pull asynchronously.
+
+Run (CPU smoke):
+    JAX_PLATFORMS=cpu python examples/mnist/mnist_ps.py \
+        --cluster_size 3 --num_ps 1 --steps 60
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import mlp
+    from tensorflowonspark_tpu.parallel import ps
+
+    if ctx.job_name == "ps":
+        # the server.join() role (reference: TFNode.py:120-129)
+        ps.run_server(ctx)
+        return
+
+    from mnist_data_setup import synthetic_mnist
+
+    x, y = synthetic_mnist(2048, seed=ctx.task_index)
+    model = mlp.MNISTNet(hidden=128)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    def loss(params, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        logp = jax.nn.log_softmax(logits)
+        import jax.numpy as jnp
+
+        nll = -jnp.take_along_axis(
+            logp, batch["label"].astype(jnp.int32)[:, None], axis=-1
+        )[:, 0]
+        return jnp.mean(nll)
+
+    trainer = ps.AsyncTrainer(
+        loss,
+        ctx.cluster_spec["ps"],
+        optimizer=("adam", {"learning_rate": 1e-3}),
+    )
+    live = trainer.init(params)
+    for i in range(args.steps):
+        lo = (i * args.batch_size) % (len(x) - args.batch_size)
+        batch = {
+            "image": x[lo : lo + args.batch_size],
+            "label": y[lo : lo + args.batch_size],
+        }
+        live = trainer.step(live, batch)
+        if i % 10 == 0:
+            print(
+                "worker %d step %d loss %.4f"
+                % (ctx.task_index, i, float(loss(live, batch)))
+            )
+    trainer.stop()
+
+
+def main():
+    from tensorflowonspark_tpu import setup_logging
+    from tensorflowonspark_tpu.cluster import cluster as tfcluster
+
+    setup_logging()
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=3)
+    p.add_argument("--num_ps", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=60)
+    args = p.parse_args()
+
+    cluster = tfcluster.run(
+        args.cluster_size,
+        main_fun,
+        args,
+        num_executors=args.cluster_size,
+        num_ps=args.num_ps,
+        input_mode=tfcluster.InputMode.TENSORFLOW,
+    )
+    cluster.shutdown()
+    print("async PS training complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,121 @@
+"""MNIST training, InputMode.TENSORFLOW: each node reads its own data.
+
+Reference-parity app for ``examples/mnist/keras/mnist_tf_ds.py``
+(reference: examples/mnist/keras/mnist_tf_ds.py:42 reads TFRecord
+shards from HDFS via ``ctx.absolute_path``).  Here each worker reads
+its shard-slice of the TFRecord directory through the native codec and
+trains on its own chips; no driver-side feeding job exists in this
+mode (reference: TFCluster.py InputMode.TENSORFLOW semantics).
+
+Run (CPU smoke):
+    JAX_PLATFORMS=cpu python examples/mnist/mnist_tf.py --cluster_size 2 --steps 40
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+
+def main_fun(args, ctx):
+    import glob
+
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.checkpoint import save_for_serving
+    from tensorflowonspark_tpu.data import interchange
+    from tensorflowonspark_tpu.models import mlp
+    from tensorflowonspark_tpu.parallel import dp
+
+    ctx.initialize_distributed()
+
+    # shard files across workers by task_index (the tf.data shard(...)
+    # equivalent, reference: examples/mnist/keras/mnist_tf_ds.py:42-47)
+    data_dir = ctx.absolute_path(args.images_labels)
+    files = sorted(glob.glob(os.path.join(data_dir.replace("file://", ""), "*")))
+    files = [f for i, f in enumerate(files) if i % ctx.num_workers == ctx.task_index]
+    rows = []
+    for f in files:
+        part, _ = interchange.load_tfrecords(f)
+        rows.extend(part)
+    images = np.stack([np.asarray(r["image"], np.float32) for r in rows])
+    labels = np.asarray([int(np.ravel(r["label"])[0]) for r in rows], np.int64)
+
+    model = mlp.MNISTNet()
+    params = model.init(jax.random.PRNGKey(0), images[:1])["params"]
+    trainer = dp.SyncTrainer(mlp.loss_fn(model), optax.adam(1e-3), has_aux=True)
+    state = trainer.create_state(params)
+
+    steps = args.steps or (args.epochs * len(images) // args.batch_size)
+    rng = jax.random.PRNGKey(ctx.task_index)
+    for i in range(steps):
+        lo = (i * args.batch_size) % max(1, len(images) - args.batch_size)
+        batch = {
+            "image": images[lo : lo + args.batch_size],
+            "label": labels[lo : lo + args.batch_size],
+        }
+        rng, sub = jax.random.split(rng)
+        state, metrics = trainer.step(state, batch, sub)
+        if i % 10 == 0:
+            print(
+                "worker %d step %d loss %.4f acc %.3f"
+                % (
+                    ctx.task_index,
+                    i,
+                    float(metrics["loss"]),
+                    float(metrics["accuracy"]),
+                )
+            )
+
+    if ctx.task_index == 0:
+        save_for_serving(
+            args.export_dir,
+            jax.tree.map(np.asarray, state.params),
+            extra_metadata={
+                "model_ref": "tensorflowonspark_tpu.models.mlp:serving_builder",
+                "model_config": {"input_name": "image"},
+            },
+        )
+
+
+def main():
+    from tensorflowonspark_tpu import setup_logging
+    from tensorflowonspark_tpu.cluster import cluster as tfcluster
+
+    setup_logging()
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--steps", type=int, default=None)
+    p.add_argument("--images_labels", default="data/mnist/train")
+    p.add_argument("--export_dir", default="mnist_export")
+    args = p.parse_args()
+
+    if not os.path.isdir(args.images_labels):
+        sys.exit(
+            "no TFRecords at {0}; run mnist_data_setup.py first".format(
+                args.images_labels
+            )
+        )
+    args.images_labels = os.path.abspath(args.images_labels)
+    args.export_dir = os.path.abspath(args.export_dir)
+
+    cluster = tfcluster.run(
+        args.cluster_size,
+        main_fun,
+        args,
+        num_executors=args.cluster_size,
+        input_mode=tfcluster.InputMode.TENSORFLOW,
+    )
+    cluster.shutdown()
+    print("export written to", args.export_dir)
+
+
+if __name__ == "__main__":
+    main()

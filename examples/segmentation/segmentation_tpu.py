@@ -1,0 +1,102 @@
+"""Image segmentation (UNet) through the cluster API.
+
+Reference-parity app for ``examples/segmentation/segmentation_spark.py``
+(reference: examples/segmentation/segmentation_spark.py:19-122 — Keras
+UNet with a MobileNetV2 encoder, staged from single-node to TF_CONFIG
+to TFoS).  The dataset there (oxford_iiit_pet via tfds) needs egress,
+so this generates learnable synthetic shapes: a bright rectangle on a
+noisy background, mask = rectangle interior (3 classes like the pet
+dataset's trimap: interior / border / background).
+
+Run (CPU smoke):
+    JAX_PLATFORMS=cpu python examples/segmentation/segmentation_tpu.py \
+        --cluster_size 2 --steps 10 --image_size 32
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+
+def synthetic_shapes(n, size, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.uniform(0, 0.3, size=(n, size, size, 3)).astype(np.float32)
+    masks = np.zeros((n, size, size), np.int32)  # 0 = background
+    for i in range(n):
+        h, w = rng.randint(size // 4, size // 2, size=2)
+        r, c = rng.randint(0, size - h), rng.randint(0, size - w)
+        images[i, r : r + h, c : c + w] += 0.6
+        masks[i, r : r + h, c : c + w] = 1  # interior
+        masks[i, r, c : c + w] = 2  # border strips
+        masks[i, r + h - 1, c : c + w] = 2
+        masks[i, r : r + h, c] = 2
+        masks[i, r : r + h, c + w - 1] = 2
+    return images, masks
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import unet
+    from tensorflowonspark_tpu.parallel import dp
+
+    ctx.initialize_distributed()
+
+    x, m = synthetic_shapes(512, args.image_size, seed=ctx.task_index)
+    model = unet.UNet(num_classes=3)
+    variables = model.init(jax.random.PRNGKey(0), x[:1])
+    params = variables["params"]
+
+    trainer = dp.SyncTrainer(
+        unet.loss_fn(model), optax.adam(1e-3), has_aux=True
+    )
+    state = trainer.create_state(params)
+
+    rng = jax.random.PRNGKey(ctx.task_index)
+    for i in range(args.steps):
+        lo = (i * args.batch_size) % max(1, len(x) - args.batch_size)
+        batch = {
+            "image": x[lo : lo + args.batch_size],
+            "mask": m[lo : lo + args.batch_size],
+        }
+        rng, sub = jax.random.split(rng)
+        state, metrics = trainer.step(state, batch, sub)
+        if i % 5 == 0:
+            print(
+                "worker %d step %d loss %.4f"
+                % (ctx.task_index, i, float(metrics["loss"]))
+            )
+
+
+def main():
+    from tensorflowonspark_tpu import setup_logging
+    from tensorflowonspark_tpu.cluster import cluster as tfcluster
+
+    setup_logging()
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=16)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--image_size", type=int, default=64)
+    args = p.parse_args()
+
+    cluster = tfcluster.run(
+        args.cluster_size,
+        main_fun,
+        args,
+        num_executors=args.cluster_size,
+        input_mode=tfcluster.InputMode.TENSORFLOW,
+    )
+    cluster.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Request early termination of a running cluster from the outside.
+
+Reference-parity tool for ``examples/utils/stop_streaming.py``
+(reference: examples/utils/stop_streaming.py:12-18), which connected a
+reservation client to the driver's server and sent the STOP message so
+a streaming feed would wind down.
+
+Usage:
+    python examples/utils/stop_cluster.py <host> <port>
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+from tensorflowonspark_tpu.cluster import reservation  # noqa: E402
+
+
+def main():
+    if len(sys.argv) != 3:
+        sys.exit(__doc__)
+    host, port = sys.argv[1], int(sys.argv[2])
+    client = reservation.Client((host, port))
+    client.request_stop()
+    client.close()
+    print("stop requested at {0}:{1}".format(host, port))
+
+
+if __name__ == "__main__":
+    main()

@@ -231,12 +231,17 @@ class TPUCluster(object):
 
     def _await_compute_done(self, workers, deadline):
         pending = {w["executor_id"]: w for w in workers}
+        conns = {}  # one manager connection per worker, reused across polls
         while pending:
             for eid, w in list(pending.items()):
                 try:
-                    state = self._connect(w).get("compute_state")._getvalue()
-                except Exception:  # noqa: BLE001 - transient: retry until
-                    continue  # the deadline (managers outlive compute)
+                    m = conns.get(eid)
+                    if m is None:
+                        m = conns[eid] = self._connect(w)
+                    state = m.get("compute_state")._getvalue()
+                except Exception:  # noqa: BLE001 - transient: reconnect and
+                    conns.pop(eid, None)  # retry until the deadline
+                    continue
                 if state in ("finished", "failed"):
                     pending.pop(eid)
             if not pending:

@@ -29,17 +29,54 @@ def dataframe_to_rows(df):
     return [row.asDict() for row in df.collect()]
 
 
-def rows_to_dataframe(spark, rows, schema=None):
-    """Dict rows → DataFrame (``schema`` is an interchange schema list
-    or struct string, converted to column order)."""
+def _spark_type(typ):
+    from pyspark.sql import types as T
+
+    base_map = {
+        "binary": T.BinaryType(),
+        "boolean": T.BooleanType(),
+        "double": T.DoubleType(),
+        "float": T.FloatType(),
+        "int": T.IntegerType(),
+        "long": T.LongType(),
+        "string": T.StringType(),
+        "short": T.ShortType(),
+    }
+    if typ.startswith("array<"):
+        return T.ArrayType(base_map[typ[len("array<"):-1]])
+    return base_map[typ]
+
+
+def to_spark_schema(schema):
+    """Interchange schema (``[(name, type)]`` or struct string) →
+    ``pyspark.sql.types.StructType`` (the SimpleTypeParser role,
+    reference: SimpleTypeParser.scala:36-63)."""
     _require_pyspark()
+    from pyspark.sql import types as T
+
     from tensorflowonspark_tpu.data import interchange
 
     if isinstance(schema, str):
         schema = interchange.parse_schema(schema)
+    return T.StructType(
+        [T.StructField(name, _spark_type(typ), True) for name, typ in schema]
+    )
+
+
+def rows_to_dataframe(spark, rows, schema=None):
+    """Dict rows → DataFrame.  ``schema`` (interchange schema list or
+    struct string) carries the column *types*, so empty row sets and
+    None-valued columns don't break Spark's inference."""
+    _require_pyspark()
     if schema:
-        cols = [name for name, _ in schema]
-        rows = [{c: r.get(c) for c in cols} for r in rows]
+        spark_schema = to_spark_schema(schema)
+        cols = spark_schema.fieldNames()
+        data = [tuple(r.get(c) for c in cols) for r in rows]
+        return spark.createDataFrame(data, schema=spark_schema)
+    if not rows:
+        raise ValueError(
+            "cannot infer a DataFrame schema from zero rows; pass schema="
+        )
     return spark.createDataFrame(rows)
 
 

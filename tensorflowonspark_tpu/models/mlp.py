@@ -43,6 +43,33 @@ def logical_axes(params):
     return base.annotate(params, LOGICAL_AXES_RULES)
 
 
+def serving_builder(params, config):
+    """``model_ref`` target for serving exports of :class:`MNISTNet`
+    (see :mod:`tensorflowonspark_tpu.serving`): returns
+    ``predict(batch) -> {"logits", "prediction"}``."""
+    import numpy as np
+
+    model = MNISTNet(
+        hidden=config.get("hidden", 512),
+        num_classes=config.get("num_classes", 10),
+    )
+    input_name = config.get("input_name", "image")
+    params = jax.tree.map(jnp.asarray, params)
+
+    @jax.jit
+    def _logits(x):
+        return model.apply({"params": params}, x)
+
+    def predict(batch):
+        logits = _logits(jnp.asarray(batch[input_name]))
+        return {
+            "logits": np.asarray(logits),
+            "prediction": np.asarray(jnp.argmax(logits, axis=-1)),
+        }
+
+    return predict
+
+
 def loss_fn(model):
     """Softmax cross-entropy; batch = (images, labels) or dict."""
 

@@ -1,0 +1,41 @@
+"""Sequence/context parallelism: long-context attention over the mesh.
+
+Absent from the reference entirely (grep-verified, SURVEY.md §5
+'Long-context / sequence parallelism'); built fresh for the TPU
+framework.  Two interchangeable strategies over the ``seq`` mesh axis:
+
+- **ring attention** (:func:`ring_attention`): KV blocks rotate around
+  the ring via ``ppermute`` while each device holds its query block —
+  communication overlaps compute, memory stays O(seq/devices);
+- **Ulysses** (:func:`ulysses_attention`): all-to-all re-shards from
+  sequence-split to head-split and back — cheaper at moderate head
+  counts, one collective pair per attention.
+
+Both produce numerics matching full attention (see
+tests/test_attention.py) and compose with DP/FSDP via the mesh axes.
+"""
+
+from tensorflowonspark_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+    ring_attention_sharded,
+)
+from tensorflowonspark_tpu.ops.ulysses import (  # noqa: F401
+    ulysses_attention,
+    ulysses_attention_sharded,
+)
+
+STRATEGIES = {
+    "ring": ring_attention_sharded,
+    "ulysses": ulysses_attention_sharded,
+}
+
+
+def context_parallel_attention(q, k, v, mesh, strategy="ring", **kwargs):
+    """Dispatch sequence-parallel attention by strategy name."""
+    if strategy not in STRATEGIES:
+        raise ValueError(
+            "unknown context-parallel strategy {0!r}; options: {1}".format(
+                strategy, sorted(STRATEGIES)
+            )
+        )
+    return STRATEGIES[strategy](q, k, v, mesh, **kwargs)

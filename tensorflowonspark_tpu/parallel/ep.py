@@ -1,0 +1,19 @@
+"""Expert parallelism: MoE routing over the ``expert`` mesh axis.
+
+Absent from the reference (SURVEY.md §2.3); built fresh.  The design is
+sharding-driven: :class:`~tensorflowonspark_tpu.models.moe.MoEMLP`
+computes dense dispatch/combine einsums against expert-sharded weights,
+and XLA lowers the resharding to expert all-to-alls over ICI — no
+hand-written routing collectives to get wrong.
+
+This module is the strategy surface; the router math lives in
+:mod:`tensorflowonspark_tpu.ops.moe` and the layer in
+:mod:`tensorflowonspark_tpu.models.moe`.
+"""
+
+from tensorflowonspark_tpu.models.moe import MoEMLP, moe_loss_fn  # noqa: F401
+from tensorflowonspark_tpu.ops.moe import (  # noqa: F401
+    expert_capacity,
+    top_k_gating,
+)
+from tensorflowonspark_tpu.parallel.mesh import AXIS_EXPERT  # noqa: F401

@@ -1,0 +1,500 @@
+"""Asynchronous parameter-server data parallelism.
+
+The reference's async-DP mode delegated everything to TensorFlow's PS
+runtime: ``num_ps`` executors ran ``tf.train.Server`` processes that the
+framework kept pinned via a control-queue block, with
+``ParameterServerStrategy`` in user code (reference:
+TFSparkNode.py:409-426, TFCluster.py:186-194,
+examples/mnist/estimator/mnist_spark_streaming.py:88).  TPUs have no
+native PS runtime, so this module *is* the PS system (SURVEY.md §7
+'Hard parts: Async PS on TPU'):
+
+- **ParamServerShard** — a TCP service holding a shard of the model's
+  leaves in host memory, applying updates with its own numpy optimizer
+  (the parameter-host-over-DCN design: PS traffic rides the data-center
+  network while each worker's compute stays on its chips).
+- **PSClient** — worker-side: partitions a params pytree across shards
+  (size-balanced), then ``push_pull(grads)`` ships gradients and
+  returns fresh params in one round trip per shard (DistBelief-style
+  async SGD; no barrier between workers, stale gradients by design).
+- **run_server(ctx)** — what a ps-role node runs inside ``main_fun``
+  (the ``server.join()`` analogue, reference: TFNode.py:120-129): binds
+  the clusterspec's ps address and serves until STOP/teardown.
+- **AsyncTrainer** — worker-side convenience wrapping grad computation
+  (jit on the local chips) + push_pull.
+
+Wire protocol: 4-byte BE header length + JSON header + raw tensor
+bytes (no pickle — same hardening rationale as
+:mod:`tensorflowonspark_tpu.cluster.reservation`).  Optimizers are
+named specs (``("adam", {"learning_rate": 1e-3})``) resolved against
+the server's own numpy implementations, never deserialized code.
+Leafwise optimizers only (sgd/momentum/adagrad/adam): each shard
+updates its leaves independently, which is exact for these rules.
+"""
+
+import json
+import logging
+import socket
+import struct
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAX_HEADER = 16 * 1024 * 1024
+
+
+# ----------------------------------------------------------------------
+# framing: JSON header + raw tensor payloads
+# ----------------------------------------------------------------------
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("socket closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock, header, tensors=None):
+    """Send ``header`` (JSON-able dict) plus named numpy ``tensors``."""
+    tensors = tensors or {}
+    meta = []
+    payloads = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        meta.append(
+            {
+                "name": name,
+                "dtype": arr.dtype.str,
+                "shape": list(arr.shape),
+                "nbytes": int(arr.nbytes),
+            }
+        )
+        payloads.append(arr)
+    header = dict(header, tensors=meta)
+    hb = json.dumps(header).encode("utf-8")
+    parts = [struct.pack(">I", len(hb)), hb]
+    parts.extend(memoryview(p).cast("B") for p in payloads)
+    sock.sendall(b"".join(parts))
+
+
+def recv_msg(sock):
+    """Receive one message → ``(header, {name: np.ndarray})``."""
+    (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
+    if hlen > _MAX_HEADER:
+        raise ConnectionError("header length {0} exceeds limit".format(hlen))
+    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    tensors = {}
+    for m in header.get("tensors", ()):
+        raw = _recv_exact(sock, m["nbytes"])
+        tensors[m["name"]] = np.frombuffer(raw, dtype=np.dtype(m["dtype"])).reshape(
+            m["shape"]
+        )
+    return header, tensors
+
+
+# ----------------------------------------------------------------------
+# server-side numpy optimizers (leafwise; no code deserialization)
+# ----------------------------------------------------------------------
+
+
+class _SGD(object):
+    def __init__(self, learning_rate=0.01, momentum=0.0):
+        self.lr = learning_rate
+        self.momentum = momentum
+        self._vel = {}
+
+    def update(self, name, param, grad):
+        if self.momentum:
+            v = self._vel.get(name)
+            v = grad if v is None else self.momentum * v + grad
+            self._vel[name] = v
+            grad = v
+        return param - self.lr * grad
+
+
+class _Adagrad(object):
+    def __init__(self, learning_rate=0.01, eps=1e-10):
+        self.lr = learning_rate
+        self.eps = eps
+        self._acc = {}
+
+    def update(self, name, param, grad):
+        acc = self._acc.get(name, np.zeros_like(param)) + grad * grad
+        self._acc[name] = acc
+        return param - self.lr * grad / (np.sqrt(acc) + self.eps)
+
+
+class _Adam(object):
+    def __init__(self, learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8):
+        self.lr = learning_rate
+        self.b1, self.b2, self.eps = b1, b2, eps
+        self._m, self._v, self._t = {}, {}, {}
+
+    def update(self, name, param, grad):
+        t = self._t.get(name, 0) + 1
+        m = self.b1 * self._m.get(name, np.zeros_like(param)) + (1 - self.b1) * grad
+        v = self.b2 * self._v.get(name, np.zeros_like(param)) + (
+            1 - self.b2
+        ) * grad * grad
+        self._m[name], self._v[name], self._t[name] = m, v, t
+        mhat = m / (1 - self.b1**t)
+        vhat = v / (1 - self.b2**t)
+        return param - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+
+
+OPTIMIZERS = {"sgd": _SGD, "adagrad": _Adagrad, "adam": _Adam}
+
+
+def _build_optimizer(spec):
+    name, kwargs = spec
+    if name not in OPTIMIZERS:
+        raise ValueError(
+            "unknown PS optimizer {0!r}; supported: {1}".format(
+                name, sorted(OPTIMIZERS)
+            )
+        )
+    return OPTIMIZERS[name](**(kwargs or {}))
+
+
+# ----------------------------------------------------------------------
+# server
+# ----------------------------------------------------------------------
+
+
+class ParamServerShard(object):
+    """One PS shard: parameter store + optimizer + TCP service.
+
+    Thread-per-connection; updates serialized under a lock (each push is
+    one atomic read-modify-write, the async-SGD consistency model).
+    """
+
+    def __init__(self):
+        self._params = {}
+        self._opt = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = None
+        self.addr = None
+
+    # -- ops -----------------------------------------------------------
+
+    def _op_init(self, header, tensors):
+        with self._lock:
+            if self._opt is None:
+                self._opt = _build_optimizer(header["optimizer"])
+                self._params = {k: v.copy() for k, v in tensors.items()}
+                logger.info(
+                    "ps shard initialized: %d tensors, optimizer %s",
+                    len(tensors),
+                    header["optimizer"][0],
+                )
+            # idempotent: late initializers get the live params
+            return {"op": "init_ok"}, dict(self._params)
+
+    def _op_pull(self, header, tensors):
+        with self._lock:
+            return {"op": "pull_ok"}, dict(self._params)
+
+    def _op_push(self, header, tensors):
+        with self._lock:
+            if self._opt is None:
+                return {"op": "error", "error": "shard not initialized"}, {}
+            for name, grad in tensors.items():
+                p = self._params.get(name)
+                if p is None:
+                    return {
+                        "op": "error",
+                        "error": "unknown tensor {0}".format(name),
+                    }, {}
+                self._params[name] = self._opt.update(
+                    name, p, grad.astype(p.dtype, copy=False)
+                )
+            # piggyback fresh params: push+pull in one round trip
+            return {"op": "push_ok"}, dict(self._params)
+
+    # -- service loop --------------------------------------------------
+
+    def start(self, host="", port=0):
+        """Bind and serve in background threads; returns ``(host, port)``."""
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.addr = self._sock.getsockname()
+        t = threading.Thread(target=self._accept_loop, daemon=True, name="ps-accept")
+        t.start()
+        return self.addr
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, peer = self._sock.accept()
+            except OSError:
+                return  # socket closed
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True, name="ps-conn"
+            ).start()
+
+    def _serve_conn(self, conn):
+        ops = {"init": self._op_init, "pull": self._op_pull, "push": self._op_push}
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            while not self._stop.is_set():
+                try:
+                    header, tensors = recv_msg(conn)
+                except (ConnectionError, OSError, json.JSONDecodeError):
+                    return
+                op = header.get("op")
+                if op == "stop":
+                    send_msg(conn, {"op": "stop_ok"})
+                    self.stop()
+                    return
+                handler = ops.get(op)
+                if handler is None:
+                    send_msg(conn, {"op": "error", "error": "bad op " + repr(op)})
+                    continue
+                out_header, out_tensors = handler(header, tensors)
+                send_msg(conn, out_header, out_tensors)
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+    def join(self, timeout=None):
+        """Block until the shard is stopped (the ``server.join()`` role,
+        reference: TFNode.py:120-129)."""
+        self._stop.wait(timeout)
+
+
+def run_server(ctx, host=""):
+    """Run this ps node's shard until STOP / process teardown.
+
+    Called from ``main_fun`` when ``ctx.job_name == 'ps'`` — the
+    reference-parity usage where user code dispatched ps roles to
+    ``server.join()`` (reference: TFNode.py:120-129).  The shard binds
+    the port the clusterspec advertises for this ps task, so workers
+    find it at ``ctx.cluster_spec['ps'][task_index]``.
+    """
+    addr = ctx.cluster_spec["ps"][ctx.task_index]
+    port = int(addr.rsplit(":", 1)[1])
+    shard = ParamServerShard()
+    shard.start(host, port)
+    logger.info("ps shard %d serving at %s", ctx.task_index, shard.addr)
+    shard.join()
+
+
+# ----------------------------------------------------------------------
+# client
+# ----------------------------------------------------------------------
+
+
+def _flatten(params):
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    return [np.asarray(x) for x in leaves], treedef
+
+
+class PSClient(object):
+    """Worker-side connection to every PS shard.
+
+    Args:
+      addresses: list of ``"host:port"`` (``ctx.cluster_spec['ps']``).
+      timeout: per-socket timeout (secs).
+    """
+
+    def __init__(self, addresses, timeout=60):
+        self.addresses = list(addresses)
+        self._socks = []
+        for a in self.addresses:
+            host, _, port = a.rpartition(":")
+            # Retry refused connections until the deadline: workers race
+            # the ps shards' startup (the shard binds in a background
+            # compute process after the rendezvous barrier releases).
+            import time as _time
+
+            deadline = _time.monotonic() + timeout
+            while True:
+                try:
+                    s = socket.create_connection(
+                        (host, int(port)),
+                        timeout=max(1.0, deadline - _time.monotonic()),
+                    )
+                    break
+                except (ConnectionRefusedError, socket.timeout, OSError):
+                    if _time.monotonic() >= deadline:
+                        raise
+                    _time.sleep(0.2)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._socks.append(s)
+        self._treedef = None
+        self._assignment = None  # leaf index -> shard index
+        self._shapes = None
+
+    # -- sharding ------------------------------------------------------
+
+    def _assign(self, leaves):
+        """Size-balanced greedy leaf→shard assignment (deterministic)."""
+        order = sorted(
+            range(len(leaves)), key=lambda i: (-leaves[i].nbytes, i)
+        )
+        load = [0] * len(self._socks)
+        assignment = [0] * len(leaves)
+        for i in order:
+            shard = min(range(len(load)), key=lambda s: (load[s], s))
+            assignment[i] = shard
+            load[shard] += max(1, leaves[i].nbytes)
+        return assignment
+
+    def _shard_tensors(self, leaves):
+        per_shard = [dict() for _ in self._socks]
+        for i, leaf in enumerate(leaves):
+            per_shard[self._assignment[i]]["t{0}".format(i)] = leaf
+        return per_shard
+
+    def _unshard(self, replies):
+        flat = {}
+        for tensors in replies:
+            flat.update(tensors)
+        import jax
+
+        leaves = [flat["t{0}".format(i)] for i in range(len(self._assignment))]
+        return jax.tree_util.tree_unflatten(self._treedef, leaves)
+
+    # -- round trips ---------------------------------------------------
+
+    def _roundtrip_all(self, headers, per_shard_tensors):
+        """One request per shard, in parallel threads; returns replies."""
+        replies = [None] * len(self._socks)
+        errors = []
+
+        def _one(i):
+            try:
+                send_msg(self._socks[i], headers[i], per_shard_tensors[i])
+                header, tensors = recv_msg(self._socks[i])
+                if header.get("op") == "error":
+                    raise RuntimeError("ps shard {0}: {1}".format(i, header["error"]))
+                replies[i] = tensors
+            except Exception as e:  # noqa: BLE001 - collected and re-raised
+                errors.append((i, e))
+
+        threads = [
+            threading.Thread(target=_one, args=(i,), daemon=True)
+            for i in range(len(self._socks))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError(
+                "PS round trip failed: "
+                + "; ".join("shard {0}: {1}".format(i, e) for i, e in errors)
+            )
+        return replies
+
+    def init(self, params, optimizer=("sgd", {"learning_rate": 0.01})):
+        """Initialize (or join) the PS ensemble; returns the live params.
+
+        Idempotent across workers: the first ``init`` seeds the shards,
+        later ones receive the current values — the chief/worker race is
+        harmless by construction.
+        """
+        leaves, self._treedef = _flatten(params)
+        self._shapes = [x.shape for x in leaves]
+        self._assignment = self._assign(leaves)
+        per_shard = self._shard_tensors(leaves)
+        headers = [
+            {"op": "init", "optimizer": [optimizer[0], optimizer[1] or {}]}
+            for _ in self._socks
+        ]
+        return self._unshard(self._roundtrip_all(headers, per_shard))
+
+    def pull(self):
+        """Fetch current params from all shards.  Requires a prior
+        :meth:`init` on this client (it defines the pytree structure and
+        leaf→shard assignment; init is idempotent, so calling it with a
+        params template is the way to *join* a live ensemble)."""
+        if self._assignment is None:
+            raise RuntimeError(
+                "call init(params_template, optimizer) before pull()/"
+                "push_pull(): it defines the leaf->shard assignment "
+                "(idempotent; the template does not overwrite live params)"
+            )
+        headers = [{"op": "pull"} for _ in self._socks]
+        return self._unshard(self._roundtrip_all(headers, [{}] * len(self._socks)))
+
+    def push_pull(self, grads):
+        """Ship gradients, get fresh params back (one async-SGD step)."""
+        leaves, _ = _flatten(grads)
+        per_shard = self._shard_tensors(leaves)
+        headers = [{"op": "push"} for _ in self._socks]
+        return self._unshard(self._roundtrip_all(headers, per_shard))
+
+    def stop(self):
+        """Stop every shard (end of training; the driver's control-queue
+        teardown is the backstop, reference: TFCluster.py:186-194)."""
+        for s in self._socks:
+            try:
+                send_msg(s, {"op": "stop"})
+                recv_msg(s)
+            except (ConnectionError, OSError):
+                pass
+        self.close()
+
+    def close(self):
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# ----------------------------------------------------------------------
+# worker-side trainer
+# ----------------------------------------------------------------------
+
+
+class AsyncTrainer(object):
+    """Async-PS worker loop: local grads on this node's chips, updates on
+    the parameter hosts.
+
+    Args:
+      loss_fn: ``loss_fn(params, batch) -> scalar``.
+      ps_addresses: ``ctx.cluster_spec['ps']``.
+      optimizer: named spec, e.g. ``("adam", {"learning_rate": 1e-3})``.
+    """
+
+    def __init__(self, loss_fn, ps_addresses, optimizer=("sgd", {"learning_rate": 0.01})):
+        import jax
+
+        self.client = PSClient(ps_addresses)
+        self.optimizer = optimizer
+        self._grad_fn = jax.jit(jax.grad(loss_fn))
+
+    def init(self, params):
+        return self.client.init(params, self.optimizer)
+
+    def step(self, params, batch):
+        """One async step; returns fresh params (stale-gradient model:
+        grads computed at ``params`` may land after other workers')."""
+        grads = self._grad_fn(params, batch)
+        return self.client.push_pull(grads)
+
+    def stop(self, stop_servers=False):
+        if stop_servers:
+            self.client.stop()
+        else:
+            self.client.close()

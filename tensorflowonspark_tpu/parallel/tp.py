@@ -1,0 +1,47 @@
+"""Tensor parallelism: sharded-matmul strategy surface.
+
+The reference had no TP implementation — its README's "model
+parallelism" claim rested on arbitrary clusterspec job names letting
+TF1 users place ops by device scope (reference: README.md:45, SURVEY.md
+§2.3).  Here TP is a first-class mesh program: parameters carry logical
+axis names, a rule set maps them onto the ``model`` mesh axis, and XLA
+inserts the all-reduces over ICI.
+
+This module is the strategy-level API; the mechanics live in
+:mod:`tensorflowonspark_tpu.parallel.sharding` (rule application) and
+the model zoo's logical annotations.  Megatron-style pairing: shard the
+up-projection column-wise (``ffn_in``), the down-projection row-wise
+(``ffn_out``), attention heads across ``model`` — one psum per block.
+"""
+
+from tensorflowonspark_tpu.parallel.mesh import AXIS_TENSOR  # noqa: F401
+from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
+    apply_rules,
+    param_specs,
+    shard_params,
+)
+
+#: Megatron-style rule set for the model zoo's logical axis names:
+#: embed stays replicated across ``model``; FFN in/out split col/row;
+#: attention heads split across ``model``.
+TP_RULES = (
+    ("embed", None),
+    ("vocab", "model"),
+    ("heads", "model"),
+    ("kv", None),
+    ("ffn", "model"),
+    ("seq", None),
+)
+
+
+def tensor_parallel_specs(abstract_params, mesh, rules=TP_RULES, annotations=None):
+    """PartitionSpecs placing params for TP on ``mesh``'s ``model`` axis.
+
+    Args:
+      abstract_params: pytree of ShapeDtypeStructs (or arrays).
+      mesh: a Mesh with a ``model`` axis (see
+        :func:`tensorflowonspark_tpu.parallel.mesh.build_mesh`).
+      rules: (logical_axis, mesh_axis) pairs.
+      annotations: optional explicit logical specs per leaf path.
+    """
+    return param_specs(abstract_params, rules, mesh=mesh, annotations=annotations)

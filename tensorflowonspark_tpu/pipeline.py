@@ -221,7 +221,8 @@ def _to_partitions(dataset, num_partitions, columns=None):
 
         dataset = spark_io.dataframe_to_rows(dataset)
     rows = list(dataset)
-    if rows and isinstance(rows[0], (list,)) and not isinstance(rows[0], tuple):
+    if rows and isinstance(rows[0], list):
+        # already partitioned: a list of row-lists (dict/tuple rows)
         partitions = [list(p) for p in rows]
     else:
         num_partitions = max(1, num_partitions)
@@ -290,10 +291,18 @@ class TFEstimator(TFParams, *_ESTIMATOR_MIXINS):
 
             def train_fn(a, ctx, _inner=self.train_fn):  # noqa: F811
                 result = _inner(a, ctx)
-                # chief-only export (reference: compat.py:10-17 semantics)
-                if ctx.job_name in ("chief", "master") or (
-                    ctx.job_name == "worker" and ctx.task_index == 0
-                ):
+                # exactly-one-exporter: the dedicated chief when one
+                # exists, else worker:0 (reference: compat.py:10-17;
+                # same XOR as the tensorboard-node rule, node.py)
+                has_chief = any(
+                    j in ctx.cluster_spec for j in ("chief", "master")
+                )
+                is_exporter = (
+                    ctx.job_name in ("chief", "master")
+                    if has_chief
+                    else (ctx.job_name == "worker" and ctx.task_index == 0)
+                )
+                if is_exporter:
                     export_fn(a, ctx)
                 return result
 
@@ -341,7 +350,12 @@ def _run_model(rows, args, predictor_builder=None):
     ``_run_model_tf2``); runs inside an executor process."""
     from tensorflowonspark_tpu import serving
 
-    key = (args.export_dir, args.signature_def_key, args.tag_set)
+    key = (
+        args.export_dir,
+        args.signature_def_key,
+        args.tag_set,
+        serving._builder_key(predictor_builder),
+    )
     if _TRANSFORM_STATE["key"] != key:
         logger.info("loading predictor for %s", key)
         _TRANSFORM_STATE["predict"] = serving.load_predictor(

@@ -36,11 +36,27 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-#: Per-process predictor cache keyed by (export_dir, builder identity) —
+#: Per-process predictor cache keyed by (export_dir, builder digest) —
 #: the reference cached one SavedModelBundle per executor JVM
 #: (TFModel.scala:24-29,257-263) / one session per python worker
 #: (pipeline.py:492-496).
 _PREDICTOR_CACHE = {}
+
+
+def _builder_key(builder):
+    """Content digest of a builder callable, stable across pickling —
+    ``id()`` would miss on every per-job unpickled copy and can collide
+    after GC address reuse."""
+    if builder is None:
+        return None
+    import hashlib
+
+    try:
+        import cloudpickle as _cp
+
+        return hashlib.sha256(_cp.dumps(builder)).hexdigest()
+    except Exception:  # noqa: BLE001 - unpicklable builder: don't cache
+        return object()  # unique → never a cache hit
 
 
 def resolve_ref(ref):
@@ -68,7 +84,7 @@ def load_predictor(export_dir, builder=None, use_cache=True):
         (the per-process singleton the reference kept,
         TFModel.scala:257-263).
     """
-    key = (os.path.abspath(os.fspath(export_dir)), id(builder) if builder else None)
+    key = (os.path.abspath(os.fspath(export_dir)), _builder_key(builder))
     if use_cache and key in _PREDICTOR_CACHE:
         return _PREDICTOR_CACHE[key]
 
@@ -141,11 +157,15 @@ def predict_rows(
         out = predict(batch)
         out = {k: np.asarray(v)[:n] for k, v in out.items()}
         if output_mapping:
-            out = {
-                col: out[name]
-                for name, col in output_mapping.items()
-                if name in out
-            }
+            missing = [n_ for n_ in output_mapping if n_ not in out]
+            if missing:
+                # fail fast like the reference's signature lookup
+                # (pipeline.py:559-564), not silent empty rows
+                raise KeyError(
+                    "output_mapping names {0} not produced by the "
+                    "predictor (outputs: {1})".format(missing, sorted(out))
+                )
+            out = {col: out[name] for name, col in output_mapping.items()}
         for i in range(n):
             yield {k: v[i] for k, v in out.items()}
 

@@ -1,0 +1,217 @@
+"""Async parameter-server tests.
+
+Unit: wire framing, shard ops, idempotent init, optimizer math.
+Integration: multi-threaded async workers converging a quadratic, and a
+full cluster run with a real ps node (the reference's async-PS config,
+reference: examples/mnist/estimator/mnist_spark_streaming.py:88,141-144).
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.parallel import ps
+
+
+# --- framing -----------------------------------------------------------
+
+
+def test_framing_roundtrip():
+    a, b = socket.socketpair()
+    tensors = {
+        "x": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "y": np.array([1, 2, 3], dtype=np.int64),
+        "empty": np.zeros((0,), np.float32),
+    }
+    ps.send_msg(a, {"op": "push", "k": 1}, tensors)
+    header, got = ps.recv_msg(b)
+    assert header["op"] == "push" and header["k"] == 1
+    assert set(got) == set(tensors)
+    for k in tensors:
+        assert got[k].dtype == tensors[k].dtype
+        np.testing.assert_array_equal(got[k], tensors[k])
+    a.close()
+    b.close()
+
+
+# --- numpy optimizers --------------------------------------------------
+
+
+def test_sgd_matches_formula():
+    opt = ps._SGD(learning_rate=0.5)
+    p = np.array([1.0, 2.0])
+    g = np.array([0.2, -0.4])
+    np.testing.assert_allclose(opt.update("a", p, g), p - 0.5 * g)
+
+
+def test_adam_first_step_is_lr_sign():
+    opt = ps._Adam(learning_rate=0.1)
+    p = np.zeros(3)
+    g = np.array([1.0, -2.0, 0.5])
+    out = opt.update("a", p, g)
+    # bias-corrected first adam step ≈ -lr * sign(g)
+    np.testing.assert_allclose(out, -0.1 * np.sign(g), atol=1e-6)
+
+
+def test_unknown_optimizer_rejected():
+    with pytest.raises(ValueError):
+        ps._build_optimizer(("magic", {}))
+
+
+# --- shard service -----------------------------------------------------
+
+
+@pytest.fixture()
+def shards():
+    servers = [ps.ParamServerShard() for _ in range(2)]
+    addrs = []
+    for s in servers:
+        host, port = s.start("127.0.0.1", 0)
+        addrs.append("127.0.0.1:{0}".format(port))
+    yield servers, addrs
+    for s in servers:
+        s.stop()
+
+
+def test_init_pull_push(shards):
+    _, addrs = shards
+    client = ps.PSClient(addrs)
+    params = {"w": np.ones((4,), np.float32), "b": np.zeros((), np.float32)}
+    live = client.init(params, ("sgd", {"learning_rate": 0.1}))
+    np.testing.assert_allclose(live["w"], params["w"])
+
+    grads = {"w": np.full((4,), 2.0, np.float32), "b": np.float32(1.0)}
+    new = client.push_pull(grads)
+    np.testing.assert_allclose(new["w"], 1.0 - 0.1 * 2.0)
+    np.testing.assert_allclose(new["b"], -0.1)
+
+    pulled = client.pull()
+    np.testing.assert_allclose(pulled["w"], new["w"])
+    client.close()
+
+
+def test_init_is_idempotent_across_workers(shards):
+    _, addrs = shards
+    c1 = ps.PSClient(addrs)
+    c2 = ps.PSClient(addrs)
+    p0 = {"w": np.full((3,), 7.0, np.float32)}
+    c1.init(p0, ("sgd", {"learning_rate": 0.1}))
+    c1.push_pull({"w": np.ones((3,), np.float32)})
+    # second worker's init must NOT reset the trained params
+    live = c2.init({"w": np.zeros((3,), np.float32)}, ("sgd", {"learning_rate": 0.1}))
+    np.testing.assert_allclose(live["w"], 6.9)
+    c1.close()
+    c2.close()
+
+
+def test_push_before_init_errors(shards):
+    _, addrs = shards
+    client = ps.PSClient(addrs)
+    client._treedef = None
+    with pytest.raises(RuntimeError):
+        # craft a raw push against uninitialized shards
+        ps.send_msg(client._socks[0], {"op": "push"}, {"t0": np.ones(2)})
+        header, _ = ps.recv_msg(client._socks[0])
+        if header.get("op") == "error":
+            raise RuntimeError(header["error"])
+    client.close()
+
+
+def test_async_workers_converge(shards):
+    # 4 concurrent workers minimize ||w - target||^2 via async sgd
+    _, addrs = shards
+    target = np.array([3.14, 1.618, -2.0, 0.5], np.float32)
+    seed = ps.PSClient(addrs)
+    seed.init({"w": np.zeros(4, np.float32)}, ("sgd", {"learning_rate": 0.05}))
+    seed.close()
+
+    def worker():
+        c = ps.PSClient(addrs)
+        # init is idempotent: joins the live ensemble (template ignored)
+        p = c.init({"w": np.zeros(4, np.float32)}, ("sgd", {"learning_rate": 0.05}))
+        for _ in range(100):
+            g = 2.0 * (p["w"] - target)
+            p = c.push_pull({"w": g.astype(np.float32)})
+        c.close()
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    final = ps.PSClient(addrs)
+    final.init({"w": np.zeros(4, np.float32)}, ("sgd", {"learning_rate": 0.05}))
+    out = final.pull()
+    np.testing.assert_allclose(out["w"], target, atol=1e-2)
+    final.close()
+
+
+def test_stop_op_stops_shard():
+    shard = ps.ParamServerShard()
+    host, port = shard.start("127.0.0.1", 0)
+    c = ps.PSClient(["127.0.0.1:{0}".format(port)])
+    c.stop()
+    shard.join(timeout=5)
+    assert shard._stop.is_set()
+
+
+def test_size_balanced_assignment():
+    c = ps.PSClient.__new__(ps.PSClient)
+    c._socks = [None, None, None]
+    leaves = [np.zeros(100), np.zeros(90), np.zeros(10), np.zeros(5), np.zeros(5)]
+    assignment = c._assign(leaves)
+    loads = [0, 0, 0]
+    for i, s in enumerate(assignment):
+        loads[s] += leaves[i].nbytes
+    assert max(loads) <= 100 * 8  # biggest leaf alone on one shard
+
+
+# --- cluster integration ----------------------------------------------
+
+
+def _ps_main_fun(args, ctx):
+    """Reference-parity dispatch: ps joins the server, workers train
+    (reference user pattern: TFNode.py:120-129 + estimator examples)."""
+    import numpy as np
+
+    from tensorflowonspark_tpu.parallel import ps as ps_mod
+
+    if ctx.job_name == "ps":
+        ps_mod.run_server(ctx)
+        return
+
+    target = np.array([3.14, 1.618], np.float32)
+    client = ps_mod.PSClient(ctx.cluster_spec["ps"])
+    p = client.init(
+        {"w": np.zeros(2, np.float32)}, ("sgd", {"learning_rate": 0.05})
+    )
+    for _ in range(150):
+        g = 2.0 * (p["w"] - target)
+        p = client.push_pull({"w": g.astype(np.float32)})
+    final = client.pull()
+    client.close()
+    err = float(np.abs(final["w"] - target).max())
+    assert err < 1e-2, "async PS failed to converge: {0}".format(final["w"])
+
+
+def test_cluster_with_ps_node():
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+    from tensorflowonspark_tpu.engine import LocalEngine
+
+    engine = LocalEngine(3)
+    try:
+        cluster = tpu_cluster.run(
+            engine,
+            _ps_main_fun,
+            args={},
+            num_executors=3,
+            num_ps=1,
+            input_mode=InputMode.TENSORFLOW,
+        )
+        cluster.shutdown(timeout=120)
+    finally:
+        engine.stop()
