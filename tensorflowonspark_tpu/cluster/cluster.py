@@ -72,6 +72,7 @@ class TPUCluster(object):
         input_mode,
         queues,
         owns_engine=False,
+        driver_ps=(),
     ):
         self.engine = engine
         self.cluster_meta = cluster_meta
@@ -81,6 +82,7 @@ class TPUCluster(object):
         self.input_mode = input_mode
         self.queues = queues
         self._owns_engine = owns_engine
+        self._driver_ps = list(driver_ps)
         self.cluster_id = cluster_meta["id"]
 
     # -- data plane ----------------------------------------------------
@@ -106,6 +108,38 @@ class TPUCluster(object):
             node.train(self.cluster_info, self.cluster_meta, feed_timeout, qname),
             repeated,
         )
+
+    def train_stream(self, batches, feed_timeout=600, qname="input"):
+        """Feed an unbounded stream of partition micro-batches.
+
+        The DStream role (reference: TFCluster.py:83-85 ``foreachRDD``
+        + examples/mnist/estimator/mnist_spark_streaming.py): each item
+        of ``batches`` is a list of partitions fed as one job.  The
+        stream ends when the iterator is exhausted or when someone posts
+        STOP on the reservation server (reference:
+        examples/utils/stop_streaming.py; here
+        ``examples/utils/stop_cluster.py`` or
+        ``reservation.Client(addr).request_stop()``).
+        """
+        assert self.input_mode == InputMode.SPARK, (
+            "train_stream() requires InputMode.SPARK"
+        )
+        fed = 0
+        for partitions in batches:
+            if self.server.stop_requested:
+                logger.info(
+                    "stop requested after %d stream batches; ending feed", fed
+                )
+                break
+            self.engine.run_job(
+                node.train(
+                    self.cluster_info, self.cluster_meta, feed_timeout, qname
+                ),
+                [list(p) for p in partitions],
+            )
+            fed += 1
+        logger.info("stream feed complete after %d batches", fed)
+        return fed
 
     def inference(self, partitions, feed_timeout=600, qname="input"):
         """Feed data for inference and collect results
@@ -217,6 +251,8 @@ class TPUCluster(object):
             except Exception:  # noqa: BLE001
                 pass
 
+        for shard in self._driver_ps:
+            shard.stop()
         self.server.stop()
         if self._owns_engine:
             self.engine.stop()
@@ -342,6 +378,7 @@ def run(
     tensorboard=False,
     input_mode=InputMode.SPARK,
     log_dir=None,
+    driver_ps_nodes=False,
     master_node=None,
     reservation_timeout=600,
     queues=("input", "output", "error"),
@@ -362,6 +399,12 @@ def run(
       tensorboard: launch tensorboard on chief/worker:0.
       input_mode: :class:`InputMode`.
       log_dir: event-log directory.
+      driver_ps_nodes: host the ``num_ps`` parameter-server shards in
+        the *driver* process instead of dedicating executors
+        (reference: TFCluster.py:296-314 ran PS threads on the driver);
+        every executor then runs a worker, and
+        ``ctx.cluster_spec['ps']`` points at the driver's shard
+        addresses.
       master_node: job name for a dedicated chief (e.g. ``'chief'``)
         (reference: TFCluster.py:233).
       reservation_timeout: startup barrier timeout seconds
@@ -412,8 +455,16 @@ def run(
             reservation_timeout,
         )
 
+    # driver-hosted PS consumes no executors (reference: TFCluster.py:
+    # 296-314); shards start only after validation so a failed run()
+    # can't leak their sockets/threads.
+    use_driver_ps = driver_ps_nodes and num_ps > 0
+    num_ps_exec = 0 if use_driver_ps else num_ps
+
     # validate cluster composition (reference: TFCluster.py:246-253)
-    num_special = num_ps + (1 if master_node else 0) + (1 if eval_node else 0)
+    num_special = (
+        num_ps_exec + (1 if master_node else 0) + (1 if eval_node else 0)
+    )
     num_workers = num_executors - num_special
     if num_workers < 0 or (num_workers == 0 and master_node is None):
         raise ValueError(
@@ -427,9 +478,23 @@ def run(
         )
 
     template = node._cluster_template(
-        num_executors, num_ps, master_node=master_node, eval_node=eval_node
+        num_executors, num_ps_exec, master_node=master_node, eval_node=eval_node
     )
     logger.info("cluster template: %s", template)
+
+    driver_ps = []
+    driver_ps_addrs = []
+    if use_driver_ps:
+        from tensorflowonspark_tpu.parallel.ps import ParamServerShard
+        from tensorflowonspark_tpu.utils.net import get_ip_address
+
+        host = get_ip_address()
+        for _ in range(num_ps):
+            shard = ParamServerShard()
+            _, port = shard.start("")
+            driver_ps.append(shard)
+            driver_ps_addrs.append("{0}:{1}".format(host, port))
+        logger.info("driver-hosted ps shards at %s", driver_ps_addrs)
 
     server = reservation.Server(num_executors)
     server_addr = server.start()
@@ -443,6 +508,7 @@ def run(
         "reservation_timeout": reservation_timeout,
         "queues": list(queues),
         "num_chips_per_node": num_chips_per_node,
+        "driver_ps_addrs": driver_ps_addrs,
     }
 
     # async start job: one blocking task per executor
@@ -464,6 +530,8 @@ def run(
             status=_HandleStatus(handle), timeout=reservation_timeout
         )
     except Exception:
+        for shard in driver_ps:
+            shard.stop()
         server.stop()
         if owns_engine:
             engine.stop()
@@ -491,6 +559,7 @@ def run(
         input_mode,
         list(queues),
         owns_engine=owns_engine,
+        driver_ps=driver_ps,
     )
     if tensorboard:
         url = cluster.tensorboard_url()

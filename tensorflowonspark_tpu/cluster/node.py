@@ -412,6 +412,10 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
 
         # 7. cluster spec sorted by executor id (reference: TFSparkNode.py:340-352)
         spec, coordinator, process_ranks = build_cluster_spec(cluster_info)
+        # driver-hosted ps shards join the spec by address (reference:
+        # TFCluster.py:296-314 driver_ps_nodes)
+        if cluster_meta.get("driver_ps_addrs"):
+            spec = dict(spec, ps=list(cluster_meta["driver_ps_addrs"]))
 
         # accelerator allocation by HOST-LOCAL rank: co-located nodes must
         # land on disjoint chip windows, so the index comes from this

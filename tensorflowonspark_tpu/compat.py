@@ -1,0 +1,46 @@
+"""Version/backend compatibility shims.
+
+The reference's ``compat.py`` papered over TF 2.0/2.1 API drift
+(``export_saved_model``, ``disable_auto_shard``, ``is_gpu_available`` —
+reference: tensorflowonspark/compat.py:10-31).  The JAX surface this
+framework uses is stable, so the shims here are thin by design: a
+chief-aware export helper matching the reference's calling convention,
+an accelerator probe, and a no-op kept for source compatibility with
+code ported from the reference.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def export_saved_model(params, export_dir, is_chief=False, metadata=None):
+    """Chief-only serving export (reference: compat.py:10-17 — chief
+    exported, workers wrote to a dummy dir; here non-chiefs no-op)."""
+    if not is_chief:
+        logger.info("skipping export on non-chief node")
+        return None
+    from tensorflowonspark_tpu.checkpoint import save_for_serving
+
+    return save_for_serving(export_dir, params, extra_metadata=metadata)
+
+
+def disable_auto_shard(options):  # noqa: ARG001 - source-compat no-op
+    """No-op: tf.data auto-sharding has no JAX analogue — feed sharding
+    is explicit via partitions / DataFeed (reference: compat.py:20-24)."""
+    return options
+
+
+def is_accelerator_available():
+    """True when a TPU/GPU backend is live (reference: compat.py:27-31
+    ``is_gpu_available``)."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform in ("tpu", "gpu")
+    except RuntimeError:
+        return False
+
+
+#: Reference-name alias (reference: compat.py:27)
+is_gpu_available = is_accelerator_available

@@ -1,0 +1,95 @@
+"""Shared lazy loader for the C++ libraries under ``native/``.
+
+One implementation of the build-on-first-use + ctypes-load dance for
+all native components (tfrecord codec, example codec), so fixes land
+once.  Cross-process safety: concurrent first-users (spawned compute
+processes) serialize the ``make`` through an ``flock`` file lock, so no
+process ever ``CDLL``s a half-written ``.so``.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger(__name__)
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+
+_loaded = {}
+_failed = set()
+_lock = threading.Lock()
+
+
+def _build(lib_name):
+    lock_path = os.path.join(NATIVE_DIR, ".build.lock")
+    try:
+        import fcntl
+
+        with open(lock_path, "w") as lock_file:
+            fcntl.flock(lock_file, fcntl.LOCK_EX)
+            try:
+                if not os.path.exists(os.path.join(NATIVE_DIR, lib_name)):
+                    subprocess.run(
+                        ["make", "-C", NATIVE_DIR],
+                        check=True,
+                        capture_output=True,
+                        timeout=120,
+                    )
+            finally:
+                fcntl.flock(lock_file, fcntl.LOCK_UN)
+    except ImportError:  # pragma: no cover - non-posix
+        subprocess.run(
+            ["make", "-C", NATIVE_DIR],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+
+
+def load_library(lib_name, configure):
+    """Load (building if needed) ``native/<lib_name>``.
+
+    Args:
+      lib_name: shared-object filename, e.g. ``"libtfrecord_codec.so"``.
+      configure: ``fn(lib)`` that sets restype/argtypes; called once.
+
+    Returns the configured ``ctypes.CDLL``, or ``None`` when the build
+    toolchain is unavailable (callers fall back to pure Python).
+    """
+    if lib_name in _loaded:
+        return _loaded[lib_name]
+    if lib_name in _failed:
+        return None
+    with _lock:
+        if lib_name in _loaded:
+            return _loaded[lib_name]
+        if lib_name in _failed:
+            return None
+        path = os.path.join(NATIVE_DIR, lib_name)
+        if not os.path.exists(path):
+            try:
+                _build(lib_name)
+            except Exception as e:  # noqa: BLE001 - fall back to python
+                logger.warning(
+                    "native build of %s failed (%s); using pure-python "
+                    "fallback", lib_name, e,
+                )
+                _failed.add(lib_name)
+                return None
+        try:
+            lib = ctypes.CDLL(path)
+            configure(lib)
+        except (OSError, AttributeError) as e:
+            logger.warning(
+                "native load of %s failed (%s); using pure-python "
+                "fallback", lib_name, e,
+            )
+            _failed.add(lib_name)
+            return None
+        _loaded[lib_name] = lib
+        return lib

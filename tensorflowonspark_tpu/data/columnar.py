@@ -1,0 +1,146 @@
+"""Batch Example → columnar numpy: the native feed fast path.
+
+Role parity with the reference JVM layer's record→tensor conversion
+(``batch2tensors``, TFModel.scala:51-114, and the tensorflow-hadoop
+jar's record decode): a batch of serialized ``tf.train.Example`` protos
+is parsed in C++ (native/example_codec.cc) straight into contiguous
+columnar arrays — one pass per requested column, no per-value Python
+objects — ready for ``jax.device_put``.  Pure-Python fallback via
+:mod:`tensorflowonspark_tpu.data.example` keeps the package working
+without a compiler.
+
+Fixed-width numeric columns only (the training fast path); string /
+ragged features go through the row decoder.
+"""
+
+import ctypes
+import logging
+
+import numpy as np
+
+from tensorflowonspark_tpu.data import _native
+
+logger = logging.getLogger(__name__)
+
+_LIB_NAME = "libexample_codec.so"
+
+_ERRORS = {
+    -1: "feature missing from a record",
+    -2: "feature has a different kind than requested",
+    -3: "feature width differs from the requested width",
+    -4: "malformed Example proto",
+}
+
+
+def _configure(lib):
+    pp = ctypes.POINTER(ctypes.c_char_p)
+    for fname, ctype in (
+        ("ex_extract_float", ctypes.POINTER(ctypes.c_float)),
+        ("ex_extract_int64", ctypes.POINTER(ctypes.c_int64)),
+    ):
+        fn = getattr(lib, fname)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [
+            pp,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.c_char_p,
+            ctype,
+            ctypes.c_int64,
+        ]
+
+
+def _load_native():
+    return _native.load_library(_LIB_NAME, _configure)
+
+
+def _extract_native(lib, records, name, width, dtype, recs=None, lens=None):
+    n = len(records)
+    if recs is None:
+        recs = (ctypes.c_char_p * n)(*records)
+        lens = (ctypes.c_uint64 * n)(*[len(r) for r in records])
+    out = np.empty((n, width), dtype)
+    if dtype == np.float32:
+        rc = lib.ex_extract_float(
+            recs, lens, n, name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), width,
+        )
+    else:
+        rc = lib.ex_extract_int64(
+            recs, lens, n, name.encode(),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), width,
+        )
+    if rc != 0:
+        raise ValueError(
+            "column {0!r}: {1}".format(name, _ERRORS.get(rc, "error %d" % rc))
+        )
+    return out
+
+
+def _extract_python(records, name, width, dtype):
+    from tensorflowonspark_tpu.data import example as ex
+
+    out = np.empty((len(records), width), dtype)
+    kind_wanted = ex.KIND_FLOAT if dtype == np.float32 else ex.KIND_INT64
+    for i, rec in enumerate(records):
+        feats = ex.decode_example(rec)
+        if name not in feats:
+            raise ValueError("column {0!r}: {1}".format(name, _ERRORS[-1]))
+        kind, values = feats[name]
+        if values and kind != kind_wanted:
+            raise ValueError("column {0!r}: {1}".format(name, _ERRORS[-2]))
+        if len(values) != width:
+            raise ValueError("column {0!r}: {1}".format(name, _ERRORS[-3]))
+        out[i] = values
+    return out
+
+
+def decode_batch(records, columns):
+    """Decode serialized Examples into columnar arrays.
+
+    Args:
+      records: list of ``bytes`` (serialized ``tf.train.Example``).
+      columns: ``{name: (dtype, width)}`` with dtype ``"float32"`` or
+        ``"int64"``; every record must carry exactly ``width`` values
+        (missing/ragged features raise — silent zero-fill would corrupt
+        training data).
+
+    Returns:
+      ``{name: np.ndarray[n, width]}`` (width-1 columns keep the
+      trailing axis; squeeze at the call site if needed).
+    """
+    records = [bytes(r) for r in records]
+    lib = _load_native()
+    recs = lens = None
+    if lib is not None and records:
+        # build the ctypes views once, shared across all columns
+        recs = (ctypes.c_char_p * len(records))(*records)
+        lens = (ctypes.c_uint64 * len(records))(*[len(r) for r in records])
+    out = {}
+    for name, (dtype, width) in columns.items():
+        dtype = np.dtype(dtype).type
+        if dtype not in (np.float32, np.int64):
+            raise ValueError(
+                "column {0!r}: only float32/int64 columnar decode is "
+                "supported (got {1})".format(name, dtype)
+            )
+        if lib is not None:
+            out[name] = _extract_native(
+                lib, records, name, width, dtype, recs=recs, lens=lens
+            )
+        else:
+            out[name] = _extract_python(records, name, width, dtype)
+    return out
+
+
+def load_tfrecords_columnar(path, columns):
+    """TFRecord file/dir → columnar arrays in one pass (the
+    InputMode.TENSORFLOW training-data fast path; see
+    examples/mnist/mnist_tf.py for the row-based equivalent)."""
+    from tensorflowonspark_tpu.data import tfrecord as tfr
+    from tensorflowonspark_tpu.data.interchange import _record_files
+
+    records = []
+    for f in _record_files(path):
+        records.extend(tfr.read_records(f))
+    return decode_batch(records, columns)

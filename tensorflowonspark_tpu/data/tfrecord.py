@@ -16,74 +16,41 @@ import ctypes
 import logging
 import os
 import struct
-import subprocess
-import threading
+
+from tensorflowonspark_tpu.data import _native
 
 logger = logging.getLogger(__name__)
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-)
 _LIB_NAME = "libtfrecord_codec.so"
-_lib = None
-_lib_lock = threading.Lock()
-_lib_failed = False
 
 
 def _load_native():
     """Load (building if needed) the codec library; None on failure."""
-    global _lib, _lib_failed
-    if _lib is not None or _lib_failed:
-        return _lib
-    with _lib_lock:
-        if _lib is not None or _lib_failed:
-            return _lib
-        path = os.path.join(_NATIVE_DIR, _LIB_NAME)
-        if not os.path.exists(path):
-            try:
-                subprocess.run(
-                    ["make", "-C", _NATIVE_DIR],
-                    check=True,
-                    capture_output=True,
-                    timeout=120,
-                )
-            except Exception as e:  # noqa: BLE001 - fall back to python
-                logger.warning("native codec build failed (%s); using "
-                               "pure-python fallback", e)
-                _lib_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError as e:
-            logger.warning("native codec load failed (%s); using "
-                           "pure-python fallback", e)
-            _lib_failed = True
-            return None
-        lib.tfr_crc32c.restype = ctypes.c_uint32
-        lib.tfr_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
-        lib.tfr_masked_crc.restype = ctypes.c_uint32
-        lib.tfr_masked_crc.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
-        lib.tfr_writer_open.restype = ctypes.c_void_p
-        lib.tfr_writer_open.argtypes = [ctypes.c_char_p]
-        lib.tfr_writer_write.restype = ctypes.c_int
-        lib.tfr_writer_write.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
-        ]
-        lib.tfr_writer_flush.argtypes = [ctypes.c_void_p]
-        lib.tfr_writer_close.argtypes = [ctypes.c_void_p]
-        lib.tfr_reader_open.restype = ctypes.c_void_p
-        lib.tfr_reader_open.argtypes = [ctypes.c_char_p]
-        lib.tfr_reader_next.restype = ctypes.c_int64
-        lib.tfr_reader_next.argtypes = [
-            ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-        ]
-        lib.tfr_reader_error.restype = ctypes.c_char_p
-        lib.tfr_reader_error.argtypes = [ctypes.c_void_p]
-        lib.tfr_reader_close.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        logger.info("native tfrecord codec loaded from %s", path)
-        return _lib
+    return _native.load_library(_LIB_NAME, _configure)
+
+
+def _configure(lib):
+    lib.tfr_crc32c.restype = ctypes.c_uint32
+    lib.tfr_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.tfr_masked_crc.restype = ctypes.c_uint32
+    lib.tfr_masked_crc.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.tfr_writer_open.restype = ctypes.c_void_p
+    lib.tfr_writer_open.argtypes = [ctypes.c_char_p]
+    lib.tfr_writer_write.restype = ctypes.c_int
+    lib.tfr_writer_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+    ]
+    lib.tfr_writer_flush.argtypes = [ctypes.c_void_p]
+    lib.tfr_writer_close.argtypes = [ctypes.c_void_p]
+    lib.tfr_reader_open.restype = ctypes.c_void_p
+    lib.tfr_reader_open.argtypes = [ctypes.c_char_p]
+    lib.tfr_reader_next.restype = ctypes.c_int64
+    lib.tfr_reader_next.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+    ]
+    lib.tfr_reader_error.restype = ctypes.c_char_p
+    lib.tfr_reader_error.argtypes = [ctypes.c_void_p]
+    lib.tfr_reader_close.argtypes = [ctypes.c_void_p]
 
 
 def native_available():

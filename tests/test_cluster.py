@@ -203,3 +203,45 @@ def test_failed_job_cancels_queued_tasks(engine, tmp_path):
     # and the engine still schedules fresh jobs afterwards
     results = engine.run_job(lambda it: ["ok"], [["x"]], collect=True)
     assert results == ["ok"]
+
+
+def test_train_stream_micro_batches(engine):
+    # DStream-role feeding: three micro-batches, clean shutdown
+    # (reference: TFCluster.py:83-85 foreachRDD)
+    cluster = tpu_cluster.run(
+        engine,
+        _train_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    fed = cluster.train_stream(
+        [[list(range(i * 10, i * 10 + 10))] for i in range(3)]
+    )
+    assert fed == 3
+    cluster.shutdown(timeout=60)
+
+
+def test_train_stream_stops_on_request(engine):
+    # request_stop ends the stream between micro-batches
+    # (reference: examples/utils/stop_streaming.py:12-18)
+    from tensorflowonspark_tpu.cluster import reservation
+
+    cluster = tpu_cluster.run(
+        engine,
+        _train_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    client = reservation.Client(tuple(cluster.cluster_meta["server_addr"]))
+
+    def batches():
+        yield [[1, 2, 3]]
+        client.request_stop()
+        yield [[4, 5, 6]]
+
+    fed = cluster.train_stream(batches())
+    assert fed == 1  # second micro-batch never fed
+    client.close()
+    cluster.shutdown(timeout=60)

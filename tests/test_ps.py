@@ -215,3 +215,30 @@ def test_cluster_with_ps_node():
         cluster.shutdown(timeout=120)
     finally:
         engine.stop()
+
+
+def test_cluster_with_driver_ps_nodes():
+    # PS shards hosted in the driver process; all executors are workers
+    # (reference: TFCluster.py:296-314 driver_ps_nodes)
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+    from tensorflowonspark_tpu.engine import LocalEngine
+
+    engine = LocalEngine(2)
+    try:
+        cluster = tpu_cluster.run(
+            engine,
+            _ps_main_fun,
+            args={},
+            num_executors=2,
+            num_ps=1,
+            driver_ps_nodes=True,
+            input_mode=InputMode.TENSORFLOW,
+        )
+        assert len(cluster.cluster_meta["driver_ps_addrs"]) == 1
+        # both executors are workers (no ps role consumed an executor)
+        roles = sorted(n["job_name"] for n in cluster.cluster_info)
+        assert roles == ["worker", "worker"]
+        cluster.shutdown(timeout=120)
+    finally:
+        engine.stop()
