@@ -98,6 +98,7 @@ class SyncTrainer(object):
         self.data_axes = data_axes
         self._step_fn = self._build_step()
         self._eval_fn = None
+        self._multi_fn = None
 
     # -- state ---------------------------------------------------------
 
@@ -162,6 +163,49 @@ class SyncTrainer(object):
             rng = jax.random.PRNGKey(0)
         device_batch = sh.shard_batch(batch, self.mesh, self.data_axes)
         return self._step_fn(state, device_batch, rng)
+
+    def multi_step(self, state, stacked_batch, rngs):
+        """Run K fused steps in ONE dispatch (`lax.scan` over the
+        leading axis) — the steps-per-execution technique: host→device
+        round trips amortize K×, which dominates when per-step compute
+        is a few ms (ResNet/CIFAR-class models).
+
+        Args:
+          stacked_batch: pytree with a leading ``[K, ...]`` axis over
+            per-step batches (host arrays; sharded here).
+          rngs: ``[K, 2]`` stacked PRNG keys.
+        Returns ``(state, metrics)`` with metrics stacked ``[K]``.
+        """
+        if self._multi_fn is None:
+            step_fn = self._step_fn
+
+            def multi(state, batches, rngs):
+                def body(s, xs):
+                    b, r = xs
+                    return step_fn(s, b, r)
+
+                return jax.lax.scan(body, state, (batches, rngs))
+
+            self._multi_fn = jax.jit(multi, donate_argnums=(0,))
+        device_batch = sh.shard_batch(
+            stacked_batch, self.mesh, self.data_axes, leading_dims=1
+        )
+        return self._multi_fn(state, device_batch, rngs)
+
+    def step_on_device(self, state, device_batch, rng):
+        """One step on an already device-resident (sharded) batch.
+
+        Pair with :func:`tensorflowonspark_tpu.data.feed.prefetch_to_device`
+        (give it :meth:`batch_sharding`) so batch N+1's host→HBM DMA
+        overlaps batch N's compute.  When per-step *dispatch* dominates
+        (small/fast models), prefer :meth:`multi_step`, which amortizes
+        it K× — the structure bench.py uses."""
+        return self._step_fn(state, device_batch, rng)
+
+    def batch_sharding(self):
+        """The sharding a host batch should be placed with for
+        :meth:`step_on_device` (give it to ``prefetch_to_device``)."""
+        return sh.batch_sharding(self.mesh, self.data_axes)
 
     def eval_step(self, state, batch, apply_fn):
         """Jitted forward pass for evaluation/prediction."""

@@ -191,7 +191,7 @@ def batch_sharding(mesh, data_axes=("data", "fsdp")):
     return NamedSharding(mesh, PartitionSpec(axes))
 
 
-def shard_batch(batch, mesh, data_axes=("data", "fsdp")):
+def shard_batch(batch, mesh, data_axes=("data", "fsdp"), leading_dims=0):
     """Place a host batch (pytree of np/jnp arrays, leading batch dim)
     onto the mesh, split over the data axes.
 
@@ -199,14 +199,22 @@ def shard_batch(batch, mesh, data_axes=("data", "fsdp")):
     Multi-process: each host owns a slice of the global batch; assembled
     via ``make_array_from_process_local_data`` (the HBM landing zone of
     the reference's InputMode.SPARK feed path, SURVEY.md §2.3).
+
+    Args:
+      leading_dims: number of replicated axes *before* the batch dim —
+        e.g. 1 for the ``[K, batch, ...]`` stacks that
+        ``SyncTrainer.multi_step`` scans over.
     """
-    sharding = batch_sharding(mesh, data_axes)
+    base = batch_sharding(mesh, data_axes)
+    spec = PartitionSpec(*(((None,) * leading_dims) + tuple(base.spec)))
+    sharding = NamedSharding(mesh, spec)
     width = 1
     for a in data_axes:
         width *= mesh.shape.get(a, 1)
 
     def _check(x):
-        n = getattr(x, "shape", (0,))[0] if getattr(x, "ndim", 0) else 0
+        ndim = getattr(x, "ndim", 0)
+        n = x.shape[leading_dims] if ndim > leading_dims else 0
         if width > 1 and n % width != 0:
             raise ValueError(
                 "batch dim {0} not divisible by data-parallel width {1}; "
@@ -219,7 +227,7 @@ def shard_batch(batch, mesh, data_axes=("data", "fsdp")):
         return jax.tree.map(lambda x: jax.device_put(_check(x), sharding), batch)
     return jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(
-            sharding, np.asarray(x)
+            sharding, np.asarray(_check(x))
         ),
         batch,
     )

@@ -106,6 +106,64 @@ class TestSyncTrainer:
         assert losses[-1] < losses[0]
         assert int(state.step) == 10
 
+    def test_multi_step_matches_sequential(self):
+        # K fused steps (one dispatch, lax.scan) must equal K calls of
+        # step() with the same batches/rngs
+        m = mesh_mod.build_mesh({"data": 8})
+        trainer, params = self._make(m)
+        K = 4
+        rng = jax.random.PRNGKey(7)
+        xs = np.asarray(jax.random.normal(rng, (K, 32, 784)), np.float32)
+        ys = np.tile((np.arange(32) % 10).astype(np.int32), (K, 1))
+        rngs = jax.random.split(jax.random.PRNGKey(3), K)
+
+        s_seq = trainer.create_state(params)
+        for i in range(K):
+            s_seq, m_seq = trainer.step(s_seq, (xs[i], ys[i]), rngs[i])
+
+        s_multi = trainer.create_state(params)
+        s_multi, m_multi = trainer.multi_step(s_multi, (xs, ys), rngs)
+
+        assert int(s_multi.step) == K
+        assert m_multi["loss"].shape == (K,)
+        np.testing.assert_allclose(
+            float(m_multi["loss"][-1]), float(m_seq["loss"]), rtol=1e-5
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(s_seq.params),
+            jax.tree_util.tree_leaves(s_multi.params),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    def test_step_on_device_with_prefetch(self):
+        # the prefetch + step_on_device pairing matches plain step()
+        from tensorflowonspark_tpu.data.feed import prefetch_to_device
+
+        m = mesh_mod.build_mesh({"data": 8})
+        trainer, params = self._make(m)
+        xs = np.asarray(
+            jax.random.normal(jax.random.PRNGKey(5), (3, 32, 784)), np.float32
+        )
+        ys = np.tile((np.arange(32) % 10).astype(np.int32), (3, 1))
+        rngs = jax.random.split(jax.random.PRNGKey(2), 3)
+
+        s_ref = trainer.create_state(params)
+        for i in range(3):
+            s_ref, m_ref = trainer.step(s_ref, (xs[i], ys[i]), rngs[i])
+
+        s_dev = trainer.create_state(params)
+        it = prefetch_to_device(
+            ((xs[i], ys[i]) for i in range(3)),
+            size=2,
+            sharding=trainer.batch_sharding(),
+        )
+        for i, db in enumerate(it):
+            s_dev, m_dev = trainer.step_on_device(s_dev, db, rngs[i])
+
+        np.testing.assert_allclose(
+            float(m_dev["loss"]), float(m_ref["loss"]), rtol=1e-5
+        )
+
     def test_batch_is_sharded_over_data_axis(self):
         m = mesh_mod.build_mesh({"data": 8})
         trainer, params = self._make(m)
