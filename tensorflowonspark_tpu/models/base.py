@@ -60,3 +60,44 @@ def param_count(params):
     return sum(
         getattr(l, "size", 0) for l in jax.tree_util.tree_leaves(params)
     )
+
+
+def as_variables(params, require_collections=()):
+    """Normalize a serving export into a flax variables dict.
+
+    Accepts either bare params or a ``{"params": ..., <collections>}``
+    dict.  ``require_collections`` names collections (e.g.
+    ``"batch_stats"``) that MUST be present — models with BatchNorm
+    can't serve from bare params, and the flax error for that is
+    cryptic, so fail with a clear one here.
+    """
+    variables = params if "params" in params else {"params": params}
+    missing = [c for c in require_collections if c not in variables]
+    if missing:
+        raise ValueError(
+            "serving export is missing the {0} collection(s); export "
+            "the full variables dict (e.g. save_for_serving(dir, "
+            "{{'params': ..., 'batch_stats': ...}}))".format(missing)
+        )
+    import jax.numpy as jnp
+
+    return jax.tree.map(jnp.asarray, variables)
+
+
+def make_serving_predict(variables, apply_fn, input_name, outputs):
+    """Shared scaffold for the model zoo's ``serving_builder``s
+    (see :mod:`tensorflowonspark_tpu.serving` for the contract).
+
+    Args:
+      variables: flax variables dict (from :func:`as_variables`).
+      apply_fn: ``fn(variables, x) -> model output`` (handles its own
+        input casting); jitted here.
+      input_name: batch key carrying the input column.
+      outputs: ``fn(model_output) -> {name: np.ndarray}``.
+    """
+    jitted = jax.jit(lambda x: apply_fn(variables, x))
+
+    def predict(batch):
+        return outputs(jitted(batch[input_name]))
+
+    return predict

@@ -53,21 +53,15 @@ def serving_builder(params, config):
         hidden=config.get("hidden", 512),
         num_classes=config.get("num_classes", 10),
     )
-    input_name = config.get("input_name", "image")
-    params = jax.tree.map(jnp.asarray, params)
-
-    @jax.jit
-    def _logits(x):
-        return model.apply({"params": params}, x)
-
-    def predict(batch):
-        logits = _logits(jnp.asarray(batch[input_name]))
-        return {
+    return base.make_serving_predict(
+        base.as_variables(params),
+        lambda v, x: model.apply(v, jnp.asarray(x)),
+        config.get("input_name", "image"),
+        lambda logits: {
             "logits": np.asarray(logits),
             "prediction": np.asarray(jnp.argmax(logits, axis=-1)),
-        }
-
-    return predict
+        },
+    )
 
 
 def loss_fn(model):

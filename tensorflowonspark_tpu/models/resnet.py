@@ -214,3 +214,33 @@ def loss_fn(model, weight_decay=2e-4):
         return loss, ({"accuracy": acc}, dict(new_state))
 
     return _loss
+
+
+def serving_builder(params, config):
+    """``model_ref`` target for serving exports (see
+    :mod:`tensorflowonspark_tpu.serving`).  ``config``: ``arch``
+    ("cifar" | "resnet50"), ``depth``, ``num_classes``, ``input_name``.
+    The export must be the full variables dict
+    ``{"params", "batch_stats"}`` — BatchNorm serves from running
+    statistics."""
+    import numpy as np
+
+    arch = config.get("arch", "cifar")
+    if arch == "resnet50":
+        model = ResNet50(num_classes=config.get("num_classes", 1000))
+    else:
+        model = ResNetCIFAR(
+            depth=config.get("depth", 56),
+            num_classes=config.get("num_classes", 10),
+        )
+    return base.make_serving_predict(
+        base.as_variables(params, require_collections=("batch_stats",)),
+        lambda v, x: model.apply(
+            v, jnp.asarray(x).astype(jnp.float32), train=False
+        ),
+        config.get("input_name", "image"),
+        lambda logits: {
+            "logits": np.asarray(logits, np.float32),
+            "prediction": np.asarray(jnp.argmax(logits, axis=-1)),
+        },
+    )

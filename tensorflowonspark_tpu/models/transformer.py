@@ -39,6 +39,11 @@ class TransformerConfig:
     max_seq_len: int = 2048
     dtype: str = "bfloat16"
     attention_impl: str = "dot"  # dot | flash | ring | ulysses
+    #: Mesh for ring/ulysses sequence parallelism on *global* arrays:
+    #: the attention op wraps itself in a shard_map over ``seq_axis``.
+    #: Leave None when the whole model already runs under shard_map.
+    mesh: object = None
+    seq_axis: str = "seq"
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
     # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
     # MoE FFN (models/moe.py) in every block
@@ -94,7 +99,13 @@ class Attention(nn.Module):
         q = rope(q, positions)
         k = rope(k, positions)
         out = attention(
-            q, k, v, impl=cfg.attention_impl, causal=True
+            q,
+            k,
+            v,
+            impl=cfg.attention_impl,
+            causal=True,
+            mesh=cfg.mesh,
+            seq_axis=cfg.seq_axis,
         )
         return nn.DenseGeneral(
             cfg.embed_dim,
@@ -209,3 +220,29 @@ def loss_fn(model):
         return jnp.mean(nll)
 
     return _loss
+
+
+def serving_builder(params, config):
+    """``model_ref`` target for serving exports: next-token logits for
+    a ``tokens`` batch (see :mod:`tensorflowonspark_tpu.serving`).
+    ``config`` carries TransformerConfig fields; distributed-attention
+    settings (``ring``/``ulysses``, ``mesh``) are coerced to dense
+    ``dot`` — serving is single-host batch inference and the kernels
+    are numerically identical (tests/test_attention.py)."""
+    import numpy as np
+
+    cfg_fields = {f.name for f in dataclasses.fields(TransformerConfig)}
+    overrides = dict(config, attention_impl="dot", mesh=None)
+    cfg = TransformerConfig(
+        **{k: v for k, v in overrides.items() if k in cfg_fields}
+    )
+    model = Transformer(cfg)
+    return base.make_serving_predict(
+        base.as_variables(params),
+        lambda v, tokens: model.apply(v, jnp.asarray(tokens, jnp.int32)),
+        config.get("input_name", "tokens"),
+        lambda logits: {
+            "logits": np.asarray(logits, np.float32),
+            "next_token": np.asarray(jnp.argmax(logits[:, -1], axis=-1)),
+        },
+    )

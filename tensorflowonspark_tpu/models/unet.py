@@ -116,3 +116,25 @@ def loss_fn(model):
         return jnp.mean(nll), {"accuracy": acc}
 
     return _loss
+
+
+def serving_builder(params, config):
+    """``model_ref`` target for serving exports: per-pixel class
+    predictions (see :mod:`tensorflowonspark_tpu.serving`)."""
+    import numpy as np
+
+    model = UNet(
+        num_classes=config.get("num_classes", 3),
+        base_filters=config.get("base_filters", 32),
+    )
+    return base.make_serving_predict(
+        base.as_variables(params),
+        lambda v, x: model.apply(
+            v, jnp.asarray(x).astype(jnp.float32), train=False
+        ),
+        config.get("input_name", "image"),
+        lambda logits: {
+            "logits": np.asarray(logits, np.float32),
+            "mask": np.asarray(jnp.argmax(logits, axis=-1)),
+        },
+    )

@@ -225,6 +225,7 @@ class SyncTrainer(object):
         rng=None,
         max_steps=None,
         log_every=100,
+        steps_per_execution=1,
     ):
         """Run the synchronized feed loop: pull batches from a
         :class:`~tensorflowonspark_tpu.data.feed.DataFeed`, stop globally
@@ -233,26 +234,63 @@ class SyncTrainer(object):
         Args:
           preprocess: ``fn(list_of_rows) -> batch pytree`` (default:
             ``np.asarray`` stacking).
+          steps_per_execution: fuse up to this many steps into one
+            :meth:`multi_step` dispatch (per-batch readiness stays
+            globally agreed, so every host fuses the same count; a
+            partial final group may compile a second program).
         Returns the final state.
         """
+        if steps_per_execution < 1:
+            raise ValueError(
+                "steps_per_execution must be >= 1, got {0}".format(
+                    steps_per_execution
+                )
+            )
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         steps = 0
-        while True:
+        stop = False
+        while not stop:
             if max_steps is not None and steps >= max_steps:
                 break
-            rows = feed.next_batch(batch_size)
-            have = bool(rows) and len(rows) == batch_size and not feed.should_stop()
-            if not all_hosts_ready(have):
-                # A peer (or this host) is exhausted: every host leaves
-                # the loop on the same step — no straggler enters a
-                # collective alone.
-                logger.info("global stop after %d steps", steps)
+            limit = steps_per_execution
+            if max_steps is not None:
+                limit = min(limit, max_steps - steps)
+            # collect up to `limit` globally-ready batches; the per-batch
+            # all-hosts barrier keeps the fused count identical on every
+            # host, so no straggler enters a collective alone (a batch a
+            # ready host pulled in the failing round is dropped — the
+            # same data the reference's '90% of steps' trick dropped).
+            group, subs = [], []
+            for _ in range(limit):
+                rows = feed.next_batch(batch_size)
+                have = (
+                    bool(rows)
+                    and len(rows) == batch_size
+                    and not feed.should_stop()
+                )
+                if not all_hosts_ready(have):
+                    if have:
+                        logger.info("dropping one ready batch at global stop")
+                    logger.info("global stop after %d steps", steps)
+                    stop = True
+                    break
+                group.append(
+                    preprocess(rows) if preprocess else _default_batch(rows)
+                )
+                rng, sub = jax.random.split(rng)
+                subs.append(sub)
+            if not group:
                 break
-            batch = preprocess(rows) if preprocess else _default_batch(rows)
-            rng, sub = jax.random.split(rng)
-            state, metrics = self.step(state, batch, sub)
-            steps += 1
-            if log_every and steps % log_every == 0:
+            if len(group) == 1:
+                state, metrics = self.step(state, group[0], subs[0])
+            else:
+                stacked = jax.tree.map(lambda *xs: np.stack(xs), *group)
+                state, metrics = self.multi_step(
+                    state, stacked, jnp.stack(subs)
+                )
+                metrics = jax.tree.map(lambda m: m[-1], metrics)
+            steps += len(group)
+            if log_every and (steps % log_every < len(group)):
                 logger.info(
                     "step %d loss %.4f", steps, float(metrics["loss"])
                 )

@@ -110,3 +110,73 @@ def test_prefetch_to_device_preserves_order():
     assert len(out) == 5
     for i, b in enumerate(out):
         np.testing.assert_array_equal(np.asarray(b["x"]), np.full((2, 2), i))
+
+
+def test_train_on_feed_steps_per_execution_equivalence(mgr):
+    # fused feed-driven training (multi_step groups) must match the
+    # per-step path given identical data and rng chain
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp
+
+    rng_np = np.random.RandomState(0)
+    w_true = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    rows = []
+    for _ in range(7 * 8):  # 7 full batches of 8
+        x = rng_np.rand(4).astype(np.float32)
+        rows.append((x, np.float32(x @ w_true)))
+
+    def loss(params, batch, rng):
+        import jax.numpy as jnp
+
+        x, y = batch
+        pred = jnp.dot(x, params["w"])
+        return jnp.mean((pred - y) ** 2)
+
+    def run(steps_per_execution):
+        _feed(mgr, list(rows) + [None])
+        feed = DataFeed(mgr, train_mode=True)
+        trainer = dp.SyncTrainer(loss, optax.adam(0.05))
+        state = trainer.create_state({"w": np.zeros(4, np.float32)})
+        state = trainer.train_on_feed(
+            state,
+            feed,
+            batch_size=8,
+            rng=jax.random.PRNGKey(0),
+            steps_per_execution=steps_per_execution,
+        )
+        return np.asarray(state.params["w"]), int(state.step)
+
+    w1, n1 = run(1)
+    w3, n3 = run(3)  # 7 steps -> groups of 3,3,1 (two compiled programs)
+    assert n1 == n3 == 7
+    np.testing.assert_allclose(w1, w3, rtol=1e-6)
+
+
+def test_train_on_feed_max_steps_caps_group(mgr):
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp
+
+    _feed(mgr, [([1.0], np.float32(1.0))] * 40 + [None])
+    feed = DataFeed(mgr, train_mode=True)
+
+    def loss(params, batch, rng):
+        import jax.numpy as jnp
+
+        x, y = batch
+        return jnp.mean((jnp.dot(x, params["w"]) - y) ** 2)
+
+    trainer = dp.SyncTrainer(loss, optax.sgd(0.1))
+    state = trainer.create_state({"w": np.zeros(1, np.float32)})
+    state = trainer.train_on_feed(
+        state,
+        feed,
+        batch_size=8,
+        rng=jax.random.PRNGKey(0),
+        max_steps=4,
+        steps_per_execution=3,  # groups of 3 then 1
+    )
+    assert int(state.step) == 4
