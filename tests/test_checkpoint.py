@@ -110,3 +110,76 @@ class TestServingExport:
         ref = model.apply({"params": state.params}, x)
         got = model.apply({"params": params}, x)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
+
+
+# --- cluster-level failure -> resume (the recovery story, SURVEY.md §5) ---
+
+
+def _resumable_train_fn(args, ctx):
+    """Train a linear model with per-step checkpoints; optionally crash
+    mid-run.  Restart resumes from the latest step."""
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu import checkpoint as ckpt
+    from tensorflowonspark_tpu.parallel import dp as dp_mod
+
+    def loss(params, batch, rng):
+        import jax.numpy as jnp
+
+        x, y = batch
+        return jnp.mean((jnp.dot(x, params["w"]) - y) ** 2)
+
+    trainer = dp_mod.SyncTrainer(loss, optax.sgd(0.05))
+    state = trainer.create_state({"w": np.zeros(2, np.float32)})
+
+    ckptr = ckpt.Checkpointer(args["dir"], max_to_keep=None)
+    latest = ckptr.latest_step()
+    if latest is not None:
+        state = ckptr.restore(state, step=latest)
+
+    rng = np.random.RandomState(0)
+    w_true = np.array([3.0, -1.0], np.float32)
+    start = int(state.step)
+    for i in range(start, args["total_steps"]):
+        x = rng.rand(16, 2).astype(np.float32)
+        batch = (x, (x @ w_true).astype(np.float32))
+        state, _ = trainer.step(state, batch)
+        ckptr.save(int(state.step), state, wait=True)
+        if args["fail_at"] is not None and int(state.step) == args["fail_at"]:
+            ckptr.close()
+            raise RuntimeError("injected crash at step %d" % args["fail_at"])
+    ckptr.close()
+
+
+def test_cluster_failure_then_resume(tmp_path):
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+
+    args = {"dir": str(tmp_path / "ckpts"), "total_steps": 6, "fail_at": 3}
+    # run 1: crashes at step 3; shutdown propagates the failure
+    cluster = tpu_cluster.run(
+        1, _resumable_train_fn, args, num_executors=1,
+        input_mode=InputMode.TENSORFLOW,
+    )
+    with pytest.raises(RuntimeError, match="injected crash"):
+        cluster.shutdown(timeout=120)
+
+    mgr = ckpt.Checkpointer(args["dir"])
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+    # run 2: resumes from step 3 and completes
+    args2 = dict(args, fail_at=None)
+    cluster = tpu_cluster.run(
+        1, _resumable_train_fn, args2, num_executors=1,
+        input_mode=InputMode.TENSORFLOW,
+    )
+    cluster.shutdown(timeout=120)
+
+    mgr = ckpt.Checkpointer(args["dir"])
+    assert mgr.latest_step() == 6
+    # steps 4..6 exist but 1..2 were written by run 1 before the crash
+    assert set(mgr.all_steps()) >= {3, 4, 5, 6}
+    mgr.close()

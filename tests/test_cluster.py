@@ -245,3 +245,41 @@ def test_train_stream_stops_on_request(engine):
     assert fed == 1  # second micro-batch never fed
     client.close()
     cluster.shutdown(timeout=60)
+
+
+def _eval_role_fn(args, ctx):
+    # evaluator runs in the background like ps (service node); record
+    # the role so the test can assert it actually launched
+    if ctx.job_name == "evaluator":
+        ctx.mgr.set("saw_evaluator", True)
+        return
+    feed = ctx.get_data_feed(train_mode=True)
+    while not feed.should_stop():
+        feed.next_batch(8)
+
+
+def test_eval_node_role(engine):
+    # eval_node=True dedicates one executor as 'evaluator'
+    # (reference: TFCluster.py:236; examples/mnist/estimator/mnist_tf.py:115)
+    from tensorflowonspark_tpu.cluster import manager as mgr_mod
+
+    cluster = tpu_cluster.run(
+        engine,
+        _eval_role_fn,
+        args={},
+        num_executors=2,
+        eval_node=True,
+        input_mode=InputMode.SPARK,
+    )
+    roles = sorted(n["job_name"] for n in cluster.cluster_info)
+    assert roles == ["evaluator", "worker"]
+    cluster.train([[1, 2, 3]])
+    ev = next(n for n in cluster.cluster_info if n["job_name"] == "evaluator")
+    m = mgr_mod.connect(tuple(ev["addr"]), bytes.fromhex(ev["authkey"]))
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if m.get("saw_evaluator")._getvalue():
+            break
+        time.sleep(0.5)
+    assert m.get("saw_evaluator")._getvalue() is True
+    cluster.shutdown(timeout=60)
