@@ -1,0 +1,125 @@
+// Shared-memory ring buffer: the zero-copy feed staging path.
+//
+// SURVEY.md §7 'Hard parts: feed-path throughput' calls for "a C++
+// ring buffer + async device_put, not JoinableQueues".  This is that
+// ring: a single-producer/single-consumer byte ring living in a
+// multiprocessing.SharedMemory segment shared by the feeder task
+// process and the compute process on one host.  Records are
+// length-framed; head/tail are C++11 atomics (lock-free, cross-process
+// over shm), so a push and a pop never contend on a lock and data
+// crosses processes with exactly two memcpys (in, out) — no manager
+// RPC, no pickle round trip through a third process.
+//
+// Layout (64-byte-aligned header):
+//   uint64 magic; uint64 capacity;        // data region size in bytes
+//   atomic<uint64> head;                  // next write offset (mod cap)
+//   atomic<uint64> tail;                  // next read offset (mod cap)
+//   uint8 data[capacity];
+//
+// Framing: [uint32 len][len bytes], wrapping byte-wise at the region
+// end.  A record longer than capacity-8 is rejected (-2).
+//
+// All functions take the base pointer of the shm segment.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x54464f5352494e47ull;  // "TFOSRING"
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;
+  std::atomic<uint64_t> head;
+  std::atomic<uint64_t> tail;
+  uint8_t pad[64 - 2 * sizeof(uint64_t) - 2 * sizeof(std::atomic<uint64_t>)];
+};
+
+static_assert(sizeof(Header) == 64, "header must be one cache line");
+
+inline Header* H(uint8_t* base) { return reinterpret_cast<Header*>(base); }
+inline uint8_t* Data(uint8_t* base) { return base + sizeof(Header); }
+
+// copy `n` bytes into the ring at logical offset `pos` (wraps)
+inline void RingWrite(uint8_t* data, uint64_t cap, uint64_t pos,
+                      const uint8_t* src, uint64_t n) {
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  memcpy(data + off, src, first);
+  if (n > first) memcpy(data, src + first, n - first);
+}
+
+inline void RingRead(const uint8_t* data, uint64_t cap, uint64_t pos,
+                     uint8_t* dst, uint64_t n) {
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  memcpy(dst, data + off, first);
+  if (n > first) memcpy(dst + first, data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// initialize a fresh segment of `total_bytes`; returns usable capacity
+// or -1 if the segment is too small.
+int64_t shmring_init(uint8_t* base, uint64_t total_bytes) {
+  if (total_bytes < sizeof(Header) + 64) return -1;
+  Header* h = H(base);
+  h->magic = kMagic;
+  h->capacity = total_bytes - sizeof(Header);
+  h->head.store(0, std::memory_order_relaxed);
+  h->tail.store(0, std::memory_order_release);
+  return static_cast<int64_t>(h->capacity);
+}
+
+// 0 = ok, -1 = full (retry later), -2 = record too large, -3 = bad segment
+int shmring_push(uint8_t* base, const uint8_t* rec, uint64_t len) {
+  Header* h = H(base);
+  if (h->magic != kMagic) return -3;
+  uint64_t cap = h->capacity;
+  if (len + 4 > cap) return -2;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t tail = h->tail.load(std::memory_order_acquire);
+  if (head - tail + len + 4 > cap) return -1;  // not enough free space
+  uint32_t len32 = static_cast<uint32_t>(len);
+  RingWrite(Data(base), cap, head,
+            reinterpret_cast<const uint8_t*>(&len32), 4);
+  RingWrite(Data(base), cap, head + 4, rec, len);
+  h->head.store(head + 4 + len, std::memory_order_release);
+  return 0;
+}
+
+// >=0 = record length copied into out, -1 = empty, -2 = out_cap too
+// small (record length returned via *need), -3 = bad segment
+int64_t shmring_pop(uint8_t* base, uint8_t* out, uint64_t out_cap,
+                    uint64_t* need) {
+  Header* h = H(base);
+  if (h->magic != kMagic) return -3;
+  uint64_t cap = h->capacity;
+  uint64_t tail = h->tail.load(std::memory_order_relaxed);
+  uint64_t head = h->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint32_t len32;
+  RingRead(Data(base), cap, tail, reinterpret_cast<uint8_t*>(&len32), 4);
+  if (len32 > out_cap) {
+    if (need) *need = len32;
+    return -2;
+  }
+  RingRead(Data(base), cap, tail + 4, out, len32);
+  h->tail.store(tail + 4 + len32, std::memory_order_release);
+  return static_cast<int64_t>(len32);
+}
+
+// bytes currently buffered (approximate under concurrency)
+int64_t shmring_size(uint8_t* base) {
+  Header* h = H(base);
+  if (h->magic != kMagic) return -3;
+  return static_cast<int64_t>(
+      h->head.load(std::memory_order_acquire) -
+      h->tail.load(std::memory_order_acquire));
+}
+
+}  // extern "C"

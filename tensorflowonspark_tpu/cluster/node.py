@@ -40,11 +40,15 @@ import time
 import uuid
 
 from tensorflowonspark_tpu.cluster import manager, reservation, tpu_info
-from tensorflowonspark_tpu.cluster.marker import EndPartition
+from tensorflowonspark_tpu.cluster.marker import Block, EndPartition
 from tensorflowonspark_tpu.utils import paths as path_utils
 from tensorflowonspark_tpu.utils.net import get_ip_address
 
 logger = logging.getLogger(__name__)
+
+#: Rows per feed Block — one manager RPC ships this many rows
+#: (SURVEY.md §7 'feed-path throughput'; override via env for tuning).
+FEED_BLOCK_SIZE = int(os.environ.get("TFOS_FEED_BLOCK_SIZE", "256"))
 
 
 class NodeContext(object):
@@ -193,6 +197,11 @@ _LOCAL_MANAGERS = []
 
 def _register_local_manager(mgr):
     _LOCAL_MANAGERS.append(mgr)
+
+
+#: Keepalive for shm feed rings created by this executor (segment dies
+#: with its creating process; see TFOS_SHM_FEED in run()).
+_LOCAL_RINGS = []
 
 
 _MANAGER_FILE = "tfos_manager.json"
@@ -353,6 +362,42 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
         mgr, addr = manager.start(authkey, queues, mode="remote")
         _register_local_manager(mgr)  # keepalive for the executor lifetime
         mgr.set("state", "running")
+        # Optional shared-memory feed ring (TFOS_SHM_FEED=1): feeders
+        # push row-Blocks through shm instead of manager RPCs — the
+        # SURVEY.md §7 'C++ ring buffer' staging path.  Created here so
+        # it lives as long as the executor process; feeders and the
+        # compute process attach by name via the manager kv.
+        if (
+            not is_service_node
+            and input_mode == InputMode.SPARK  # only the feed path uses it
+            and os.environ.get("TFOS_SHM_FEED") == "1"
+        ):
+            from tensorflowonspark_tpu.data import shm_ring
+
+            if shm_ring.available():
+                ring_name = "tfos_{0}_{1}".format(
+                    cluster_meta["id"][-8:], executor_id
+                )
+                ring_cap = int(
+                    os.environ.get(
+                        "TFOS_SHM_FEED_BYTES", shm_ring.DEFAULT_CAPACITY
+                    )
+                )
+                ring = shm_ring.ShmRing(ring_name, ring_cap, create=True)
+                _LOCAL_RINGS.append(ring)  # keepalive: executor lifetime
+                mgr.set(
+                    "shm_ring", {"name": ring_name, "capacity": ring_cap}
+                )
+                logger.info(
+                    "shm feed ring %s (%d MB) enabled",
+                    ring_name,
+                    ring_cap // (1 << 20),
+                )
+            else:
+                logger.warning(
+                    "TFOS_SHM_FEED=1 but native ring unavailable; "
+                    "falling back to queue feeding"
+                )
         host = get_ip_address()
         adv_addr = (host, addr[1])
         _write_manager_info(
@@ -571,16 +616,66 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             except (ConnectionError, OSError) as e:
                 logger.debug("unable to reach reservation server: %s", e)
             return []
+        err_q = mgr.get_queue("error")
+        ring = _attach_feed_ring(mgr)
         count = 0
+        block = []
+
+        def _ship(rows):
+            if ring is not None:
+                import pickle as _p
+
+                payload = _p.dumps(rows, protocol=5)
+                # a block that outgrows the ring is split, not fatal —
+                # the queue path never had a size cap; a single giant
+                # row falls back to the queue
+                if len(payload) + 8 >= ring.capacity:
+                    if len(rows) == 1:
+                        queue.put(Block(rows), block=True)
+                        return
+                    mid = len(rows) // 2
+                    _ship(rows[:mid])
+                    _ship(rows[mid:])
+                    return
+                ring.push(
+                    payload,
+                    timeout=feed_timeout,
+                    error_check=lambda: _check_error_queue(mgr, err_q),
+                )
+            else:
+                queue.put(Block(rows), block=True)
+
         for item in iterator:
             count += 1
-            queue.put(item, block=True)
+            block.append(item)
+            if len(block) >= FEED_BLOCK_SIZE:
+                _ship(block)
+                block = []
+        if block:
+            _ship(block)
         # wait for consumption, surfacing compute errors promptly
         # (reference: TFSparkNode.py:472-483)
-        joinThr = _JoinWatcher(queue)
         timeout = feed_timeout
+        if ring is not None:
+            while True:
+                sz = ring.size()
+                if sz < 0:
+                    raise RuntimeError(
+                        "feed ring segment corrupt during drain wait"
+                    )
+                if sz == 0:
+                    break
+                _check_error_queue(mgr, err_q)
+                time.sleep(0.05)
+                timeout -= 0.05
+                if timeout <= 0:
+                    raise RuntimeError(
+                        "timed out waiting for ring consumption "
+                        "(feed_timeout exceeded)"
+                    )
+        joinThr = _JoinWatcher(queue)
         while joinThr.is_alive():
-            _check_error_queue(mgr)
+            _check_error_queue(mgr, err_q)
             time.sleep(1)
             timeout -= 1
             if timeout <= 0:
@@ -588,11 +683,36 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     "timed out waiting for consumption of all batches "
                     "(feed_timeout exceeded)"
                 )
-        _check_error_queue(mgr)
+        _check_error_queue(mgr, err_q)
         logger.info("fed %d items", count)
         return []
 
     return _train
+
+
+#: feeder-side ring attachments, one per (process, ring name)
+_ATTACHED_RINGS = {}
+
+
+def _attach_feed_ring(mgr):
+    """Attach to this node's shm feed ring if one was advertised."""
+    try:
+        info = mgr.get("shm_ring")._getvalue()
+    except Exception:  # noqa: BLE001 - kv read is best effort
+        info = None
+    if not info:
+        return None
+    name = info["name"]
+    if name not in _ATTACHED_RINGS:
+        from tensorflowonspark_tpu.data import shm_ring
+
+        # evict attachments from finished cluster runs: an unlinked
+        # segment stays resident while mapped, so long-lived executor
+        # processes would otherwise pin one dead ring per run
+        for stale in list(_ATTACHED_RINGS):
+            _ATTACHED_RINGS.pop(stale).close(unlink=False)
+        _ATTACHED_RINGS[name] = shm_ring.ShmRing(name)
+    return _ATTACHED_RINGS[name]
 
 
 def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
@@ -603,27 +723,39 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         mgr = _get_manager(cluster_info, _local_executor_id())
         queue_in = mgr.get_queue(qname)
         count = 0
+        block = []
         for item in iterator:
             count += 1
-            queue_in.put(item, block=True)
+            block.append(item)
+            if len(block) >= FEED_BLOCK_SIZE:
+                queue_in.put(Block(block), block=True)
+                block = []
+        if block:
+            queue_in.put(Block(block), block=True)
         queue_in.put(EndPartition())
         if count == 0:
             return []
+        err_q = mgr.get_queue("error")
         joinThr = _JoinWatcher(queue_in)
         timeout = feed_timeout
         while joinThr.is_alive():
-            _check_error_queue(mgr)
+            _check_error_queue(mgr, err_q)
             time.sleep(1)
             timeout -= 1
             if timeout <= 0:
                 raise RuntimeError("timed out waiting for inference consumption")
-        _check_error_queue(mgr)
+        _check_error_queue(mgr, err_q)
         queue_out = mgr.get_queue("output")
         results = []
         while count > 0:
-            results.append(queue_out.get(block=True))
+            item = queue_out.get(block=True)
             queue_out.task_done()
-            count -= 1
+            if isinstance(item, Block):
+                results.extend(item.items)
+                count -= len(item.items)
+            else:
+                results.append(item)
+                count -= 1
         logger.info("returning %d inference results", len(results))
         return results
 
@@ -636,14 +768,19 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 # the sentinels and peeks the error queues itself (cluster.py).
 
 
-def _check_error_queue(mgr):
+def _check_error_queue(mgr, err_queue=None):
     """Raise if the node's compute process posted an error; the error is
     re-queued first so later tasks (and shutdown) see it too
-    (reference: TFSparkNode.py:476-479,612-618)."""
+    (reference: TFSparkNode.py:476-479,612-618).
+
+    Pass a cached ``err_queue`` proxy from polling loops — creating a
+    proxy is a full manager round trip.
+    """
+    q = err_queue if err_queue is not None else mgr.get_queue("error")
     try:
-        error = mgr.get_queue("error").get(block=False)
-        mgr.get_queue("error").task_done()
-        mgr.get_queue("error").put(error)
+        error = q.get(block=False)
+        q.task_done()
+        q.put(error)
         raise RuntimeError("compute process failed:\n{0}".format(error))
     except _queue_mod.Empty:
         pass

@@ -25,10 +25,11 @@ TPU-native additions (no reference analogue — SURVEY.md §7 step 3):
 """
 
 import logging
+import queue as queue_mod
 
 import numpy as np
 
-from tensorflowonspark_tpu.cluster.marker import EndPartition
+from tensorflowonspark_tpu.cluster.marker import Block, EndPartition
 
 logger = logging.getLogger(__name__)
 
@@ -55,6 +56,18 @@ class DataFeed(object):
         self.input_tensors = (
             sorted(input_mapping.keys()) if input_mapping is not None else None
         )
+        #: rows unwrapped from a Block but not yet consumed by a batch
+        self._pending = []
+        self._pending_pos = 0
+        #: queue proxies are cached: creating one is a full manager
+        #: round trip (~100ms) and next_batch used to pay it per call
+        self._qin = None
+        self._qout = None
+        #: shm feed ring (TFOS_SHM_FEED): attached lazily from the
+        #: manager kv; None = queue-only feeding
+        self._ring = None
+        self._ring_checked = False
+        self._last_queue_poll = 0.0
 
     def next_batch(self, batch_size):
         """Gets a batch of items from the input queue.
@@ -64,18 +77,64 @@ class DataFeed(object):
         ``input_mapping`` was provided — a dict of named column lists
         (reference: TFNode.py:243-288).
         """
-        queue_in = self.mgr.get_queue(self.qname_in)
+        if self._qin is None:
+            self._qin = self.mgr.get_queue(self.qname_in)
+        queue_in = self._qin
         tensors = [] if self.input_tensors is None else {
             tensor: [] for tensor in self.input_tensors
         }
         count = 0
+
+        def _consume(item):
+            if self.input_tensors is None:
+                tensors.append(item)
+            else:
+                for i, tensor in enumerate(self.input_tensors):
+                    tensors[tensor].append(item[i])
+
+        if not self._ring_checked:
+            self._attach_ring()
         while count < batch_size:
-            item = queue_in.get(block=True)
+            # drain Block leftovers first (feeders ship rows in Blocks —
+            # one manager RPC per block, marker.Block)
+            if self._pending_pos < len(self._pending):
+                _consume(self._pending[self._pending_pos])
+                self._pending_pos += 1
+                count += 1
+                continue
+            if self._ring is not None:
+                # shm fast path: rows arrive through the ring; the queue
+                # only carries control sentinels (None / EndPartition),
+                # polled at most every 100ms so an idle wait doesn't
+                # hammer the single-threaded manager with RPCs
+                rec = self._ring.pop(timeout=0.05)
+                if rec is not None:
+                    import pickle as _p
+
+                    self._pending = _p.loads(rec)
+                    self._pending_pos = 0
+                    continue
+                import time as _time
+
+                now = _time.monotonic()
+                if now - self._last_queue_poll < 0.1:
+                    continue
+                self._last_queue_poll = now
+                try:
+                    item = queue_in.get(block=False)
+                except queue_mod.Empty:
+                    continue
+            else:
+                item = queue_in.get(block=True)
             if item is None:
                 # End-of-feed: mark done and stop (reference: TFNode.py:265-268)
                 queue_in.task_done()
                 self.done_feeding = True
                 break
+            elif isinstance(item, Block):
+                self._pending = item.items
+                self._pending_pos = 0
+                queue_in.task_done()
             elif isinstance(item, EndPartition):
                 # Truncate the batch at a partition boundary
                 # (reference: TFNode.py:268-274)
@@ -83,15 +142,25 @@ class DataFeed(object):
                 if count > 0:
                     break
             else:
-                if self.input_tensors is None:
-                    tensors.append(item)
-                else:
-                    for i, tensor in enumerate(self.input_tensors):
-                        tensors[tensor].append(item[i])
+                _consume(item)
                 count += 1
                 queue_in.task_done()
         logger.debug("next_batch() returning %d items", count)
         return tensors
+
+    def _attach_ring(self):
+        """Attach the node's shm feed ring if the runtime advertised one
+        (TFOS_SHM_FEED; see cluster/node.py and data/shm_ring.py)."""
+        self._ring_checked = True
+        try:
+            info = self.mgr.get("shm_ring")._getvalue()
+        except Exception:  # noqa: BLE001 - kv read is best effort
+            info = None
+        if info:
+            from tensorflowonspark_tpu.data.shm_ring import ShmRing
+
+            self._ring = ShmRing(info["name"])
+            logger.info("consuming from shm feed ring %s", info["name"])
 
     def should_stop(self):
         """True once the feeder posted the end-of-feed sentinel
@@ -100,10 +169,11 @@ class DataFeed(object):
 
     def batch_results(self, results):
         """Push a batch of inference results to the output queue
-        (reference: TFNode.py:294-305)."""
-        queue_out = self.mgr.get_queue(self.qname_out)
-        for item in results:
-            queue_out.put(item, block=True)
+        (reference: TFNode.py:294-305).  Ships the whole batch as one
+        Block — one manager RPC (the feed-side optimization, mirrored)."""
+        if self._qout is None:
+            self._qout = self.mgr.get_queue(self.qname_out)
+        self._qout.put(Block(results), block=True)
 
     def terminate(self):
         """Terminate data feeding early: set node state to 'terminating'
@@ -114,7 +184,26 @@ class DataFeed(object):
 
         from tensorflowonspark_tpu.cluster import manager
 
-        count = manager.drain(self.mgr.get_queue(self.qname_in), timeout=5)
+        if not self._ring_checked:
+            self._attach_ring()
+        if self._ring is not None:
+            # release feeders blocked on a full ring: keep discarding
+            # until the ring stays empty (an in-flight feeder refills it
+            # as space frees) — the queue-path drain's shm twin
+            import time as _time
+
+            hard_end = _time.monotonic() + 30
+            idle_end = _time.monotonic() + 2
+            ring_count = 0
+            while _time.monotonic() < min(hard_end, idle_end):
+                if self._ring.pop(timeout=0.05) is None:
+                    continue
+                ring_count += 1
+                idle_end = _time.monotonic() + 2
+            logger.info("terminate() drained %d ring blocks", ring_count)
+        if self._qin is None:
+            self._qin = self.mgr.get_queue(self.qname_in)
+        count = manager.drain(self._qin, timeout=5)
         logger.info("terminate() drained %d items from input queue", count)
 
     # ------------------------------------------------------------------

@@ -1,0 +1,177 @@
+"""Shared-memory ring: zero-RPC staging between feeder and compute.
+
+The manager-queue data plane costs one proxy RPC (connect, pickle,
+third-process hop) per Block; this ring (native/shm_ring.cc, a
+lock-free SPSC byte ring in a ``multiprocessing.shared_memory``
+segment) moves a record with two memcpys and no intermediary — the
+"C++ ring buffer" half of SURVEY.md §7's feed-throughput prescription
+(the "async device_put" half is
+:func:`tensorflowonspark_tpu.data.feed.prefetch_to_device`).
+
+Used as the opt-in train-feed fast path (``TFOS_SHM_FEED=1``): the node
+runtime creates a ring per worker, advertises its name through the
+manager kv, feeders push pickled row-Blocks, and ``DataFeed`` drains
+the ring before consulting the queue (control sentinels — ``None`` /
+EndPartition — always travel via the queue).
+
+No pure-Python fallback: callers check :func:`available` and stay on
+the queue path when the native lib is missing.
+"""
+
+import atexit
+import ctypes
+import gc
+import logging
+import time
+import weakref
+from multiprocessing import shared_memory
+
+from tensorflowonspark_tpu.data import _native
+
+logger = logging.getLogger(__name__)
+
+_LIB_NAME = "libshm_ring.so"
+
+DEFAULT_CAPACITY = 64 * 1024 * 1024
+
+#: live rings; at interpreter exit their ctypes buffer pins are dropped
+#: BEFORE SharedMemory.__del__ runs, so its close() doesn't raise
+#: BufferError into stderr
+_INSTANCES = weakref.WeakSet()
+
+
+@atexit.register
+def _release_pins():
+    for ring in list(_INSTANCES):
+        ring._cbase = None
+    gc.collect()
+
+
+def _configure(lib):
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.shmring_init.restype = ctypes.c_int64
+    lib.shmring_init.argtypes = [u8p, ctypes.c_uint64]
+    lib.shmring_push.restype = ctypes.c_int
+    lib.shmring_push.argtypes = [u8p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmring_pop.restype = ctypes.c_int64
+    lib.shmring_pop.argtypes = [
+        u8p, u8p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.shmring_size.restype = ctypes.c_int64
+    lib.shmring_size.argtypes = [u8p]
+
+
+def _load():
+    return _native.load_library(_LIB_NAME, _configure)
+
+
+def available():
+    return _load() is not None
+
+
+class ShmRing(object):
+    """SPSC byte ring over a named shared-memory segment.
+
+    Args:
+      name: segment name (``create=True`` makes it, else attaches).
+      capacity: total segment bytes when creating.
+    """
+
+    def __init__(self, name, capacity=DEFAULT_CAPACITY, create=False):
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError("native shm ring unavailable (no compiler?)")
+        if create:
+            self.shm = shared_memory.SharedMemory(
+                name=name, create=True, size=capacity
+            )
+        else:
+            self.shm = shared_memory.SharedMemory(name=name)
+        self.name = name
+        self._owner = create
+        #: usable data-region bytes (segment minus the 64B header)
+        self.capacity = self.shm.size - 64
+        self._out = ctypes.create_string_buffer(1 << 20)
+        # one ctypes view for the segment's lifetime: from_buffer pins
+        # the exported buffer, so it must be dropped before close()
+        self._cbase = (ctypes.c_uint8 * self.shm.size).from_buffer(
+            self.shm.buf
+        )
+        if create:
+            rc = self._lib.shmring_init(self._cbase, self.shm.size)
+            if rc < 0:
+                self.close()
+                raise ValueError("segment too small: {0}".format(capacity))
+        _INSTANCES.add(self)
+
+    def _base(self):
+        return self._cbase
+
+    def push(self, record, timeout=None, error_check=None):
+        """Append one byte record; blocks (spin+sleep) while full.
+
+        ``error_check``: optional callable invoked during waits so
+        feeders can keep surfacing compute errors.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        base = self._base()
+        while True:
+            rc = self._lib.shmring_push(base, record, len(record))
+            if rc == 0:
+                return
+            if rc == -2:
+                raise ValueError(
+                    "record of {0} bytes exceeds ring capacity".format(
+                        len(record)
+                    )
+                )
+            if rc == -3:
+                raise RuntimeError("corrupt ring segment")
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("ring full for {0}s".format(timeout))
+            if error_check is not None:
+                error_check()
+            time.sleep(0.001)
+
+    def pop(self, timeout=0):
+        """Pop one record; returns ``None`` when empty past ``timeout``."""
+        deadline = time.monotonic() + timeout
+        base = self._base()
+        need = ctypes.c_uint64(0)
+        while True:
+            n = self._lib.shmring_pop(
+                base,
+                ctypes.cast(self._out, ctypes.POINTER(ctypes.c_uint8)),
+                len(self._out),
+                ctypes.byref(need),
+            )
+            if n >= 0:
+                return self._out.raw[:n]
+            if n == -2:  # grow the scratch buffer and retry
+                self._out = ctypes.create_string_buffer(int(need.value))
+                continue
+            if n == -3:
+                raise RuntimeError("corrupt ring segment")
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.0005)
+
+    def size(self):
+        return int(self._lib.shmring_size(self._base()))
+
+    def close(self, unlink=None):
+        self._cbase = None  # release the exported-buffer pin
+        gc.collect()  # the pin is freed only once the array is collected
+        try:
+            self.shm.close()
+        except BufferError:
+            # a stray export (e.g. an in-flight ctypes call) still pins
+            # the mapping; it unmaps at process exit — log and move on
+            logger.debug("segment %s still pinned; deferring unmap", self.name)
+        except FileNotFoundError:
+            pass
+        if unlink if unlink is not None else self._owner:
+            try:
+                self.shm.unlink()
+            except FileNotFoundError:
+                pass

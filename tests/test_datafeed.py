@@ -58,10 +58,15 @@ def test_end_partition_truncates_batch(mgr):
 
 
 def test_batch_results_roundtrip(mgr):
+    from tensorflowonspark_tpu.cluster.marker import Block
+
     feed = DataFeed(mgr)
     feed.batch_results([7, 8, 9])
     q = mgr.get_queue("output")
-    assert [q.get() for _ in range(3)] == [7, 8, 9]
+    # results travel as ONE Block (one manager RPC per batch)
+    block = q.get()
+    assert isinstance(block, Block)
+    assert block.items == [7, 8, 9]
 
 
 def test_terminate_sets_state_and_drains(mgr):
@@ -180,3 +185,34 @@ def test_train_on_feed_max_steps_caps_group(mgr):
         steps_per_execution=3,  # groups of 3 then 1
     )
     assert int(state.step) == 4
+
+
+def test_block_unwrapping_preserves_order_and_markers(mgr):
+    from tensorflowonspark_tpu.cluster.marker import Block
+
+    _feed(mgr, [Block([[1], [2], [3]]), EndPartition(), Block([[4], [5]]), None])
+    feed = DataFeed(mgr)
+    batch = feed.next_batch(10)
+    assert batch == [[1], [2], [3]]  # EndPartition truncates after block
+    batch = feed.next_batch(10)
+    assert batch == [[4], [5]]
+    assert feed.should_stop()
+
+
+def test_block_spans_batches(mgr):
+    from tensorflowonspark_tpu.cluster.marker import Block
+
+    _feed(mgr, [Block([[i] for i in range(10)]), None])
+    feed = DataFeed(mgr)
+    assert feed.next_batch(4) == [[0], [1], [2], [3]]
+    assert feed.next_batch(4) == [[4], [5], [6], [7]]
+    assert feed.next_batch(4) == [[8], [9]]
+    assert feed.should_stop()
+
+
+def test_block_with_input_mapping(mgr):
+    from tensorflowonspark_tpu.cluster.marker import Block
+
+    _feed(mgr, [Block([[0, 10], [1, 11]]), None])
+    feed = DataFeed(mgr, input_mapping={"x": "a", "y": "b"})
+    assert feed.next_batch(4) == {"x": [0, 1], "y": [10, 11]}
