@@ -42,12 +42,20 @@ def main_fun(args, ctx):
 
     platform = jax.devices()[0].platform
     dtype = "bfloat16" if platform in ("tpu", "gpu") else "float32"
-    model = resnet.ResNetCIFAR(depth=args.depth, dtype=dtype)
-    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 32, 32, 3)))
+    if args.arch == "resnet50":
+        # ImageNet-class workload (reference: resnet_imagenet_main.py)
+        model = resnet.ResNet50(num_classes=1000, dtype=dtype)
+        hw = args.image_size
+    else:
+        model = resnet.ResNetCIFAR(depth=args.depth, dtype=dtype)
+        hw = 32
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, hw, hw, 3)))
 
     # LR schedule shape follows the reference defaults (0.1 → /10 at
-    # epoch boundaries 91/136, reference: resnet_cifar_dist.py:33-35)
-    steps_per_epoch = max(1, 50000 // args.batch_size)
+    # epoch boundaries 91/136, reference: resnet_cifar_dist.py:33-35);
+    # epoch length tracks the modeled dataset (CIFAR 50k / ImageNet 1.28M)
+    dataset_size = 1_281_167 if args.arch == "resnet50" else 50_000
+    steps_per_epoch = max(1, dataset_size // args.batch_size)
     schedule = optax.piecewise_constant_schedule(
         0.1, {91 * steps_per_epoch: 0.1, 136 * steps_per_epoch: 0.1}
     )
@@ -61,10 +69,11 @@ def main_fun(args, ctx):
         variables["params"], {"batch_stats": variables["batch_stats"]}
     )
 
-    # synthetic CIFAR batch (reference: common.py:315-363)
+    # synthetic image batch (reference: common.py:315-363)
     rng = np.random.RandomState(0)
-    x = rng.rand(args.batch_size, 32, 32, 3).astype(np.float32)
-    y = (np.arange(args.batch_size) % 10).astype(np.int32)
+    x = rng.rand(args.batch_size, hw, hw, 3).astype(np.float32)
+    num_classes = 1000 if args.arch == "resnet50" else 10
+    y = (np.arange(args.batch_size) % num_classes).astype(np.int32)
 
     warmup = min(3, args.steps)
     for i in range(warmup):
@@ -77,9 +86,10 @@ def main_fun(args, ctx):
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
     ips = args.batch_size * args.steps / dt
+    name = "resnet50" if args.arch == "resnet50" else "resnet%d" % args.depth
     print(
-        "resnet%d %s: %d steps, %.1f images/sec, final loss %.4f"
-        % (args.depth, platform, args.steps, ips, float(metrics["loss"]))
+        "%s %s: %d steps, %.1f images/sec, final loss %.4f"
+        % (name, platform, args.steps, ips, float(metrics["loss"]))
     )
     return ips
 
@@ -91,6 +101,9 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--cluster_size", type=int, default=0,
                    help="0 = run in-process; N = run through the cluster API")
+    p.add_argument("--arch", choices=("cifar", "resnet50"), default="cifar")
+    p.add_argument("--image_size", type=int, default=224,
+                   help="input size for --arch resnet50")
     p.add_argument("--depth", type=int, default=56)
     p.add_argument("--batch_size", type=int, default=128)
     p.add_argument("--steps", type=int, default=30)

@@ -1,0 +1,94 @@
+"""Spark DataFrame veneer tests (skipped where pyspark is absent; the
+schema-mapping logic is exercised via a stub types module either way)."""
+
+import sys
+import types
+
+import pytest
+
+
+def test_require_pyspark_error_message(monkeypatch):
+    monkeypatch.setitem(sys.modules, "pyspark", None)
+    from tensorflowonspark_tpu.data import spark_io
+
+    with pytest.raises(ImportError, match="pyspark is required"):
+        spark_io._require_pyspark()
+
+
+def _stub_pyspark(monkeypatch):
+    """Minimal pyspark.sql.types stand-in so the schema mapping is
+    testable without a Spark install."""
+
+    class _T:
+        def __init__(self, name):
+            self.name = name
+
+        def __repr__(self):
+            return self.name
+
+        def __eq__(self, other):
+            return isinstance(other, _T) and other.name == self.name
+
+    class ArrayType(_T):
+        def __init__(self, inner):
+            super().__init__("array<{0}>".format(inner))
+            self.inner = inner
+
+    class StructField:
+        def __init__(self, name, dtype, nullable):
+            self.name, self.dtype, self.nullable = name, dtype, nullable
+
+    class StructType:
+        def __init__(self, fields):
+            self.fields = fields
+
+        def fieldNames(self):
+            return [f.name for f in self.fields]
+
+    T = types.ModuleType("pyspark.sql.types")
+    for n in ("Binary", "Boolean", "Double", "Float", "Integer", "Long",
+              "String", "Short"):
+        setattr(T, n + "Type", lambda n=n: _T(n.lower()))
+    T.ArrayType = ArrayType
+    T.StructField = StructField
+    T.StructType = StructType
+
+    pyspark = types.ModuleType("pyspark")
+    sql = types.ModuleType("pyspark.sql")
+    sql.types = T
+    pyspark.sql = sql
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", sql)
+    monkeypatch.setitem(sys.modules, "pyspark.sql.types", T)
+    return T
+
+
+def test_to_spark_schema_maps_all_types(monkeypatch):
+    _stub_pyspark(monkeypatch)
+    from tensorflowonspark_tpu.data import spark_io
+
+    st = spark_io.to_spark_schema(
+        "struct<a:int,b:array<float>,c:string,d:long,e:binary>"
+    )
+    assert st.fieldNames() == ["a", "b", "c", "d", "e"]
+    assert repr(st.fields[0].dtype) == "integer"
+    assert repr(st.fields[1].dtype) == "array<float>"
+    assert repr(st.fields[4].dtype) == "binary"
+
+
+def test_rows_to_dataframe_requires_schema_for_empty(monkeypatch):
+    _stub_pyspark(monkeypatch)
+    from tensorflowonspark_tpu.data import spark_io
+
+    class _Spark:
+        def createDataFrame(self, data, schema=None):
+            return (data, schema)
+
+    with pytest.raises(ValueError, match="zero rows"):
+        spark_io.rows_to_dataframe(_Spark(), [])
+
+    data, schema = spark_io.rows_to_dataframe(
+        _Spark(), [{"a": 1, "b": "x"}], schema="struct<a:int,b:string>"
+    )
+    assert data == [(1, "x")]
+    assert schema.fieldNames() == ["a", "b"]
