@@ -1,0 +1,113 @@
+"""MNIST streaming training: unbounded micro-batches + external stop.
+
+Reference-parity app for ``examples/mnist/estimator/mnist_spark_streaming.py``
+(reference: examples/mnist/estimator/mnist_spark_streaming.py — DStream
+feeding with ``foreachRDD`` and a reservation-STOP shutdown via
+examples/utils/stop_streaming.py).  Here the stream is any iterator of
+partition micro-batches driven through ``cluster.train_stream``; stop it
+from another terminal with::
+
+    python examples/utils/stop_cluster.py <host> <port>
+
+(the host:port is printed at startup), or let ``--max_batches`` end it.
+
+Run (CPU smoke):
+    JAX_PLATFORMS=cpu python examples/mnist/mnist_streaming.py --max_batches 5
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+)
+
+
+def main_fun(args, ctx):
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import mlp
+    from tensorflowonspark_tpu.parallel import dp
+
+    model = mlp.MNISTNet(hidden=128)
+    params = model.init(
+        jax.random.PRNGKey(0), np.zeros((1, 784), np.float32)
+    )["params"]
+    trainer = dp.SyncTrainer(mlp.loss_fn(model), optax.adam(1e-3), has_aux=True)
+    state = trainer.create_state(params)
+
+    feed = ctx.get_data_feed(train_mode=True)
+
+    def preprocess(rows):
+        images = np.stack([np.asarray(r[0], np.float32) for r in rows])
+        labels = np.asarray([int(np.ravel(r[1])[0]) for r in rows], np.int64)
+        return {"image": images, "label": labels}
+
+    # the stream never "ends" from the trainer's view — it trains until
+    # the end-of-feed sentinel arrives at shutdown
+    state = trainer.train_on_feed(
+        state, feed, batch_size=args.batch_size, preprocess=preprocess,
+        log_every=10,
+    )
+    print("worker %d trained %d steps" % (ctx.task_index, int(state.step)))
+
+
+def micro_batches(cluster_size, batch_rows, interval_secs, max_batches):
+    """Simulated stream source: yields lists of partitions forever
+    (the DStream role).  A real deployment replaces this with Kafka /
+    file-watcher / socket ingestion."""
+    from mnist_data_setup import synthetic_mnist
+
+    i = 0
+    while max_batches is None or i < max_batches:
+        x, y = synthetic_mnist(batch_rows, seed=i)
+        rows = [(x[j], int(y[j])) for j in range(len(x))]
+        yield [rows[k::cluster_size] for k in range(cluster_size)]
+        i += 1
+        if interval_secs:
+            time.sleep(interval_secs)
+
+
+def main():
+    from tensorflowonspark_tpu import setup_logging
+    from tensorflowonspark_tpu.cluster import cluster as tfcluster
+
+    setup_logging()
+    p = argparse.ArgumentParser()
+    p.add_argument("--cluster_size", type=int, default=2)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--rows_per_micro_batch", type=int, default=512)
+    p.add_argument("--interval_secs", type=float, default=0.0)
+    p.add_argument("--max_batches", type=int, default=None,
+                   help="stop after N micro-batches (default: run until "
+                        "an external STOP)")
+    args = p.parse_args()
+
+    cluster = tfcluster.run(
+        args.cluster_size,
+        main_fun,
+        args,
+        num_executors=args.cluster_size,
+        input_mode=tfcluster.InputMode.SPARK,
+    )
+    host, port = cluster.cluster_meta["server_addr"]
+    print("streaming; stop externally with: "
+          "python examples/utils/stop_cluster.py {0} {1}".format(host, port))
+    fed = cluster.train_stream(
+        micro_batches(
+            args.cluster_size,
+            args.rows_per_micro_batch,
+            args.interval_secs,
+            args.max_batches,
+        )
+    )
+    print("stream ended after %d micro-batches" % fed)
+    cluster.shutdown(grace_secs=2)
+
+
+if __name__ == "__main__":
+    main()

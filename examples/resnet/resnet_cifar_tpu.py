@@ -42,19 +42,21 @@ def main_fun(args, ctx):
 
     platform = jax.devices()[0].platform
     dtype = "bfloat16" if platform in ("tpu", "gpu") else "float32"
+    # every arch-derived value set in one place
     if args.arch == "resnet50":
         # ImageNet-class workload (reference: resnet_imagenet_main.py)
         model = resnet.ResNet50(num_classes=1000, dtype=dtype)
-        hw = args.image_size
+        hw, num_classes, dataset_size = args.image_size, 1000, 1_281_167
+        name = "resnet50"
     else:
         model = resnet.ResNetCIFAR(depth=args.depth, dtype=dtype)
-        hw = 32
+        hw, num_classes, dataset_size = 32, 10, 50_000
+        name = "resnet%d" % args.depth
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, hw, hw, 3)))
 
     # LR schedule shape follows the reference defaults (0.1 → /10 at
     # epoch boundaries 91/136, reference: resnet_cifar_dist.py:33-35);
     # epoch length tracks the modeled dataset (CIFAR 50k / ImageNet 1.28M)
-    dataset_size = 1_281_167 if args.arch == "resnet50" else 50_000
     steps_per_epoch = max(1, dataset_size // args.batch_size)
     schedule = optax.piecewise_constant_schedule(
         0.1, {91 * steps_per_epoch: 0.1, 136 * steps_per_epoch: 0.1}
@@ -72,7 +74,6 @@ def main_fun(args, ctx):
     # synthetic image batch (reference: common.py:315-363)
     rng = np.random.RandomState(0)
     x = rng.rand(args.batch_size, hw, hw, 3).astype(np.float32)
-    num_classes = 1000 if args.arch == "resnet50" else 10
     y = (np.arange(args.batch_size) % num_classes).astype(np.int32)
 
     warmup = min(3, args.steps)
@@ -86,7 +87,6 @@ def main_fun(args, ctx):
     jax.block_until_ready(metrics["loss"])
     dt = time.perf_counter() - t0
     ips = args.batch_size * args.steps / dt
-    name = "resnet50" if args.arch == "resnet50" else "resnet%d" % args.depth
     print(
         "%s %s: %d steps, %.1f images/sec, final loss %.4f"
         % (name, platform, args.steps, ips, float(metrics["loss"]))

@@ -82,19 +82,47 @@ def rows_to_dataframe(spark, rows, schema=None):
 
 def save_df_as_tfrecords(df, path, num_shards=1):
     """DataFrame → TFRecord shards via the native codec
-    (reference: dfutil.py:29-41 saveAsTFRecords)."""
+    (reference: dfutil.py:29-41 saveAsTFRecords).  A DataFrame loaded
+    by :func:`load_tfrecords_df` reuses its known schema instead of
+    re-inferring types per row."""
     from tensorflowonspark_tpu.data import interchange
 
     return interchange.save_as_tfrecords(
-        dataframe_to_rows(df), path, num_shards=num_shards
+        dataframe_to_rows(df),
+        path,
+        schema=loaded_schema(df),
+        num_shards=num_shards,
     )
 
 
 def load_tfrecords_df(spark, path, schema=None, binary_features=()):
-    """TFRecords → DataFrame (reference: dfutil.py:44-81 loadTFRecords)."""
+    """TFRecords → DataFrame (reference: dfutil.py:44-81 loadTFRecords).
+    The result is marked for :func:`is_loaded_df` provenance checks."""
     from tensorflowonspark_tpu.data import interchange
 
     rows, schema = interchange.load_tfrecords(
         path, schema=schema, binary_features=binary_features
     )
-    return rows_to_dataframe(spark, rows, schema)
+    df = rows_to_dataframe(spark, rows, schema)
+    mark_loaded_df(df, schema)
+    return df
+
+
+def mark_loaded_df(df, schema):
+    """Record that ``df`` originated from TFRecords (its interchange
+    schema is known exactly — no re-inference needed on save)."""
+    df._tfos_loaded_schema = schema
+    return df
+
+
+def is_loaded_df(df):
+    """True when ``df`` was produced by :func:`load_tfrecords_df`
+    (reference: dfutil.py:15-26 ``isLoadedDF`` provenance registry;
+    here the mark rides the DataFrame object itself — the reference's
+    global dict keyed by id() could alias recycled ids)."""
+    return getattr(df, "_tfos_loaded_schema", None) is not None
+
+
+def loaded_schema(df):
+    """The interchange schema a loaded DataFrame carries, or ``None``."""
+    return getattr(df, "_tfos_loaded_schema", None)

@@ -1,0 +1,97 @@
+"""Dataset pipeline tests: the tf.data-role chain."""
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu.data.dataset import Dataset
+
+
+def _ds(n=20):
+    return Dataset.from_arrays(
+        x=np.arange(n * 2, dtype=np.float32).reshape(n, 2),
+        y=np.arange(n, dtype=np.int64),
+    )
+
+
+def test_requires_batch():
+    with pytest.raises(ValueError, match="batch"):
+        list(_ds())
+
+
+def test_batch_shapes_and_drop_remainder():
+    batches = list(_ds(10).batch(4))
+    assert len(batches) == 2  # remainder of 2 dropped
+    assert batches[0]["x"].shape == (4, 2)
+    batches = list(_ds(10).batch(4, drop_remainder=False))
+    assert len(batches) == 3
+    assert batches[-1]["x"].shape == (2, 2)
+
+
+def test_rows_unchanged_without_shuffle():
+    batches = list(_ds(8).batch(4))
+    np.testing.assert_array_equal(batches[0]["y"], [0, 1, 2, 3])
+    np.testing.assert_array_equal(batches[1]["y"], [4, 5, 6, 7])
+
+
+def test_shuffle_is_epoch_varying_but_seeded():
+    a = [b["y"] for b in _ds(16).shuffle(seed=1).repeat(2).batch(16)]
+    b = [b["y"] for b in _ds(16).shuffle(seed=1).repeat(2).batch(16)]
+    np.testing.assert_array_equal(a[0], b[0])  # deterministic per seed
+    assert not np.array_equal(a[0], a[1])  # reshuffled across epochs
+    assert sorted(a[0]) == sorted(a[1]) == list(range(16))
+
+
+def test_repeat_and_steps_per_epoch():
+    ds = _ds(12).repeat(3).batch(4)
+    assert ds.steps_per_epoch() == 3
+    assert len(list(ds)) == 9
+
+
+def test_shard_partitions_rows():
+    d0 = _ds(10).shard(2, 0)
+    d1 = _ds(10).shard(2, 1)
+    assert d0.num_rows == d1.num_rows == 5
+    y = np.concatenate([d0._columns["y"], d1._columns["y"]])
+    assert sorted(y) == list(range(10))
+    with pytest.raises(ValueError):
+        _ds().shard(2, 2)
+
+
+def test_map_applies_per_batch():
+    ds = _ds(8).batch(4).map(lambda b: {"x2": b["x"] * 2, "y": b["y"]})
+    out = next(iter(ds))
+    assert set(out) == {"x2", "y"}
+    np.testing.assert_array_equal(out["x2"][0], [0.0, 2.0])
+
+
+def test_mismatched_columns_rejected():
+    with pytest.raises(ValueError, match="equal lengths"):
+        Dataset.from_arrays(a=np.zeros(3), b=np.zeros(4))
+
+
+def test_from_tfrecords_columnar(tmp_path):
+    from tensorflowonspark_tpu.data import interchange
+
+    rows = [
+        {"feat": np.arange(4, dtype=np.float32) + i, "label": i}
+        for i in range(9)
+    ]
+    path = str(tmp_path / "recs")
+    interchange.save_as_tfrecords(rows, path)
+    ds = Dataset.from_tfrecords(
+        path, {"feat": ("float32", 4), "label": ("int64", 1)}
+    )
+    assert ds.num_rows == 9
+    batch = next(iter(ds.batch(9)))
+    assert batch["feat"].shape == (9, 4)
+    assert batch["label"].shape == (9,)  # width-1 squeezed
+    assert sorted(batch["label"]) == list(range(9))
+
+
+def test_prefetch_yields_device_batches():
+    import jax
+
+    ds = _ds(8).batch(4)
+    out = list(ds.prefetch(size=2))
+    assert len(out) == 2
+    assert isinstance(out[0]["x"], jax.Array)

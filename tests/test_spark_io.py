@@ -92,3 +92,17 @@ def test_rows_to_dataframe_requires_schema_for_empty(monkeypatch):
     )
     assert data == [(1, "x")]
     assert schema.fieldNames() == ["a", "b"]
+
+
+def test_loaded_df_provenance(monkeypatch):
+    _stub_pyspark(monkeypatch)
+    from tensorflowonspark_tpu.data import spark_io
+
+    class _DF:
+        pass
+
+    df = _DF()
+    assert not spark_io.is_loaded_df(df)
+    spark_io.mark_loaded_df(df, [("a", "int")])
+    assert spark_io.is_loaded_df(df)
+    assert spark_io.loaded_schema(df) == [("a", "int")]
