@@ -33,6 +33,8 @@ def main_fun(args, ctx):
     from tensorflowonspark_tpu.models import mlp
     from tensorflowonspark_tpu.parallel import dp
 
+    ctx.initialize_distributed()
+
     model = mlp.MNISTNet(hidden=128)
     params = model.init(
         jax.random.PRNGKey(0), np.zeros((1, 784), np.float32)

@@ -139,6 +139,15 @@ class Dataset(object):
             raise ValueError("call .batch(n) before iterating")
         batch_size, drop_remainder = batch_spec
         n = self.num_rows
+        if drop_remainder and n < batch_size:
+            # zero full batches per epoch: with repeat(None) the epoch
+            # loop would spin forever yielding nothing
+            raise ValueError(
+                "dataset has {0} rows — fewer than one batch of {1}; "
+                "reduce batch_size or disable drop_remainder".format(
+                    n, batch_size
+                )
+            )
         epoch = 0
         while epochs is None or epoch < epochs:
             if shuffle_seed is not None:

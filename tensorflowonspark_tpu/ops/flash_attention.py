@@ -83,7 +83,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l_safe)
+    # lse rides a trailing singleton lane axis: Mosaic requires the
+    # last two block dims to be (8k, 128k) or equal to the array's —
+    # (block_q, 1) satisfies that where a rank-3 (1, block_q) cannot
+    lse_ref[0, 0, :, 0] = m + jnp.log(l_safe)
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
@@ -91,8 +94,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(2)
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0]  # [block_q]
-    delta = delta_ref[0, 0]  # [block_q]
+    lse = lse_ref[0, 0, :, 0]  # [block_q]
+    delta = delta_ref[0, 0, :, 0]  # [block_q]
 
     num_k_blocks = seq_len // block_k
     if causal:
@@ -152,8 +155,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -222,11 +225,11 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(qt, kt, vt)
@@ -243,7 +246,7 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
     # delta_i = rowsum(dout * out): the softmax-jacobian correction term
     delta = jnp.sum(
         dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
-    )  # [B,H,S]
+    )[..., None]  # [B,H,S,1] (lane axis; see lse layout note)
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
@@ -257,8 +260,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
-            pl.BlockSpec((1, 1, bq), lambda bi, hi, qi: (bi, hi, qi)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)
@@ -279,8 +282,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
             pl.BlockSpec((1, 1, s, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, kj: (bi, hi, 0)),
-            pl.BlockSpec((1, 1, s), lambda bi, hi, kj: (bi, hi, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),

@@ -95,3 +95,12 @@ def test_prefetch_yields_device_batches():
     out = list(ds.prefetch(size=2))
     assert len(out) == 2
     assert isinstance(out[0]["x"], jax.Array)
+
+
+def test_sub_batch_dataset_rejected_not_hung():
+    ds = Dataset.from_arrays(x=np.zeros(3)).repeat(None).batch(8)
+    with pytest.raises(ValueError, match="fewer than one batch"):
+        next(iter(ds))
+    # non-drop mode still yields the short batch
+    out = list(Dataset.from_arrays(x=np.zeros(3)).batch(8, drop_remainder=False))
+    assert out[0]["x"].shape == (3,)
