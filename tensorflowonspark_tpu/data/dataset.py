@@ -139,13 +139,15 @@ class Dataset(object):
             raise ValueError("call .batch(n) before iterating")
         batch_size, drop_remainder = batch_spec
         n = self.num_rows
-        if drop_remainder and n < batch_size:
-            # zero full batches per epoch: with repeat(None) the epoch
-            # loop would spin forever yielding nothing
+        if n == 0 or (drop_remainder and n < batch_size):
+            # zero batches per epoch: with repeat(None) the epoch loop
+            # would spin forever yielding nothing
             raise ValueError(
                 "dataset has {0} rows — fewer than one batch of {1}; "
-                "reduce batch_size or disable drop_remainder".format(
-                    n, batch_size
+                "add data, reduce batch_size{2}".format(
+                    n,
+                    batch_size,
+                    "" if n == 0 else ", or disable drop_remainder",
                 )
             )
         epoch = 0

@@ -1,0 +1,30 @@
+"""compat shim tests (reference: tensorflowonspark/compat.py roles)."""
+
+import numpy as np
+
+from tensorflowonspark_tpu import compat
+
+
+def test_export_saved_model_chief_only(tmp_path):
+    params = {"w": np.arange(3, dtype=np.float32)}
+    assert compat.export_saved_model(params, str(tmp_path / "e"), is_chief=False) is None
+    out = compat.export_saved_model(
+        params, str(tmp_path / "e"), is_chief=True,
+        metadata={"model_ref": "tensorflowonspark_tpu.models.linear:serving_builder"},
+    )
+    assert out is not None
+    from tensorflowonspark_tpu.checkpoint import load_for_serving
+
+    loaded, meta = load_for_serving(str(tmp_path / "e"))
+    np.testing.assert_array_equal(loaded["w"], params["w"])
+    assert "model_ref" in meta
+
+
+def test_disable_auto_shard_noop():
+    sentinel = object()
+    assert compat.disable_auto_shard(sentinel) is sentinel
+
+
+def test_accelerator_probe_runs():
+    assert compat.is_accelerator_available() in (True, False)
+    assert compat.is_gpu_available is compat.is_accelerator_available

@@ -104,3 +104,9 @@ def test_sub_batch_dataset_rejected_not_hung():
     # non-drop mode still yields the short batch
     out = list(Dataset.from_arrays(x=np.zeros(3)).batch(8, drop_remainder=False))
     assert out[0]["x"].shape == (3,)
+
+
+def test_empty_dataset_rejected_even_without_drop():
+    ds = Dataset.from_arrays(x=np.zeros(0)).repeat(None).batch(8, drop_remainder=False)
+    with pytest.raises(ValueError, match="0 rows"):
+        next(iter(ds))
