@@ -143,11 +143,12 @@ class Dataset(object):
             # zero batches per epoch: with repeat(None) the epoch loop
             # would spin forever yielding nothing
             raise ValueError(
-                "dataset has {0} rows — fewer than one batch of {1}; "
-                "add data, reduce batch_size{2}".format(
+                "dataset has {0} rows — fewer than one batch of {1}; {2}".format(
                     n,
                     batch_size,
-                    "" if n == 0 else ", or disable drop_remainder",
+                    "add data"
+                    if n == 0
+                    else "reduce batch_size or disable drop_remainder",
                 )
             )
         epoch = 0

@@ -14,10 +14,19 @@ more pallas kernels (dq, and dk/dv) that recompute probabilities from
 the saved log-sum-exp rather than storing them.
 
 TPU mapping:
-- grid = (batch, heads, q-blocks); the q/k/v matmuls hit the MXU with
-  ``preferred_element_type=f32`` (bf16 operands stay MXU-native);
-- block sizes default to 512×512 — multiples of the (8,128) f32 /
-  (16,128) bf16 tile shapes;
+- grid = (batch, heads, q-blocks, k-blocks): the K/V *blocks* stream
+  through VMEM via the trailing (sequential, "arbitrary") grid
+  dimension while running state lives in VMEM scratch — only
+  O(block) memory per core, so sequence length is HBM-bound, not
+  VMEM-bound (full-array K/V blocks capped usable seq at ~8k);
+- causal q/k block pairs that are fully masked are skipped with
+  ``pl.when`` (no wasted MXU work on the upper triangle);
+- the matmuls hit the MXU with ``preferred_element_type=f32`` (bf16
+  operands stay MXU-native); block sizes default to 512×512 —
+  multiples of the (8,128) f32 / (16,128) bf16 tile shapes;
+- lse/delta tensors carry a trailing singleton lane axis
+  ``(B, H, S, 1)``: Mosaic requires the last two block dims to be
+  (8k, 128k) or equal to the array's;
 - off-TPU (CPU tests) the same kernels run under ``interpret=True`` so
   numerics are verified against :func:`..attention.dot_attention`
   without TPU hardware (mirrors the reference's shrink-don't-mock test
@@ -37,140 +46,144 @@ def _interpret():
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_len):
+def _scratch(shape, dtype):
+    """VMEM scratch allocation that also works in interpret mode."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
+
+
+def _causal_mask(qi, kj, block_q, block_k):
+    qpos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    kpos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return qpos >= kpos
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, num_k_blocks):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
+    kj = pl.program_id(3)
 
-    m = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros(q.shape, jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    num_k_blocks = seq_len // block_k
+    # causal: skip blocks strictly above the diagonal
+    relevant = True
     if causal:
-        # last k block the diagonal touches for this q block
-        upper = jax.lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        upper = jnp.minimum(upper, num_k_blocks)
-    else:
-        upper = num_k_blocks
+        relevant = kj * block_k < (qi + 1) * block_q
 
-    def body(kj, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(relevant)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k]
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        alpha = jnp.exp(m - m_new)
+            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
+        m_prev = m_scr[:, 0]
+        l_prev = l_scr[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+        m_scr[:, 0] = m_new
+        l_scr[:, 0] = l_prev * alpha + jnp.sum(p, axis=1)
+        acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse rides a trailing singleton lane axis: Mosaic requires the
-    # last two block dims to be (8k, 128k) or equal to the array's —
-    # (block_q, 1) satisfies that where a rank-3 (1, block_q) cannot
-    lse_ref[0, 0, :, 0] = m + jnp.log(l_safe)
+    @pl.when(kj == num_k_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0, 0, :, 0] = m_scr[:, 0] + jnp.log(l_safe)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_k, seq_len):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k, num_k_blocks):
     qi = pl.program_id(2)
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, 0]  # [block_q]
-    delta = delta_ref[0, 0, :, 0]  # [block_q]
+    kj = pl.program_id(3)
 
-    num_k_blocks = seq_len // block_k
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    relevant = True
     if causal:
-        upper = jnp.minimum(
-            jax.lax.div((qi + 1) * block_q + block_k - 1, block_k),
-            num_k_blocks,
-        )
-    else:
-        upper = num_k_blocks
+        relevant = kj * block_k < (qi + 1) * block_q
 
-    def body(kj, dq):
-        k = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+    @pl.when(relevant)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]  # [block_q]
+        delta = delta_ref[0, 0, :, 0]
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros(q.shape, jnp.float32)
-    )
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(kj == num_k_blocks - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, block_q, block_k, seq_len):
+                dk_ref, dv_ref, dk_scr, dv_scr, *,
+                scale, causal, block_q, block_k, num_q_blocks):
     kj = pl.program_id(2)
-    k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
-    v = v_ref[0, 0].astype(jnp.float32)
+    qi = pl.program_id(3)
 
-    num_q_blocks = seq_len // block_q
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    relevant = True
     if causal:
-        # first q block the diagonal touches for this k block
-        lower = jax.lax.div(kj * block_k, block_q)
-    else:
-        lower = 0
+        # q blocks strictly above the diagonal contribute nothing
+        relevant = (qi + 1) * block_q > kj * block_k
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
-        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+    @pl.when(relevant)
+    def _compute():
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, :, 0]
+        delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            qpos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            kpos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
         p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
-        dv_new = dv + jax.lax.dot_general(
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -179,18 +192,15 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk_new, dv_new
 
-    dk, dv = jax.lax.fori_loop(
-        lower, num_q_blocks, body,
-        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
-    )
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == num_q_blocks - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _block_sizes(seq_len, block_q, block_k):
@@ -210,26 +220,31 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
     bq, bk = _block_sizes(s, block_q, block_k)
     # [B,S,H,D] -> [B,H,S,D]: heads become a grid dim, seq stays blocked
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    grid = (b, h, s // bq)
+    grid = (b, h, s // bq, s // bk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, seq_len=s,
+        block_q=bq, block_k=bk, num_k_blocks=s // bk,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _scratch((bq, 1), jnp.float32),  # running max
+            _scratch((bq, 1), jnp.float32),  # running normalizer
+            _scratch((bq, d), jnp.float32),  # output accumulator
         ],
         interpret=_interpret(),
     )(qt, kt, vt)
@@ -250,48 +265,53 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, seq_len=s,
+        block_q=bq, block_k=bk, num_k_blocks=s // bk,
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b, h, s // bq),
+        grid=(b, h, s // bq, s // bk),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)
+            (1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        scratch_shapes=[_scratch((bq, d), jnp.float32)],
         interpret=_interpret(),
     )(qt, kt, vt, dot_, lse, delta)
 
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, seq_len=s,
+        block_q=bq, block_k=bk, num_q_blocks=s // bq,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, s // bk),
+        grid=(b, h, s // bk, s // bq),
         in_specs=[
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
-            pl.BlockSpec((1, 1, s, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, s, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
             jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+        ],
+        scratch_shapes=[
+            _scratch((bk, d), jnp.float32),
+            _scratch((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(qt, kt, vt, dot_, lse, delta)

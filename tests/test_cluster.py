@@ -283,3 +283,26 @@ def test_eval_node_role(engine):
         time.sleep(0.5)
     assert m.get("saw_evaluator")._getvalue() is True
     cluster.shutdown(timeout=60)
+
+
+def _never_consume_fn(args, ctx):
+    import time as _t
+
+    while True:
+        _t.sleep(0.5)
+
+
+def test_feed_timeout_expires(engine):
+    # a wedged consumer must fail the feed with the timeout error, not
+    # hang the feeder forever (reference: TFSparkNode.py:475-483)
+    cluster = tpu_cluster.run(
+        engine,
+        _never_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    with pytest.raises(RuntimeError, match="timed out waiting"):
+        cluster.train([[1, 2, 3]], feed_timeout=5)
+    # teardown proceeds despite the wedged compute (bounded wait)
+    cluster.shutdown(grace_secs=0, timeout=5)
