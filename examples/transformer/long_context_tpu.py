@@ -29,8 +29,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--virtual_devices", type=int, default=0,
                    help="N virtual CPU devices (testing without a pod)")
-    p.add_argument("--attention", choices=("ring", "ulysses", "dot"),
-                   default="ring")
+    p.add_argument("--attention", choices=("ring", "ulysses", "flash", "dot"),
+                   default="ring",
+                   help="ring/ulysses shard the sequence across chips; "
+                        "flash streams K/V blocks on one chip (pallas)")
     p.add_argument("--seq_len", type=int, default=1024)
     p.add_argument("--batch_size", type=int, default=2)
     p.add_argument("--num_layers", type=int, default=2)
@@ -60,9 +62,27 @@ def main():
     from tensorflowonspark_tpu.parallel import dp
     from tensorflowonspark_tpu.parallel.mesh import build_mesh
 
+    import math
+
     n_dev = len(jax.devices())
-    seq_par = args.seq_parallel or n_dev
-    mesh = build_mesh({"data": n_dev // seq_par, "seq": seq_par})
+    # flash/dot ignore the seq axis: give devices to data parallelism
+    # there, capped so the batch still divides the data axis
+    if args.attention in ("ring", "ulysses"):
+        seq_par = args.seq_parallel or n_dev
+        data_par = n_dev // seq_par
+    else:
+        seq_par = args.seq_parallel or 1
+        data_par = math.gcd(args.batch_size, n_dev // seq_par)
+        if data_par * seq_par < n_dev:
+            print(
+                "note: %d devices idle (batch %d limits data parallelism "
+                "to %d); raise --batch_size to use them"
+                % (n_dev - data_par * seq_par, args.batch_size, data_par)
+            )
+    mesh = build_mesh(
+        {"data": data_par, "seq": seq_par},
+        devices=jax.devices()[: data_par * seq_par],
+    )
     print("mesh:", dict(mesh.shape), "attention:", args.attention)
 
     cfg = tr.TransformerConfig(
@@ -109,8 +129,12 @@ def main():
             "step %d loss %.4f (%.0f ms)"
             % (i, loss, 1e3 * (time.perf_counter() - t0))
         )
-    print("done: seq_len=%d over %d-way sequence parallelism" % (
-        args.seq_len, seq_par))
+    if args.attention in ("ring", "ulysses"):
+        print("done: seq_len=%d over %d-way sequence parallelism" % (
+            args.seq_len, seq_par))
+    else:
+        print("done: seq_len=%d single-chip (%s attention)" % (
+            args.seq_len, args.attention))
 
 
 if __name__ == "__main__":
