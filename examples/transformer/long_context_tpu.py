@@ -65,23 +65,33 @@ def main():
     import math
 
     n_dev = len(jax.devices())
-    # flash/dot ignore the seq axis: give devices to data parallelism
-    # there, capped so the batch still divides the data axis
     if args.attention in ("ring", "ulysses"):
         seq_par = args.seq_parallel or n_dev
+        if n_dev % seq_par:
+            sys.exit(
+                "--seq_parallel {0} must divide the device count {1}".format(
+                    seq_par, n_dev
+                )
+            )
         data_par = n_dev // seq_par
     else:
-        seq_par = args.seq_parallel or 1
-        data_par = math.gcd(args.batch_size, n_dev // seq_par)
-        if data_par * seq_par < n_dev:
-            print(
-                "note: %d devices idle (batch %d limits data parallelism "
-                "to %d); raise --batch_size to use them"
-                % (n_dev - data_par * seq_par, args.batch_size, data_par)
+        # flash/dot ignore the seq axis entirely: all devices go to data
+        # parallelism, capped so the batch still divides the data axis
+        if args.seq_parallel:
+            sys.exit(
+                "--seq_parallel only applies to ring/ulysses attention"
             )
+        seq_par = 1
+        data_par = math.gcd(args.batch_size, n_dev)
+    used = data_par * seq_par
+    if used < n_dev:
+        print(
+            "note: %d of %d devices idle (batch %d limits data "
+            "parallelism to %d); raise --batch_size to use them"
+            % (n_dev - used, n_dev, args.batch_size, data_par)
+        )
     mesh = build_mesh(
-        {"data": data_par, "seq": seq_par},
-        devices=jax.devices()[: data_par * seq_par],
+        {"data": data_par, "seq": seq_par}, devices=jax.devices()[:used]
     )
     print("mesh:", dict(mesh.shape), "attention:", args.attention)
 

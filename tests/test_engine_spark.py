@@ -1,0 +1,85 @@
+"""SparkEngine adapter tests against a stub SparkContext (pyspark is
+not in the test image; the adapter's protocol is what matters —
+reference architecture: TFCluster.py drives nodeRDD/dataRDD jobs)."""
+
+from tensorflowonspark_tpu.engine import SparkEngine
+
+
+class _FakeRDD:
+    def __init__(self, data):
+        self._parts = data
+
+    def mapPartitions(self, fn):
+        out = []
+        for part in self._parts:
+            out.append(list(fn(iter(part))))
+        self._mapped = out
+        return self
+
+    def collect(self):
+        return [x for part in self._mapped for x in part]
+
+    def foreachPartition(self, fn):
+        for part in self._parts:
+            fn(iter(part))
+
+
+class _FakeConf:
+    def __init__(self, d):
+        self._d = d
+
+    def get(self, k, default=None):
+        return self._d.get(k, default)
+
+
+class _FakeStatusTracker:
+    def getActiveJobsIds(self):
+        return [1, 2]
+
+
+class _FakeSC:
+    def __init__(self):
+        self.parallelize_calls = []
+
+    def getConf(self):
+        return _FakeConf({"spark.executor.instances": "3"})
+
+    def parallelize(self, data, num_slices):
+        self.parallelize_calls.append((data, num_slices))
+        return _FakeRDD([[p] for p in data])
+
+    def statusTracker(self):
+        return _FakeStatusTracker()
+
+    # no _jsc: default_fs falls back to file://
+
+
+def test_spark_engine_metadata():
+    eng = SparkEngine(_FakeSC())
+    assert eng.num_executors == 3
+    assert eng.num_executors_exact is False  # dynamic allocation caveat
+    assert eng.default_fs == "file://"
+    assert eng.num_active_jobs() == 2
+
+
+def test_spark_engine_run_job_collect():
+    sc = _FakeSC()
+    eng = SparkEngine(sc)
+    results = eng.run_job(
+        lambda it: [x * 2 for x in it], [[1, 2], [3]], collect=True
+    )
+    assert sorted(results) == [2, 4, 6]
+    (data, n), = sc.parallelize_calls
+    assert n == 2  # one Spark partition per logical partition
+
+
+def test_spark_engine_run_job_foreach():
+    sc = _FakeSC()
+    eng = SparkEngine(sc)
+    seen = []
+
+    def mapfn(it):
+        seen.append(sorted(it))
+
+    assert eng.run_job(mapfn, [[1, 2], [3]], collect=False) is None
+    assert sorted(seen) == [[1, 2], [3]]
