@@ -74,10 +74,18 @@ def main():
                 )
             )
         data_par = n_dev // seq_par
+        if args.batch_size % data_par:
+            sys.exit(
+                "--batch_size {0} must divide by the data axis {1} "
+                "(= devices {2} / seq_parallel {3}); raise batch_size or "
+                "seq_parallel".format(
+                    args.batch_size, data_par, n_dev, seq_par
+                )
+            )
     else:
         # flash/dot ignore the seq axis entirely: all devices go to data
         # parallelism, capped so the batch still divides the data axis
-        if args.seq_parallel:
+        if args.seq_parallel and args.seq_parallel != 1:
             sys.exit(
                 "--seq_parallel only applies to ring/ulysses attention"
             )
