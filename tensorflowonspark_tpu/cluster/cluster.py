@@ -87,27 +87,44 @@ class TPUCluster(object):
 
     # -- data plane ----------------------------------------------------
 
-    def train(self, partitions, num_epochs=1, feed_timeout=600, qname="input"):
-        """Feed data partitions to the cluster for training
+    def train(self, data, num_epochs=1, feed_timeout=600, qname="input"):
+        """Feed a dataset to the cluster for training
         (reference: TFCluster.py:63-94).
 
         Args:
-          partitions: list of lists (rows per partition) — the RDD
-            equivalent.  Epoch repetition mirrors the reference's
-            ``sc.union([rdd] * num_epochs)`` (reference: TFCluster.py:90-93).
+          data: an engine-native dataset (a Spark RDD/DataFrame for
+            :class:`~tensorflowonspark_tpu.engine.SparkEngine` — fed in
+            place via ``foreachPartition``, rows never transit the
+            driver, reference: TFCluster.py:90-94), OR a list of
+            partitions where each partition is a row list or a zero-arg
+            callable returning rows (callables are generated on the
+            executors — the lazy large-dataset path for LocalEngine).
+          num_epochs: epochs are fed by re-running the feed job — no
+            driver-side copies (the reference built one
+            ``sc.union([rdd] * num_epochs)`` job, TFCluster.py:90-93;
+            same data motion, per-epoch jobs here).
         """
-        logger.info(
-            "feeding %d partitions x %d epochs", len(partitions), num_epochs
-        )
         assert self.input_mode == InputMode.SPARK, (
             "train() requires InputMode.SPARK"
         )
         assert num_epochs >= 1
-        repeated = [list(p) for _ in range(num_epochs) for p in partitions]
-        self.engine.run_job(
-            node.train(self.cluster_info, self.cluster_meta, feed_timeout, qname),
-            repeated,
+        feed_fn = node.train(
+            self.cluster_info, self.cluster_meta, feed_timeout, qname
         )
+        if self.engine.is_native_dataset(data):
+            logger.info("feeding native dataset x %d epochs", num_epochs)
+            for _ in range(num_epochs):
+                self.engine.run_data_job(feed_fn, data)
+            return
+        # normalize once so generators of partitions and one-shot
+        # iterator partitions survive multi-epoch re-feeding (callables
+        # stay lazy — they regenerate rows on the executor every epoch)
+        data = [p if callable(p) else list(p) for p in data]
+        logger.info(
+            "feeding %d partitions x %d epochs", len(data), num_epochs
+        )
+        for _ in range(num_epochs):
+            self.engine.run_job(feed_fn, data)
 
     def train_stream(self, batches, feed_timeout=600, qname="input"):
         """Feed an unbounded stream of partition micro-batches.
@@ -125,35 +142,56 @@ class TPUCluster(object):
             "train_stream() requires InputMode.SPARK"
         )
         fed = 0
+        feed_fn = node.train(
+            self.cluster_info, self.cluster_meta, feed_timeout, qname
+        )
         for partitions in batches:
             if self.server.stop_requested:
                 logger.info(
                     "stop requested after %d stream batches; ending feed", fed
                 )
                 break
-            self.engine.run_job(
-                node.train(
-                    self.cluster_info, self.cluster_meta, feed_timeout, qname
-                ),
-                [list(p) for p in partitions],
-            )
+            if self.engine.is_native_dataset(partitions):
+                # a stream of RDDs — the foreachRDD contract
+                # (reference: TFCluster.py:83-85)
+                self.engine.run_data_job(feed_fn, partitions)
+            else:
+                self.engine.run_job(
+                    feed_fn,
+                    [p if callable(p) else list(p) for p in partitions],
+                )
             fed += 1
         logger.info("stream feed complete after %d batches", fed)
         return fed
 
-    def inference(self, partitions, feed_timeout=600, qname="input"):
-        """Feed data for inference and collect results
-        (reference: TFCluster.py:96-115; results RDD → list here)."""
+    def inference(self, data, feed_timeout=600, qname="input", lazy=False):
+        """Feed data for inference and return results
+        (reference: TFCluster.py:96-115).
+
+        Args:
+          data: engine-native dataset or partition list (see
+            :meth:`train`).
+          lazy: return results without materializing them on the driver:
+            a lazy result RDD for a native Spark dataset (the
+            reference's exact contract — ``mapPartitions``, evaluated
+            when acted on) or a per-partition generator for
+            LocalEngine.  Default eager: a flat result list.
+        """
         assert self.input_mode == InputMode.SPARK, (
             "inference() requires InputMode.SPARK"
         )
-        return self.engine.run_job(
-            node.inference(
-                self.cluster_info, self.cluster_meta, feed_timeout, qname
-            ),
-            [list(p) for p in partitions],
-            collect=True,
+        feed_fn = node.inference(
+            self.cluster_info, self.cluster_meta, feed_timeout, qname
         )
+        if self.engine.is_native_dataset(data):
+            result = self.engine.map_partitions_native(feed_fn, data)
+            if lazy:
+                return result
+            return result.collect()
+        data = [p if callable(p) else list(p) for p in data]
+        if lazy:
+            return self.engine.run_job_lazy(feed_fn, data)
+        return self.engine.run_job(feed_fn, data, collect=True)
 
     # -- lifecycle -----------------------------------------------------
 

@@ -30,6 +30,7 @@ manager over TCP to kill tensorboard, post end-of-feed sentinels, and
 check the error queue (no shutdown job on the executors).
 """
 
+import collections
 import json
 import logging
 import multiprocessing
@@ -199,9 +200,26 @@ def _register_local_manager(mgr):
     _LOCAL_MANAGERS.append(mgr)
 
 
-#: Keepalive for shm feed rings created by this executor (segment dies
-#: with its creating process; see TFOS_SHM_FEED in run()).
+#: Keepalive for shm feed rings created by this executor, as
+#: ``(cluster_id, ring)`` pairs (segment dies with its creating process;
+#: see TFOS_SHM_FEED in run()).  Rings from *prior* cluster runs are
+#: unlinked when a new run starts — a long-lived executor would
+#: otherwise accumulate one shm segment per cluster run.
 _LOCAL_RINGS = []
+
+
+def _evict_stale_rings(current_cluster_id):
+    kept = []
+    for cluster_id, ring in _LOCAL_RINGS:
+        if cluster_id == current_cluster_id:
+            kept.append((cluster_id, ring))
+            continue
+        try:
+            ring.close(unlink=True)
+            logger.info("unlinked stale shm ring from run %s", cluster_id)
+        except Exception:  # noqa: BLE001 - cleanup is best effort
+            logger.warning("failed to unlink stale shm ring", exc_info=True)
+    _LOCAL_RINGS[:] = kept
 
 
 _MANAGER_FILE = "tfos_manager.json"
@@ -220,15 +238,39 @@ def _read_manager_info(workdir):
         return json.load(f)
 
 
+#: Cached manager connections, keyed by (addr, authkey).  Executor
+#: processes persist across feed tasks, and a fresh connect + queue
+#: proxy setup costs ~100ms — at reference scale that tax is per
+#: partition (the reference reconnected every task,
+#: TFSparkNode.py:97-123; caching is a deliberate improvement).
+#: LRU-bounded so a long-lived executor serving many sequential cluster
+#: runs (each with a fresh addr/authkey) cannot grow it monotonically.
+_MANAGER_CONNS = collections.OrderedDict()
+_MANAGER_CONNS_MAX = 8
+
+
 def _get_manager(cluster_info, executor_id):
-    """Reconnect to the manager of the node hosting ``executor_id``
-    (reference: TFSparkNode.py:97-123; lookup is by executor id — the
-    advertised manager address already encodes the host)."""
+    """Connect (cached) to the manager of the node hosting
+    ``executor_id`` (reference: TFSparkNode.py:97-123; lookup is by
+    executor id — the advertised manager address already encodes the
+    host)."""
     for node in cluster_info:
         if node["executor_id"] == executor_id:
             addr = tuple(node["addr"])
+            key = (addr, node["authkey"])
+            m = _MANAGER_CONNS.get(key)
+            if m is not None:
+                try:
+                    m.get("state")  # liveness probe (~1ms RPC)
+                    _MANAGER_CONNS.move_to_end(key)
+                    return m
+                except Exception:  # noqa: BLE001 - stale: reconnect below
+                    _MANAGER_CONNS.pop(key, None)
             authkey = bytes.fromhex(node["authkey"])
             m = manager.connect(addr, authkey)
+            _MANAGER_CONNS[key] = m
+            while len(_MANAGER_CONNS) > _MANAGER_CONNS_MAX:
+                _MANAGER_CONNS.popitem(last=False)
             logger.debug(
                 "connected to manager of executor %d at %s", executor_id, addr
             )
@@ -383,8 +425,10 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
                         "TFOS_SHM_FEED_BYTES", shm_ring.DEFAULT_CAPACITY
                     )
                 )
+                _evict_stale_rings(cluster_meta["id"])
                 ring = shm_ring.ShmRing(ring_name, ring_cap, create=True)
-                _LOCAL_RINGS.append(ring)  # keepalive: executor lifetime
+                # keepalive until a later run evicts it
+                _LOCAL_RINGS.append((cluster_meta["id"], ring))
                 mgr.set(
                     "shm_ring", {"name": ring_name, "capacity": ring_cap}
                 )
@@ -674,10 +718,9 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                         "(feed_timeout exceeded)"
                     )
         joinThr = _JoinWatcher(queue)
-        while joinThr.is_alive():
+        while not joinThr.wait(0.1):
             _check_error_queue(mgr, err_q)
-            time.sleep(1)
-            timeout -= 1
+            timeout -= 0.1
             if timeout <= 0:
                 raise RuntimeError(
                     "timed out waiting for consumption of all batches "
@@ -738,10 +781,9 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         err_q = mgr.get_queue("error")
         joinThr = _JoinWatcher(queue_in)
         timeout = feed_timeout
-        while joinThr.is_alive():
+        while not joinThr.wait(0.1):
             _check_error_queue(mgr, err_q)
-            time.sleep(1)
-            timeout -= 1
+            timeout -= 0.1
             if timeout <= 0:
                 raise RuntimeError("timed out waiting for inference consumption")
         _check_error_queue(mgr, err_q)
@@ -798,3 +840,11 @@ class _JoinWatcher(object):
 
     def is_alive(self):
         return self._t.is_alive()
+
+    def wait(self, timeout):
+        """Block up to ``timeout`` for the join to finish; True when the
+        queue fully drained.  Event-based — a fast consumer releases the
+        feeder in milliseconds, where a fixed 1s poll made EVERY feed
+        task pay a full second (8 small partitions = 8s of pure wait)."""
+        self._t.join(timeout)
+        return not self._t.is_alive()

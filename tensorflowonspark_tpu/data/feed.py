@@ -67,7 +67,9 @@ class DataFeed(object):
         #: manager kv; None = queue-only feeding
         self._ring = None
         self._ring_checked = False
-        self._last_queue_poll = 0.0
+        #: which source produced the last item ("ring" | "queue") —
+        #: next_batch blocks on the hot source, polls the other
+        self._hot_source = "ring"
 
     def next_batch(self, batch_size):
         """Gets a batch of items from the input queue.
@@ -103,27 +105,40 @@ class DataFeed(object):
                 count += 1
                 continue
             if self._ring is not None:
-                # shm fast path: rows arrive through the ring; the queue
-                # only carries control sentinels (None / EndPartition),
-                # polled at most every 100ms so an idle wait doesn't
-                # hammer the single-threaded manager with RPCs
-                rec = self._ring.pop(timeout=0.05)
-                if rec is not None:
-                    import pickle as _p
+                # shm fast path: rows usually arrive through the ring,
+                # but control sentinels (None / EndPartition) and
+                # fallback Blocks (oversized rows, inference feeds) come
+                # via the queue.  Poll both, blocking on whichever
+                # produced LAST (the hot source) so either path runs at
+                # full rate; switching sources costs one 50ms miss.  (A
+                # fixed non-blocking queue poll throttled to 10/s capped
+                # queue-fed rows at ~2.5k rows/s — the ADVICE.md r1
+                # finding; blocking on the wrong source starved the
+                # other.)
+                import pickle as _p
 
-                    self._pending = _p.loads(rec)
-                    self._pending_pos = 0
-                    continue
-                import time as _time
-
-                now = _time.monotonic()
-                if now - self._last_queue_poll < 0.1:
-                    continue
-                self._last_queue_poll = now
-                try:
-                    item = queue_in.get(block=False)
-                except queue_mod.Empty:
-                    continue
+                if self._hot_source == "queue":
+                    try:
+                        item = queue_in.get(block=True, timeout=0.05)
+                    except queue_mod.Empty:
+                        rec = self._ring.pop(timeout=0)
+                        if rec is None:
+                            continue
+                        self._hot_source = "ring"
+                        self._pending = _p.loads(rec)
+                        self._pending_pos = 0
+                        continue
+                else:
+                    rec = self._ring.pop(timeout=0.05)
+                    if rec is not None:
+                        self._pending = _p.loads(rec)
+                        self._pending_pos = 0
+                        continue
+                    try:
+                        item = queue_in.get(block=False)
+                        self._hot_source = "queue"
+                    except queue_mod.Empty:
+                        continue
             else:
                 item = queue_in.get(block=True)
             if item is None:

@@ -97,11 +97,54 @@ class Engine(object):
     def run_job(self, mapfn, partitions, collect=False):
         """Run ``mapfn(iterator)`` over each partition; blocks.
 
+        A partition may be a list of rows OR a zero-arg callable
+        returning an iterable of rows — callables are shipped to the
+        executor and generated *there*, so a dataset far larger than
+        driver memory never transits the driver (the lazy analogue of
+        the reference feeding the actual RDD in place,
+        reference: TFCluster.py:90-94).
+
         Returns the concatenated per-partition results if ``collect``.
         Spark analogue: ``rdd.mapPartitions(...).collect()`` /
         ``rdd.foreachPartition(...)``.
         """
         raise NotImplementedError
+
+    def is_native_dataset(self, dataset):
+        """True when ``dataset`` is this engine's own distributed dataset
+        type (an RDD/DataFrame for Spark) and can be fed in place with
+        :meth:`run_data_job` — no driver materialization."""
+        return False
+
+    def run_data_job(self, mapfn, dataset, collect=False):
+        """Run ``mapfn(row_iterator)`` over each partition of an
+        engine-native dataset (see :meth:`is_native_dataset`); blocks.
+        Matches the reference's ``dataRDD.foreachPartition(feed_fn)``
+        hot path (reference: TFCluster.py:90-94, TFSparkNode.py:436-503).
+        """
+        raise NotImplementedError(
+            "{0} has no native dataset type".format(type(self).__name__)
+        )
+
+    def map_partitions_native(self, mapfn, dataset):
+        """Lazily map ``mapfn`` over a native dataset's partitions,
+        returning the engine's lazy result handle (a result RDD for
+        Spark).  Required whenever :meth:`is_native_dataset` can return
+        True — ``TPUCluster.inference`` calls it for native datasets."""
+        raise NotImplementedError(
+            "{0} has no native dataset type".format(type(self).__name__)
+        )
+
+    def run_job_lazy(self, mapfn, partitions):
+        """Run a collect-style job but yield each partition's result list
+        as it completes (partition order preserved).  The local analogue
+        of the reference returning a *lazy* result RDD from
+        ``inference()`` (reference: TFCluster.py:96-115)."""
+        # Default: no incremental machinery — one job per partition, so
+        # each yielded item is that partition's result list and nothing
+        # runs until the consumer advances.
+        for part in partitions:
+            yield self.run_job(mapfn, [part], collect=True)
 
     def run_job_async(self, mapfn, partitions):
         """Launch a job without blocking; returns a :class:`JobHandle`.
@@ -168,6 +211,10 @@ def _executor_main(
         try:
             fn = _pickle.loads(fn_bytes)
             partition = _pickle.loads(part_bytes)
+            if callable(partition):
+                # lazy partition: rows are generated HERE, on the
+                # executor — the driver only shipped the callable
+                partition = partition()
             result = fn(iter(partition))
             result = list(result) if result is not None else []
             result_queue.put((job_id, task_id, True, _pickle.dumps(result)))
@@ -248,6 +295,20 @@ class LocalEngine(Engine):
             # else: straggler of a job whose waiter already gave up — drop
 
     def run_job(self, mapfn, partitions, collect=False):
+        results = []
+        for part_result in self.run_job_lazy(mapfn, partitions):
+            if collect:
+                results.extend(part_result)
+        return results if collect else None
+
+    def run_job_lazy(self, mapfn, partitions):
+        """Collect-style job as a generator: yields each partition's
+        result list in partition order, as soon as it (and its
+        predecessors) complete.  This is the primitive :meth:`run_job`
+        consumes — one copy of the job lifecycle (registration, failure
+        cancellation, cleanup) serves both.  Abandoning the generator
+        early leaves queued tasks to finish; their results are dropped
+        by the dispatcher once the job's queue is retired."""
         my_queue = _queue_mod.Queue()
         with self._lock:
             job_id = self._job_counter
@@ -259,10 +320,14 @@ class LocalEngine(Engine):
             fn_bytes = _pickle.dumps(mapfn)
             ntasks = len(partitions)
             for task_id, part in enumerate(partitions):
+                # callables ship as-is (lazy, executor-side generation);
+                # anything else materializes to a row list
+                payload = part if callable(part) else list(part)
                 self._task_queue.put(
-                    (job_id, task_id, fn_bytes, _pickle.dumps(list(part)))
+                    (job_id, task_id, fn_bytes, _pickle.dumps(payload))
                 )
-            results = [None] * ntasks
+            buffered = {}
+            next_yield = 0
             remaining = ntasks
             while remaining:
                 _, task_id, ok, payload = my_queue.get()
@@ -283,11 +348,11 @@ class LocalEngine(Engine):
                             task_id, job_id, payload
                         )
                     )
-                results[task_id] = _pickle.loads(payload)
+                buffered[task_id] = _pickle.loads(payload)
                 remaining -= 1
-            if collect:
-                return [item for part in results for item in part]
-            return None
+                while next_yield in buffered:
+                    yield buffered.pop(next_yield)
+                    next_yield += 1
         finally:
             with self._lock:
                 self._active_jobs -= 1
@@ -384,20 +449,67 @@ class SparkEngine(Engine):
         return self._default_fs
 
     def run_job(self, mapfn, partitions, collect=False):
-        rdd = self.sc.parallelize(partitions, len(partitions))
+        # Callable (lazy) partitions are pre-serialized with cloudpickle
+        # HERE: sc.parallelize ships *data* through Spark's plain-pickle
+        # serializer, which cannot handle closures — shipping the bytes
+        # as data and loading them on the executor sidesteps that.
+        encoded = [
+            ("lazy", _pickle.dumps(p)) if callable(p) else ("rows", list(p))
+            for p in partitions
+        ]
+        rdd = self.sc.parallelize(encoded, len(encoded))
+
+        def _decode(part):
+            tag, payload = part
+            if tag == "lazy":
+                return _pickle.loads(payload)()
+            return payload
 
         def _adapter(it):
             out = []
             for part in it:
-                r = mapfn(iter(part))
+                r = mapfn(iter(_decode(part)))
                 if r is not None:
                     out.extend(r)
             return out
 
         if collect:
             return rdd.mapPartitions(_adapter).collect()
-        rdd.foreachPartition(lambda it: mapfn(iter(next(it, []))))
+
+        def _each(it):
+            part = next(it, None)
+            rows = _decode(part) if part is not None else []
+            mapfn(iter(rows))
+
+        rdd.foreachPartition(_each)
         return None
+
+    # -- native datasets (the reference's actual hot path) -------------
+
+    def is_native_dataset(self, dataset):
+        """RDDs and DataFrames are fed in place — rows move
+        executor→executor-local queue and never transit the driver
+        (reference: TFCluster.py:90-94)."""
+        return hasattr(dataset, "mapPartitions") or hasattr(dataset, "rdd")
+
+    @staticmethod
+    def _as_rdd(dataset):
+        return (
+            dataset if hasattr(dataset, "mapPartitions") else dataset.rdd
+        )
+
+    def run_data_job(self, mapfn, dataset, collect=False):
+        rdd = self._as_rdd(dataset)
+        if collect:
+            return rdd.mapPartitions(mapfn).collect()
+        rdd.foreachPartition(mapfn)
+        return None
+
+    def map_partitions_native(self, mapfn, dataset):
+        """Lazy result RDD — the reference's ``inference()`` return
+        contract (reference: TFCluster.py:96-115 ``mapPartitions``,
+        evaluated only when the caller acts on the RDD)."""
+        return self._as_rdd(dataset).mapPartitions(mapfn)
 
     def num_active_jobs(self):
         st = self.sc.statusTracker()

@@ -438,6 +438,12 @@ class PSClient(object):
 
     def push_pull(self, grads):
         """Ship gradients, get fresh params back (one async-SGD step)."""
+        if self._assignment is None:
+            raise RuntimeError(
+                "call init(params_template, optimizer) before pull()/"
+                "push_pull(): it defines the leaf->shard assignment "
+                "(idempotent; the template does not overwrite live params)"
+            )
         leaves, _ = _flatten(grads)
         per_shard = self._shard_tensors(leaves)
         headers = [{"op": "push"} for _ in self._socks]

@@ -42,7 +42,15 @@ RULES_TP_FSDP = RULES_DP + (
     ("mlp", "model"),
     ("heads", "model"),
     ("kv_heads", "model"),
-    ("vocab", "model"),
+    # vocab takes model AND fsdp: for the embedding table this puts all
+    # sharding on the gather/scatter dim and leaves the embed dim
+    # replicated (the t5x/maxtext layout).  Sharding embed on fsdp here
+    # instead forces the partitioner to reshard the gather's output from
+    # batch sharding to embed sharding in the backward scatter — an
+    # "involuntary full rematerialization" at every step.  For matmul
+    # params (lm_head) fsdp is already consumed by the embed dim by the
+    # time vocab resolves, so their specs are unchanged.
+    ("vocab", ("model", "fsdp")),
     ("embed", "fsdp"),
     ("expert", "expert"),
     ("expert_mlp", "model"),
@@ -57,34 +65,43 @@ RULES_EP = RULES_DP + (
 )
 
 
-def apply_rules(logical_spec, rules, mesh=None):
+def apply_rules(logical_spec, rules, mesh=None, shape=None):
     """Map a tuple of logical axis names (or ``None``) to a
     :class:`PartitionSpec` under ``rules``.
 
     Mesh axes absent from ``mesh`` (when given) resolve to ``None`` —
     this is what lets TP-annotated models run unmodified on a pure-DP
-    mesh.
+    mesh.  With ``shape`` given, mesh axes that would not divide the
+    dimension are dropped (e.g. a single-head model under TP: ``heads``
+    is size 1, so the ``model`` axis falls off rather than erroring in
+    ``device_put``).
     """
     rule_map = dict(rules) if not isinstance(rules, dict) else rules
     used = set()
     out = []
-    for logical in logical_spec:
+    for i, logical in enumerate(logical_spec):
         mesh_axes = rule_map.get(logical) if logical is not None else None
         if mesh_axes is None:
             out.append(None)
             continue
         if isinstance(mesh_axes, str):
             mesh_axes = (mesh_axes,)
+        dim = shape[i] if shape is not None and i < len(shape) else None
+        width = 1
         picked = []
         for ax in mesh_axes:
             if ax in used:
                 continue
-            if mesh is not None and mesh.shape.get(ax, 1) == 1:
+            size = mesh.shape.get(ax, 1) if mesh is not None else 1
+            if mesh is not None and size == 1:
                 # absent/size-1 axis: harmless to include, but dropping it
                 # keeps specs readable in logs
                 continue
+            if dim is not None and dim % (width * size) != 0:
+                continue
             picked.append(ax)
             used.add(ax)
+            width *= size
         if not picked:
             out.append(None)
         elif len(picked) == 1:
@@ -114,7 +131,9 @@ def param_specs(abstract_params, rules, mesh=None, annotations=None):
 
     def _spec_for(leaf, logical):
         if logical is not None:
-            return apply_rules(logical, rules, mesh)
+            return apply_rules(
+                logical, rules, mesh, shape=getattr(leaf, "shape", None)
+            )
         shape = getattr(leaf, "shape", ())
         if fsdp_size > 1 and len(shape) >= 1:
             # shape heuristic for un-annotated params

@@ -208,11 +208,22 @@ def _is_spark_dataframe(dataset):
     return type(dataset).__module__.startswith("pyspark")
 
 
+class Partitions(object):
+    """Explicit marker for pre-partitioned input: wrap a list of row
+    lists so flat datasets of *list-typed rows* are never misread as
+    partitions (``TFEstimator(...).fit(Partitions([[row, ...], ...]))``)."""
+
+    def __init__(self, partitions):
+        self.partitions = [list(p) for p in partitions]
+
+
 def _to_partitions(dataset, num_partitions, columns=None):
     """Normalize a dataset to a list of row partitions.
 
-    Accepts a list of dict rows, a list of partitions (list of lists),
-    or a pyspark DataFrame (gated).  ``columns`` restricts/sorts dict
+    Accepts a list of dict rows, a :class:`Partitions` wrapper, a list
+    of partitions (list of lists *of dict/tuple rows* — a flat dataset
+    of list-typed rows splits like any other flat dataset), or a
+    pyspark DataFrame (gated).  ``columns`` restricts/sorts dict
     rows into tuples — the driver-side twin of the reference's
     ``df.select(sorted(input_mapping))`` (reference: pipeline.py:411-413).
     """
@@ -220,11 +231,17 @@ def _to_partitions(dataset, num_partitions, columns=None):
         from tensorflowonspark_tpu.data import spark_io
 
         dataset = spark_io.dataframe_to_rows(dataset)
-    rows = list(dataset)
-    if rows and isinstance(rows[0], list):
-        # already partitioned: a list of row-lists (dict/tuple rows)
-        partitions = [list(p) for p in rows]
+    if isinstance(dataset, Partitions):
+        partitions = dataset.partitions  # already materialized by ctor
+        rows = None
     else:
+        rows = list(dataset)
+    if rows is not None and _looks_partitioned(rows):
+        # unambiguously partitioned: a list of row-lists of dict/tuple
+        # rows (list-typed or scalar *rows* stay on the flat path below;
+        # wrap in Partitions to force this branch)
+        partitions = [list(p) for p in rows]
+    elif rows is not None:
         num_partitions = max(1, num_partitions)
         partitions = [rows[i::num_partitions] for i in range(num_partitions)]
         partitions = [p for p in partitions if p] or [[]]
@@ -233,6 +250,18 @@ def _to_partitions(dataset, num_partitions, columns=None):
             [_select(row, columns) for row in part] for part in partitions
         ]
     return partitions
+
+
+def _looks_partitioned(rows):
+    """True when ``rows`` is a list of row-lists of dict/tuple rows.
+    Empty partitions are skipped when probing (an empty *first*
+    partition must not reclassify the dataset as flat)."""
+    if not rows or not all(isinstance(p, list) for p in rows):
+        return False
+    for p in rows:
+        if p:
+            return isinstance(p[0], (dict, tuple))
+    return True  # all partitions empty: treat as (vacuously) partitioned
 
 
 def _select(row, columns):
@@ -322,12 +351,26 @@ class TFEstimator(TFParams, *_ESTIMATOR_MIXINS):
             input_cols = (
                 sorted(args.input_mapping) if args.input_mapping else None
             )
-            partitions = _to_partitions(
-                dataset, args.cluster_size, columns=input_cols
-            )
-            cluster.train(
-                partitions, args.epochs, feed_timeout=args.feed_timeout
-            )
+            if (
+                _is_spark_dataframe(dataset)
+                and hasattr(dataset, "select")  # DataFrame, not a bare RDD
+                and cluster.engine.is_native_dataset(dataset)
+            ):
+                # feed the DataFrame's RDD in place — the reference's
+                # path (``df.select(sorted(cols)).rdd`` →
+                # ``cluster.train``, reference: pipeline.py:411-413);
+                # rows never transit the driver.  Row shape matches the
+                # driver-materialized path: sorted-column tuples with an
+                # input_mapping, dict rows without.
+                if input_cols:
+                    fed = dataset.select(*input_cols).rdd.map(tuple)
+                else:
+                    fed = dataset.rdd.map(lambda r: r.asDict())
+            else:
+                fed = _to_partitions(
+                    dataset, args.cluster_size, columns=input_cols
+                )
+            cluster.train(fed, args.epochs, feed_timeout=args.feed_timeout)
         cluster.shutdown(grace_secs=args.grace_secs)
 
         model = TFModel(args)

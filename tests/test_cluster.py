@@ -285,6 +285,86 @@ def test_eval_node_role(engine):
     cluster.shutdown(timeout=60)
 
 
+def _make_lazy_partition(start, n):
+    """A lazy partition: a zero-arg callable generating rows on the
+    EXECUTOR — the driver ships only these few bytes (the VERDICT #3
+    larger-than-driver-memory feed contract)."""
+
+    def gen():
+        return ((float(i), float(2 * i)) for i in range(start, start + n))
+
+    return gen
+
+
+def test_engine_lazy_partitions_ship_small():
+    # a nominally huge dataset (4 x 10M rows) must serialize to a few KB
+    # of callables — proof the rows never transit the driver
+    try:
+        import cloudpickle as cp
+    except ImportError:
+        import pickle as cp
+    parts = [_make_lazy_partition(i * 10_000_000, 10_000_000) for i in range(4)]
+    assert all(len(cp.dumps(p)) < 10_000 for p in parts)
+
+
+def test_engine_lazy_partitions_execute(engine):
+    parts = [_make_lazy_partition(i * 5, 5) for i in range(3)]
+    results = engine.run_job(
+        lambda it: [row[0] for row in it], parts, collect=True
+    )
+    assert sorted(results) == [float(i) for i in range(15)]
+
+
+def test_engine_run_job_lazy_yields_in_partition_order(engine):
+    import random
+
+    def mapfn(it):
+        import time as _t
+
+        items = list(it)
+        _t.sleep(random.random() * 0.2)  # scramble completion order
+        return items
+
+    parts = [[i] for i in range(6)]
+    out = list(engine.run_job_lazy(mapfn, parts))
+    assert out == [[i] for i in range(6)]
+
+
+def test_train_lazy_partitions(engine):
+    # cluster.train over callable partitions: rows generated on the
+    # executors, multi-epoch without driver-side copies
+    cluster = tpu_cluster.run(
+        engine,
+        _train_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    parts = [_make_lazy_partition(i * 2500, 2500) for i in range(4)]
+    cluster.train(parts, num_epochs=2, feed_timeout=120)
+    cluster.shutdown(grace_secs=1, timeout=60)
+
+
+def test_inference_lazy_generator(engine):
+    cluster = tpu_cluster.run(
+        engine,
+        _square_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    data = list(range(40))
+    partitions = [data[i::4] for i in range(4)]
+    gen = cluster.inference(partitions, feed_timeout=60, lazy=True)
+    collected = []
+    for part_result in gen:  # per-partition, in partition order
+        collected.append(sorted(part_result))
+    assert len(collected) == 4
+    flat = [x for part in collected for x in part]
+    assert sorted(flat) == sorted(x * x for x in data)
+    cluster.shutdown(grace_secs=1, timeout=60)
+
+
 def _never_consume_fn(args, ctx):
     import time as _t
 

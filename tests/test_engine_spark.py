@@ -83,3 +83,54 @@ def test_spark_engine_run_job_foreach():
 
     assert eng.run_job(mapfn, [[1, 2], [3]], collect=False) is None
     assert sorted(seen) == [[1, 2], [3]]
+
+
+def test_spark_engine_native_dataset_detection():
+    eng = SparkEngine(_FakeSC())
+    assert eng.is_native_dataset(_FakeRDD([[1]]))  # RDD duck type
+    assert not eng.is_native_dataset([[1, 2], [3]])
+    assert not eng.is_native_dataset("not a dataset")
+
+
+def test_spark_engine_run_data_job_feeds_rdd_in_place():
+    """The VERDICT #3 contract: feeding a native RDD must NOT
+    re-parallelize user data through the driver — the feed fn runs via
+    foreachPartition on the dataset itself
+    (reference: TFCluster.py:90-94)."""
+    sc = _FakeSC()
+    eng = SparkEngine(sc)
+    rdd = _FakeRDD([[1, 2], [3, 4, 5]])
+    seen = []
+
+    def feed_fn(it):
+        seen.append(list(it))
+
+    eng.run_data_job(feed_fn, rdd)
+    assert sorted(seen) == [[1, 2], [3, 4, 5]]
+    assert sc.parallelize_calls == []  # no user data through the driver
+
+
+def test_spark_engine_map_partitions_native_is_lazy():
+    sc = _FakeSC()
+    eng = SparkEngine(sc)
+    rdd = _FakeRDD([[1, 2], [3]])
+    result = eng.map_partitions_native(lambda it: [x + 10 for x in it], rdd)
+    # the reference's inference() contract: a result RDD, materialized
+    # only when the caller collects
+    assert sorted(result.collect()) == [11, 12, 13]
+    assert sc.parallelize_calls == []
+
+
+class _FakeDataFrame:
+    def __init__(self, rdd):
+        self.rdd = rdd
+
+
+def test_spark_engine_dataframe_unwraps_to_rdd():
+    sc = _FakeSC()
+    eng = SparkEngine(sc)
+    df = _FakeDataFrame(_FakeRDD([[1], [2]]))
+    assert eng.is_native_dataset(df)
+    seen = []
+    eng.run_data_job(lambda it: seen.extend(it), df)
+    assert sorted(seen) == [1, 2]
