@@ -13,7 +13,6 @@ plane feeds anyway); the Spark adapter in
 :mod:`tensorflowonspark_tpu.data.spark_io` maps DataFrames onto this.
 """
 
-import glob as _glob
 import logging
 import os
 import re
@@ -22,6 +21,7 @@ import numpy as np
 
 from tensorflowonspark_tpu.data import example as ex
 from tensorflowonspark_tpu.data import tfrecord as tfr
+from tensorflowonspark_tpu.utils import fs as fs_utils
 
 logger = logging.getLogger(__name__)
 
@@ -201,11 +201,13 @@ def example_to_row(record, schema):
 def save_as_tfrecords(rows, path, schema=None, num_shards=1):
     """Write rows to ``path`` (a directory of ``part-rNNNNN`` shards —
     the Hadoop OutputFormat layout the reference produced via Spark,
-    dfutil.py:29-41).  Returns the number of records written."""
-    os.makedirs(path, exist_ok=True)
+    dfutil.py:29-41; remote ``scheme://`` URIs go through fsspec like
+    the reference's jar went through HDFS).  Returns the number of
+    records written."""
+    fs_utils.makedirs(path)
     writers = [
         tfr.TFRecordWriter(
-            os.path.join(path, "part-r-{0:05d}".format(i))
+            fs_utils.join(path, "part-r-{0:05d}".format(i))
         )
         for i in range(num_shards)
     ]
@@ -222,14 +224,12 @@ def save_as_tfrecords(rows, path, schema=None, num_shards=1):
 
 
 def _record_files(path):
-    if os.path.isdir(path):
-        files = sorted(
+    if fs_utils.isdir(path):
+        files = [
             f
-            for f in _glob.glob(os.path.join(path, "*"))
-            if os.path.isfile(f) and not os.path.basename(f).startswith(
-                ("_", ".")
-            )
-        )
+            for f in fs_utils.list_files(path)
+            if not fs_utils.basename(f).startswith(("_", "."))
+        ]
         if not files:
             raise FileNotFoundError("no record files under {0}".format(path))
         return files

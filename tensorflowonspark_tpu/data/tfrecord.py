@@ -10,6 +10,11 @@ numbers, ~100x slower).
 
 The shared lib is built lazily with ``make`` on first use and cached
 next to the sources.
+
+Remote URIs (``gs://``, ``hdfs://``, ``s3://``, ``memory://``, …) are
+routed through ``fsspec`` with the pure-Python framing — the role the
+reference's Hadoop jar played for HDFS (reference: dfutil.py:39,63);
+the native codec keeps the local fast path.
 """
 
 import ctypes
@@ -18,6 +23,7 @@ import os
 import struct
 
 from tensorflowonspark_tpu.data import _native
+from tensorflowonspark_tpu.utils import fs as fs_utils
 
 logger = logging.getLogger(__name__)
 
@@ -103,15 +109,16 @@ class TFRecordWriter(object):
 
     def __init__(self, path):
         self.path = os.fspath(path)
-        self._lib = _load_native()
+        self._lib = None if fs_utils.is_remote(self.path) else _load_native()
         if self._lib is not None:
-            self._h = self._lib.tfr_writer_open(self.path.encode())
+            local = fs_utils.local_path(self.path)
+            self._h = self._lib.tfr_writer_open(local.encode())
             if not self._h:
                 raise IOError("cannot open {0} for writing".format(path))
             self._f = None
         else:
             self._h = None
-            self._f = open(self.path, "wb")
+            self._f = fs_utils.open_file(self.path, "wb")
 
     def write(self, record):
         record = bytes(record)
@@ -151,15 +158,16 @@ class TFRecordReader(object):
 
     def __init__(self, path):
         self.path = os.fspath(path)
-        self._lib = _load_native()
+        self._lib = None if fs_utils.is_remote(self.path) else _load_native()
         if self._lib is not None:
-            self._h = self._lib.tfr_reader_open(self.path.encode())
+            local = fs_utils.local_path(self.path)
+            self._h = self._lib.tfr_reader_open(local.encode())
             if not self._h:
                 raise IOError("cannot open {0}".format(path))
             self._f = None
         else:
             self._h = None
-            self._f = open(self.path, "rb")
+            self._f = fs_utils.open_file(self.path, "rb")
 
     def __iter__(self):
         return self

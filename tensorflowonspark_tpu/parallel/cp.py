@@ -31,7 +31,15 @@ STRATEGIES = {
 
 
 def context_parallel_attention(q, k, v, mesh, strategy="ring", **kwargs):
-    """Dispatch sequence-parallel attention by strategy name."""
+    """Dispatch sequence-parallel attention by strategy name.
+    ``strategy="auto"`` picks via :func:`choose_strategy`."""
+    if strategy == "auto":
+        strategy = choose_strategy(
+            seq_len=q.shape[1],
+            num_heads=q.shape[2],
+            head_dim=q.shape[3],
+            seq_devices=mesh.shape.get("seq", 1),
+        )
     if strategy not in STRATEGIES:
         raise ValueError(
             "unknown context-parallel strategy {0!r}; options: {1}".format(
@@ -39,3 +47,60 @@ def context_parallel_attention(q, k, v, mesh, strategy="ring", **kwargs):
             )
         )
     return STRATEGIES[strategy](q, k, v, mesh, **kwargs)
+
+
+def choose_strategy(seq_len, num_heads, head_dim, seq_devices):
+    """Pick ring vs Ulysses for a ``seq``-sharded attention.
+
+    The decision follows the communication structure (scaling-book
+    reasoning, volumes per device per attention call, N = seq devices):
+
+    - **Ulysses** re-shards seq<->heads with two all-to-all pairs:
+      ~``4 * (S/N) * H * D * (N-1)/N`` elements, one shot, latency
+      2 collectives — but requires ``heads % N == 0`` and caps N at H.
+    - **ring** rotates K and V around the ring: ``2 * (S/N) * H * D``
+      elements per hop x (N-1) hops ≈ ``2 * S * H * D * (N-1)/N`` —
+      ~S/(2·S/N) = N/2 x more volume than Ulysses, but every hop
+      overlaps with a block of attention compute, so at long S the
+      transfer hides entirely and ring wins on memory locality (no
+      full-seq head shard ever materializes).
+
+    Policy: Ulysses when the head count divides cleanly and the
+    per-device sequence is short enough that ring's compute blocks
+    could not hide the hops (S/N below ~4k tokens); ring otherwise.
+    """
+    if seq_devices <= 1:
+        return "ring"  # degenerates to plain attention either way
+    ulysses_ok = num_heads % seq_devices == 0
+    local_seq = seq_len // max(1, seq_devices)
+    if ulysses_ok and local_seq < 4096:
+        return "ulysses"
+    return "ring"
+
+
+def plan(seq_len, batch, num_heads, head_dim, seq_devices, dtype_bytes=2):
+    """Memory/communication plan for context-parallel attention.
+
+    Returns per-device quantities: local sequence, Q/K/V bytes, the
+    attention-score working set a *naive* (unsharded) computation would
+    need (the number that forces CP in the first place), and per-call
+    communication volume for each strategy."""
+    local_seq = -(-seq_len // seq_devices)
+    qkv_bytes = 3 * batch * local_seq * num_heads * head_dim * dtype_bytes
+    n = max(1, seq_devices)
+    ring_hop = 2 * batch * local_seq * num_heads * head_dim * dtype_bytes
+    return {
+        "local_seq": local_seq,
+        "qkv_bytes_per_device": qkv_bytes,
+        "naive_scores_bytes": batch * num_heads * seq_len * seq_len * 4,
+        "ring_bytes_per_call": ring_hop * (n - 1),
+        "ring_hops": n - 1,
+        "ulysses_bytes_per_call": (
+            4 * batch * local_seq * num_heads * head_dim * dtype_bytes
+            * (n - 1) // n
+        ),
+        "ulysses_valid": num_heads % n == 0,
+        "recommended": choose_strategy(
+            seq_len, num_heads, head_dim, seq_devices
+        ),
+    }

@@ -226,6 +226,7 @@ class SyncTrainer(object):
         max_steps=None,
         log_every=100,
         steps_per_execution=1,
+        metrics_callback=None,
     ):
         """Run the synchronized feed loop: pull batches from a
         :class:`~tensorflowonspark_tpu.data.feed.DataFeed`, stop globally
@@ -238,6 +239,10 @@ class SyncTrainer(object):
             :meth:`multi_step` dispatch (per-batch readiness stays
             globally agreed, so every host fuses the same count; a
             partial final group may compile a second program).
+          metrics_callback: optional ``fn(step, metrics)`` called after
+            each executed group with the (device-resident) metrics of
+            its last step — losses are global (psum over the mesh), so
+            every host observes identical values.
         Returns the final state.
         """
         if steps_per_execution < 1:
@@ -290,6 +295,8 @@ class SyncTrainer(object):
                 )
                 metrics = jax.tree.map(lambda m: m[-1], metrics)
             steps += len(group)
+            if metrics_callback is not None:
+                metrics_callback(steps, metrics)
             if log_every and (steps % log_every < len(group)):
                 logger.info(
                     "step %d loss %.4f", steps, float(metrics["loss"])

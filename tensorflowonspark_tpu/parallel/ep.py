@@ -17,3 +17,110 @@ from tensorflowonspark_tpu.ops.moe import (  # noqa: F401
     top_k_gating,
 )
 from tensorflowonspark_tpu.parallel.mesh import AXIS_EXPERT  # noqa: F401
+
+
+def plan(
+    num_experts,
+    tokens_per_batch,
+    k=2,
+    capacity_factor=1.25,
+    n_devices=None,
+    embed_dim=None,
+    mlp_dim=None,
+    dtype_bytes=2,
+):
+    """Expert-parallel capacity plan.
+
+    Answers the sizing questions an EP deployment starts with: how many
+    token slots each expert processes per step, how much gets dropped
+    when routing is imbalanced, how wide the ``expert`` mesh axis can
+    be, and the all-to-all traffic per MoE layer.
+
+    Args:
+      num_experts: total experts per MoE layer.
+      tokens_per_batch: global tokens per step (batch x seq).
+      k: experts per token (top-k routing).
+      capacity_factor: slack over the perfectly-balanced load.
+      n_devices: devices available for the ``expert`` axis (optional).
+      embed_dim / mlp_dim / dtype_bytes: for memory/comm estimates
+        (optional).
+
+    Returns a dict of derived quantities (all integers/floats, no jax).
+    """
+    cap = expert_capacity(
+        tokens_per_batch, num_experts, k=k, capacity_factor=capacity_factor
+    )
+    out = {
+        "capacity_per_expert": cap,
+        "total_slots": cap * num_experts,
+        #: routed assignments that fit if routing were perfectly
+        #: balanced (k per token); >1.0 slack absorbs imbalance
+        "slack": (cap * num_experts) / float(k * tokens_per_batch),
+        #: fraction of assignments dropped at worst-case imbalance
+        #: where one expert attracts 2x its balanced share
+        "drop_at_2x_hotspot": max(
+            0.0, 1.0 - cap / (2.0 * k * tokens_per_batch / num_experts)
+        ),
+    }
+    if n_devices:
+        if num_experts % n_devices == 0:
+            out["expert_axis"] = n_devices
+            out["experts_per_device"] = num_experts // n_devices
+        else:
+            divisors = [
+                d for d in range(1, n_devices + 1) if num_experts % d == 0
+            ]
+            out["expert_axis"] = max(divisors)
+            out["experts_per_device"] = num_experts // out["expert_axis"]
+    if embed_dim and mlp_dim:
+        # expert weights per device (wi + wg + wo per expert)
+        per_expert = 3 * embed_dim * mlp_dim * dtype_bytes
+        out["expert_bytes_per_device"] = per_expert * out.get(
+            "experts_per_device", num_experts
+        )
+        # dispatch+combine all-to-all volume per layer per step: each
+        # routed token activation crosses the expert axis twice
+        out["alltoall_bytes_per_layer"] = (
+            2 * k * tokens_per_batch * embed_dim * dtype_bytes
+        )
+    return out
+
+
+def trainer(
+    loss_fn,
+    optimizer,
+    mesh,
+    annotations=None,
+    has_aux=True,
+    **kw,
+):
+    """A :class:`~tensorflowonspark_tpu.parallel.dp.SyncTrainer` wired
+    for expert parallelism: RULES_EP places ``expert``-annotated params
+    on the ``expert`` mesh axis (and ``expert_mlp`` on ``model`` when
+    present); XLA inserts the dispatch/combine all-to-alls.  MoE losses
+    return ``(loss, aux)`` with the load-balance penalty in ``aux``
+    (models/moe.moe_loss_fn), hence ``has_aux=True``."""
+    from tensorflowonspark_tpu.parallel import dp, sharding as sh
+
+    return dp.SyncTrainer(
+        loss_fn,
+        optimizer,
+        mesh=mesh,
+        rules=sh.RULES_EP,
+        annotations=annotations,
+        has_aux=has_aux,
+        **kw,
+    )
+
+
+def utilization(router_probs, num_experts):
+    """Expert load-balance diagnostics from router probabilities.
+
+    Args:
+      router_probs: ``[tokens, num_experts]`` softmax outputs.
+    Returns ``(fraction_per_expert, imbalance)`` where imbalance is the
+    max/mean load ratio (1.0 = perfectly balanced)."""
+    import jax.numpy as jnp
+
+    load = jnp.mean(router_probs, axis=tuple(range(router_probs.ndim - 1)))
+    return load, float(jnp.max(load) * num_experts)

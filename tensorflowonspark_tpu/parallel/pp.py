@@ -166,12 +166,22 @@ class PipelineTrainer(object):
         num_microbatches,
         axis_name="pipe",
         data_axes=("data", "fsdp"),
+        schedule="gpipe",
     ):
+        """``schedule``: ``"gpipe"`` (fwd scan + AD backward; activation
+        memory O(M) microbatches/stage) or ``"1f1b"`` (hand-scheduled
+        PipeDream-flush: same bubble, activation stash bounded at O(P)
+        stage *inputs* with the stage forward recomputed in the
+        backward unit — the remat trade, ~1.3-1.7x stage FLOPs for
+        M/P x less activation memory; see parallel/pp_schedule.py for
+        the schedule tables and their measured properties)."""
         if mesh.shape.get(axis_name, 1) < 2:
             raise ValueError(
                 "PipelineTrainer needs a mesh with a >=2-wide {0!r} axis, "
                 "got {1}".format(axis_name, dict(mesh.shape))
             )
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError("unknown schedule {0!r}".format(schedule))
         self.layer_fn = layer_fn
         self.first_stage_fn = first_stage_fn
         self.last_stage_fn = last_stage_fn
@@ -179,10 +189,15 @@ class PipelineTrainer(object):
         self.mesh = mesh
         self.num_microbatches = num_microbatches
         self.axis_name = axis_name
+        self.schedule = schedule
         self.data_axes = tuple(
             a for a in data_axes if mesh.shape.get(a, 1) > 1
         )
-        self._step = self._build_step()
+        self._step = (
+            self._build_step()
+            if schedule == "gpipe"
+            else self._build_step_1f1b()
+        )
 
     # -- sharding ------------------------------------------------------
 
@@ -322,6 +337,212 @@ class PipelineTrainer(object):
                 lambda x: _dmean(lax.psum(x, pipe)), metrics
             )
             return grads, metrics
+
+        def train_step(state, batch):
+            grads, metrics = grad_fn(state.params, batch)
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            import optax
+
+            params = optax.apply_updates(state.params, updates)
+            from tensorflowonspark_tpu.parallel.dp import TrainState
+
+            return TrainState(state.step + 1, params, opt_state), metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    # -- 1F1B ----------------------------------------------------------
+
+    def _build_step_1f1b(self):
+        """Hand-scheduled 1F1B train step (see __init__ docstring).
+
+        Every device runs the same tick program (one masked forward
+        unit + one masked backward unit per tick) driven by the static
+        schedule tables; activations hand off through single-slot
+        ppermute buffers (the schedule guarantees a producer never
+        overruns an unconsumed slot — property-checked in
+        tests/test_pp.py), and the backward unit re-runs the stage
+        forward from the stashed stage *input* under ``jax.vjp``.
+        """
+        from tensorflowonspark_tpu.parallel import pp_schedule
+
+        layer_fn = self.layer_fn
+        first_fn = self.first_stage_fn
+        last_fn = self.last_stage_fn
+        optimizer = self.optimizer
+        pipe = self.axis_name
+        m = self.num_microbatches
+        data_axes = self.data_axes
+        mesh = self.mesh
+        p = mesh.shape[pipe]
+
+        prog = pp_schedule.stage_program(p, m, "1f1b")
+        do_f = jnp.asarray(prog["do_f"])
+        f_mb = jnp.asarray(prog["f_mb"])
+        do_b = jnp.asarray(prog["do_b"])
+        b_mb = jnp.asarray(prog["b_mb"])
+        n_ticks = int(prog["do_f"].shape[0])
+        stash_slots = min(p, m)
+
+        batch_spec = P(data_axes if data_axes else None)
+        param_specs = {"stages": P(pipe), "first": P(), "last": P()}
+
+        stage_fn = functools.partial(_layers_scan, layer_fn)
+
+        def local_grads(params, batch):
+            idx = lax.axis_index(pipe)
+            is_first = idx == 0
+            is_last = idx == p - 1
+            fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+            bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+
+            stage_params = local_stage(params["stages"])
+            h0 = first_fn(params["first"], batch)
+            b = h0.shape[0]
+            if b % m != 0:
+                raise ValueError(
+                    "local batch {0} not divisible by num_microbatches "
+                    "{1}".format(b, m)
+                )
+            mb = b // m
+            micro = h0.reshape((m, mb) + h0.shape[1:])
+            batch_micro = jax.tree.map(
+                lambda x: x.reshape((m, mb) + x.shape[1:]), batch
+            )
+
+            # metrics structure (zeros) via abstract eval of last_fn
+            mb_batch0 = jax.tree.map(lambda x: x[0], batch_micro)
+            _, metrics_shape = jax.eval_shape(
+                last_fn, params["last"], jax.ShapeDtypeStruct(
+                    micro.shape[1:], micro.dtype
+                ), mb_batch0,
+            )
+            metrics0 = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+            )
+
+            zeros_act = jnp.zeros(micro.shape[1:], micro.dtype)
+            carry = dict(
+                fwd_recv=zeros_act,
+                bwd_recv=zeros_act,
+                stash=jnp.zeros((stash_slots,) + micro.shape[1:], micro.dtype),
+                d_h0=jnp.zeros_like(micro),
+                stage_g=jax.tree.map(jnp.zeros_like, stage_params),
+                last_g=jax.tree.map(jnp.zeros_like, params["last"]),
+                loss=jnp.zeros((), jnp.float32),
+                metrics=metrics0,
+            )
+
+            def acc(flag, old, new):
+                return jax.tree.map(
+                    lambda o, n: jnp.where(flag, o + n, o), old, new
+                )
+
+            def tick(carry, t):
+                myf = do_f[t, idx].astype(bool)
+                myb = do_b[t, idx].astype(bool)
+                fj = f_mb[t, idx]
+                bj = b_mb[t, idx]
+
+                # ---- forward unit (masked) --------------------------
+                x_in = jnp.where(is_first, micro[fj], carry["fwd_recv"])
+                y = stage_fn(stage_params, x_in)
+                stash = jnp.where(
+                    myf,
+                    lax.dynamic_update_index_in_dim(
+                        carry["stash"], x_in, fj % stash_slots, axis=0
+                    ),
+                    carry["stash"],
+                )
+
+                # ---- backward unit (masked; remat from stashed input)
+                x_b = carry["stash"][bj % stash_slots]
+                y_b, pull = jax.vjp(stage_fn, stage_params, x_b)
+                mb_batch = jax.tree.map(lambda a: a[bj], batch_micro)
+                loss_j, last_pull, metrics_j = jax.vjp(
+                    lambda lp, h: last_fn(lp, h, mb_batch),
+                    params["last"],
+                    y_b,
+                    has_aux=True,
+                )
+                d_last, d_y_last = last_pull(jnp.ones_like(loss_j))
+                ct = jnp.where(is_last, d_y_last, carry["bwd_recv"])
+                d_stage, d_x = pull(ct)
+
+                bl = jnp.logical_and(myb, is_last)
+                new = dict(
+                    stash=stash,
+                    stage_g=acc(myb, carry["stage_g"], d_stage),
+                    last_g=acc(bl, carry["last_g"], d_last),
+                    loss=jnp.where(
+                        bl, carry["loss"] + loss_j.astype(jnp.float32),
+                        carry["loss"],
+                    ),
+                    metrics=acc(bl, carry["metrics"], metrics_j),
+                    d_h0=jnp.where(
+                        jnp.logical_and(myb, is_first),
+                        lax.dynamic_update_index_in_dim(
+                            carry["d_h0"], d_x, bj, axis=0
+                        ),
+                        carry["d_h0"],
+                    ),
+                )
+
+                # ---- handoffs (single slot; masked by sender's flag)
+                recv_y = lax.ppermute(y, pipe, fwd_perm)
+                recv_ct = lax.ppermute(d_x, pipe, bwd_perm)
+                sent_f = do_f[t, (idx - 1) % p].astype(bool)
+                sent_b = do_b[t, (idx + 1) % p].astype(bool)
+                new["fwd_recv"] = jnp.where(sent_f, recv_y, carry["fwd_recv"])
+                new["bwd_recv"] = jnp.where(sent_b, recv_ct, carry["bwd_recv"])
+                return new, None
+
+            carry, _ = lax.scan(tick, carry, jnp.arange(n_ticks))
+
+            # first-stage grads: one vjp of the whole-batch embedding with
+            # the accumulated per-microbatch cotangents (nonzero only on
+            # stage 0 — psum shares them to every replicated copy)
+            _, first_pull = jax.vjp(lambda fp: first_fn(fp, batch), params["first"])
+            (d_first,) = first_pull(
+                carry["d_h0"].reshape((b,) + carry["d_h0"].shape[2:])
+            )
+            d_first = jax.tree.map(
+                lambda g: jnp.where(is_first, g, jnp.zeros_like(g)), d_first
+            )
+
+            def _dmean(g):
+                return lax.pmean(g, data_axes) if data_axes else g
+
+            inv_m = 1.0 / m
+            grads = {
+                # restore the leading (local size-1) stage dim for the
+                # P(pipe) out_spec
+                "stages": jax.tree.map(
+                    lambda g: _dmean(g * inv_m)[None], carry["stage_g"]
+                ),
+                "first": jax.tree.map(
+                    lambda g: _dmean(lax.psum(g * inv_m, pipe)), d_first
+                ),
+                "last": jax.tree.map(
+                    lambda g: _dmean(lax.psum(g * inv_m, pipe)),
+                    carry["last_g"],
+                ),
+            }
+            metrics = dict(carry["metrics"])
+            metrics["loss"] = carry["loss"]
+            metrics = jax.tree.map(
+                lambda x: _dmean(lax.psum(x * inv_m, pipe)), metrics
+            )
+            return grads, metrics
+
+        grad_fn = functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(param_specs, batch_spec),
+            out_specs=(param_specs, P()),
+            check_vma=False,
+        )(local_grads)
 
         def train_step(state, batch):
             grads, metrics = grad_fn(state.params, batch)

@@ -1,0 +1,246 @@
+"""Pipeline schedules: GPipe vs 1F1B vs interleaved-1F1B, as explicit
+per-tick tables.
+
+No reference analogue (the reference has no pipeline parallelism,
+SURVEY.md §2.3); schedules follow the standard literature (GPipe,
+PipeDream-flush/1F1B, Megatron interleaved).
+
+Two uses:
+
+- *analysis*: :func:`simulate` produces the tick-by-tick table a
+  synchronous SPMD execution follows; :func:`stats` reports idle ticks,
+  bubble fraction, and peak in-flight microbatches (the activation-stash
+  bound).  This is the "scheduled-ops trace" the pp tests assert on:
+  1F1B's stash is O(P) instead of GPipe's O(M), and the interleaved
+  variant has measurably fewer idle ticks;
+- *execution*: :func:`stage_program` flattens the table into the static
+  per-tick (do_fwd, fwd_mb, do_bwd, bwd_mb) arrays the hand-scheduled
+  1F1B train step in :mod:`tensorflowonspark_tpu.parallel.pp` scans
+  over.
+
+Timing model: unit time per microbatch per stage for forward, one unit
+for backward (tf = tb = 1) — the conventional model for bubble-fraction
+accounting.  A tick is one unit; a stage executes at most one unit per
+tick.
+"""
+
+import collections
+
+__all__ = ["simulate", "stats", "stage_program"]
+
+
+#: one scheduled unit: kind is "F" or "B", mb the microbatch index,
+#: chunk the virtual-stage chunk (0 unless interleaved)
+Unit = collections.namedtuple("Unit", ["kind", "mb", "chunk"])
+
+
+def simulate(num_stages, num_microbatches, schedule="1f1b", interleave=1):
+    """Tick-by-tick schedule table.
+
+    Args:
+      num_stages: pipeline devices P.
+      num_microbatches: microbatches M per step.
+      schedule: ``"gpipe"`` (all forwards, flush, all backwards) or
+        ``"1f1b"`` (PipeDream-flush: warmup, steady 1F1B, drain).
+      interleave: virtual chunks per device v (Megatron interleaved
+        schedule); model depth splits into P*v chunks, device d owns
+        chunks ``d, d+P, ...``.  Only meaningful with ``"1f1b"``.
+
+    Returns:
+      ``table[d][t]`` — a :class:`Unit` or ``None`` (idle) for device
+      ``d`` at tick ``t``; all rows share one length (the makespan).
+    """
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError("unknown schedule {0!r}".format(schedule))
+    if schedule == "gpipe" and interleave != 1:
+        raise ValueError("gpipe does not interleave")
+    p, m, v = num_stages, num_microbatches, interleave
+    num_chunks = p * v  # logical stages
+    # chunk c runs on device c % p; chunk order is c=0..num_chunks-1
+    done_f = set()  # (chunk, mb) forward completed
+    done_b = set()
+    # completion tick of each unit, for dependency latency (unit latency
+    # 1, transfer latency 0 — the ICI permute overlaps the next tick)
+    finish = {}
+
+    def f_ready(c, mb, t):
+        if c == 0:
+            return True
+        return ("F", c - 1, mb) in finish and finish[("F", c - 1, mb)] <= t
+
+    def b_ready(c, mb, t):
+        if ("F", c, mb) not in finish or finish[("F", c, mb)] > t:
+            return False  # cannot run backward before own forward
+        if c == num_chunks - 1:
+            return True
+        return ("B", c + 1, mb) in finish and finish[("B", c + 1, mb)] <= t
+
+    table = [[] for _ in range(p)]
+    t = 0
+    total_units = 2 * num_chunks * m
+    scheduled = 0
+    while scheduled < total_units:
+        if t > 4 * total_units + 16:  # safety: schedule must terminate
+            raise RuntimeError("schedule failed to converge")
+        for d in range(p):
+            unit = _pick(
+                d, p, m, v, num_chunks, schedule, done_f, done_b,
+                f_ready, b_ready, t,
+            )
+            table[d].append(unit)
+            if unit is not None:
+                key = (unit.kind, _abs_chunk(unit, d, p), unit.mb)
+                finish[key] = t + 1
+                (done_f if unit.kind == "F" else done_b).add(key[1:])
+                scheduled += 1
+        t += 1
+    return table
+
+
+def _abs_chunk(unit, device, p):
+    return unit.chunk * p + device
+
+
+def _unit_orders(p, m, v):
+    """Fixed per-device unit orders (Megatron's chunk-cycling pattern):
+    forwards cycle chunks per group of ``p`` microbatches; backwards
+    mirror with the chunk order reversed.  v==1 degenerates to plain
+    microbatch order."""
+    groups = []
+    mb = 0
+    while mb < m:
+        groups.append(range(mb, min(mb + p, m)))
+        mb += p
+    fwd = [
+        (c_i, mb) for g in groups for c_i in range(v) for mb in g
+    ]
+    bwd = [
+        (c_i, mb)
+        for g in groups
+        for c_i in reversed(range(v))
+        for mb in g
+    ]
+    return fwd, bwd
+
+
+def _pick(d, p, m, v, num_chunks, schedule, done_f, done_b, f_ready,
+          b_ready, t):
+    """Choose device ``d``'s unit for tick ``t`` (or None).  Units
+    execute strictly in the fixed order — out-of-order running would
+    either deadlock the interleaved schedule or (for 1F1B) inflate the
+    activation stash past its O(P) bound."""
+    my_chunks = [c * p + d for c in range(v)]
+    fwd_order, bwd_order = _unit_orders(p, m, v)
+    fwd_done = sum((c, mb) in done_f for c in my_chunks for mb in range(m))
+    bwd_done = sum((c, mb) in done_b for c in my_chunks for mb in range(m))
+
+    def next_f():
+        for c_i, mb in fwd_order:
+            c = my_chunks[c_i]
+            if (c, mb) in done_f:
+                continue
+            if f_ready(c, mb, t):
+                return Unit("F", mb, c_i)
+            return None  # strictly in-order
+
+    def next_b():
+        for c_i, mb in bwd_order:
+            c = my_chunks[c_i]
+            if (c, mb) in done_b:
+                continue
+            if (c, mb) in done_f and b_ready(c, mb, t):
+                return Unit("B", mb, c_i)
+            return None  # strictly in-order
+
+    if schedule == "gpipe":
+        # strict phases: all forwards first, then all backwards
+        if fwd_done < v * m:
+            return next_f()
+        return next_b()
+
+    # 1f1b: cap in-flight forwards at the warmup depth, prefer backward
+    # once the cap is reached (PipeDream-flush)
+    in_flight = fwd_done - bwd_done
+    # v==1: stage d holds at most p-d in-flight (classic 1F1B);
+    # interleaved: Megatron's warmup count 2(p-d-1) + (v-1)p, +1 for
+    # the steady-state forward in flight
+    warmup_cap = 2 * (p - d - 1) + (v - 1) * p + 1 if v > 1 else (p - d)
+    if in_flight >= warmup_cap or fwd_done >= v * m:
+        unit = next_b()
+        if unit is not None:
+            return unit
+        # backward blocked on a not-yet-run LATER-chunk forward: that
+        # forward must proceed or the schedule deadlocks (the cap
+        # still bounds the stash at warmup_cap + v - 1)
+        return next_f() if v > 1 else None
+    unit = next_f()
+    if unit is not None:
+        return unit
+    return next_b()
+
+
+def stats(table, unit_time=1.0):
+    """Schedule metrics: makespan, per-device idle time, bubble
+    fraction, and peak in-flight microbatches (= activation-stash slots
+    a real execution needs).
+
+    Args:
+      unit_time: wall time of one scheduled unit.  For an interleaved
+        schedule at FIXED model depth each chunk is ``1/v`` of the
+        model, so pass ``1/v`` to compare wall-clock against a
+        non-interleaved schedule of the same model.
+    """
+    p = len(table)
+    makespan = len(table[0]) * unit_time
+    idle = [
+        sum(1 for u in row if u is None) * unit_time for row in table
+    ]
+    busy = [makespan - i for i in idle]
+    bubble = sum(idle) / float(p * makespan)
+    peak = []
+    for row in table:
+        live = 0
+        worst = 0
+        for u in row:
+            if u is None:
+                continue
+            live += 1 if u.kind == "F" else -1
+            worst = max(worst, live)
+        peak.append(worst)
+    return {
+        "makespan": makespan,
+        "idle_ticks": idle,
+        "busy_ticks": busy,
+        "bubble_fraction": round(bubble, 4),
+        "peak_in_flight": peak,
+    }
+
+
+def stage_program(num_stages, num_microbatches, schedule="1f1b"):
+    """Flatten a (non-interleaved) schedule into per-tick static arrays
+    for the SPMD execution in pp.py.
+
+    Returns dict of numpy int arrays, each ``[T, P]``:
+      ``do_f``/``f_mb`` — whether/which microbatch device d forwards at
+      tick t; ``do_b``/``b_mb`` — same for backward.
+    """
+    import numpy as np
+
+    table = simulate(num_stages, num_microbatches, schedule, interleave=1)
+    p = num_stages
+    t_len = len(table[0])
+    do_f = np.zeros((t_len, p), np.int32)
+    f_mb = np.zeros((t_len, p), np.int32)
+    do_b = np.zeros((t_len, p), np.int32)
+    b_mb = np.zeros((t_len, p), np.int32)
+    for d in range(p):
+        for t, u in enumerate(table[d]):
+            if u is None:
+                continue
+            if u.kind == "F":
+                do_f[t, d] = 1
+                f_mb[t, d] = u.mb
+            else:
+                do_b[t, d] = 1
+                b_mb[t, d] = u.mb
+    return {"do_f": do_f, "f_mb": f_mb, "do_b": do_b, "b_mb": b_mb}

@@ -248,10 +248,12 @@ def main(argv=None):
         _parse_mapping(args.output_mapping) if args.output_mapping else None
     )
 
-    os.makedirs(args.output, exist_ok=True)
-    out_path = os.path.join(args.output, "part-00000.jsonl")
+    from tensorflowonspark_tpu.utils import fs as fs_utils
+
+    fs_utils.makedirs(args.output)
+    out_path = fs_utils.join(args.output, "part-00000.jsonl")
     count = 0
-    with open(out_path, "w") as f:
+    with fs_utils.open_file(out_path, "w") as f:
         for out_row in predict_rows(
             predict, rows, input_mapping, output_mapping, args.batch_size
         ):

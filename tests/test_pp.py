@@ -178,3 +178,108 @@ class TestPipelineTrainer:
             state, metrics = trainer.step(state, batch)
             losses.append(float(metrics["loss"]))
         assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+    def test_1f1b_loss_and_grads_match_gpipe_reference(self):
+        # the hand-scheduled 1F1B step computes the SAME gradients as
+        # the AD-derived GPipe step and the sequential reference
+        mesh, params, first_fn, last_fn, ref_loss = self._setup(
+            {"data": 2, "pipe": 4}
+        )
+        batch = {
+            "x": np.random.RandomState(4).randn(16, 8).astype(np.float32),
+            "y": np.random.RandomState(5).randn(16).astype(np.float32),
+        }
+        trainer = pp.PipelineTrainer(
+            _layer_fn, first_fn, last_fn, optax.sgd(1.0), mesh,
+            num_microbatches=4, schedule="1f1b",
+        )
+        state = trainer.create_state(jax.tree.map(jnp.asarray, params))
+        old_params = jax.tree.map(np.asarray, state.params)
+        new_state, metrics = trainer.step(state, batch)
+
+        ref_l, ref_g = jax.value_and_grad(ref_loss)(
+            params, jax.tree.map(jnp.asarray, batch)
+        )
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(ref_l), atol=1e-5, rtol=1e-5
+        )
+        got_g = jax.tree.map(
+            lambda old, new: old - np.asarray(new), old_params, new_state.params
+        )
+        for path, g in jax.tree_util.tree_flatten_with_path(got_g)[0]:
+            r = functools.reduce(
+                lambda t, k: t[k.key if hasattr(k, "key") else k.idx],
+                path,
+                ref_g,
+            )
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=1e-4, rtol=1e-4,
+                err_msg=str(path),
+            )
+
+    def test_1f1b_training_reduces_loss(self):
+        mesh, params, first_fn, last_fn, _ = self._setup(
+            {"data": 2, "pipe": 4}, num_layers=4, stages=4
+        )
+        batch = {
+            "x": np.random.RandomState(6).randn(32, 8).astype(np.float32),
+            "y": np.random.RandomState(7).randn(32).astype(np.float32),
+        }
+        trainer = pp.PipelineTrainer(
+            _layer_fn, first_fn, last_fn, optax.adam(3e-3), mesh,
+            num_microbatches=8, schedule="1f1b",
+        )
+        state = trainer.create_state(jax.tree.map(jnp.asarray, params))
+        losses = []
+        for _ in range(20):
+            state, metrics = trainer.step(state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.8, losses[:3] + losses[-3:]
+
+
+class TestSchedules:
+    """Scheduled-ops trace tests (VERDICT r1 #9): 1F1B's activation
+    stash is O(P) where GPipe's is O(M), and the interleaved schedule
+    has measurably fewer idle ticks; single-slot handoff buffers never
+    overrun."""
+
+    def test_1f1b_stash_bound_vs_gpipe(self):
+        from tensorflowonspark_tpu.parallel import pp_schedule as ps
+
+        p, m = 4, 16  # M = 4P
+        g = ps.stats(ps.simulate(p, m, "gpipe"))
+        f = ps.stats(ps.simulate(p, m, "1f1b"))
+        assert g["peak_in_flight"] == [m] * p
+        assert f["peak_in_flight"] == [p - d for d in range(p)]
+        # same bubble at v=1 (the memory, not the bubble, is the win)
+        assert f["makespan"] == g["makespan"] == 2 * (m + p - 1)
+
+    def test_interleaved_1f1b_fewer_idle_ticks(self):
+        from tensorflowonspark_tpu.parallel import pp_schedule as ps
+
+        p, m, v = 4, 16, 2  # M = 4P, two virtual chunks per device
+        g = ps.stats(ps.simulate(p, m, "gpipe"))
+        i = ps.stats(ps.simulate(p, m, "1f1b", interleave=v), unit_time=1.0 / v)
+        assert sum(i["idle_ticks"]) < sum(g["idle_ticks"])
+        assert i["bubble_fraction"] < g["bubble_fraction"]
+        assert i["makespan"] < g["makespan"]
+
+    @pytest.mark.parametrize("p,m", [(2, 4), (4, 8), (3, 9), (4, 5), (8, 32)])
+    def test_single_slot_handoff_never_overruns(self, p, m):
+        # the execution in pp.py keeps ONE fwd and ONE bwd buffer; the
+        # schedule must never produce unit j+1 before j was consumed
+        from tensorflowonspark_tpu.parallel import pp_schedule as ps
+
+        tab = ps.simulate(p, m, "1f1b")
+        tick_f, tick_b = {}, {}
+        for d in range(p):
+            for t, u in enumerate(tab[d]):
+                if u is None:
+                    continue
+                (tick_f if u.kind == "F" else tick_b)[(d, u.mb)] = t
+        for d in range(1, p):
+            for j in range(m - 1):
+                assert tick_f[(d - 1, j + 1)] >= tick_f[(d, j)]
+        for d in range(p - 1):
+            for j in range(m - 1):
+                assert tick_b[(d + 1, j + 1)] >= tick_b[(d, j)]
