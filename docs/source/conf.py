@@ -1,0 +1,23 @@
+# Sphinx configuration for the tensorflowonspark_tpu API reference
+# (role parity with the reference's docs/source/conf.py autodoc build).
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..")))
+
+project = "tensorflowonspark_tpu"
+author = "tensorflowonspark_tpu contributors"
+release = "0.2.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.viewcode",
+    "sphinx.ext.napoleon",
+]
+
+autodoc_member_order = "bysource"
+autodoc_mock_imports = []  # jax/flax/optax are import-time requirements
+
+templates_path = []
+exclude_patterns = []
+html_theme = "alabaster"

@@ -38,7 +38,7 @@ def context_parallel_attention(q, k, v, mesh, strategy="ring", **kwargs):
             seq_len=q.shape[1],
             num_heads=q.shape[2],
             head_dim=q.shape[3],
-            seq_devices=mesh.shape.get("seq", 1),
+            seq_devices=mesh.shape.get(kwargs.get("axis_name", "seq"), 1),
         )
     if strategy not in STRATEGIES:
         raise ValueError(

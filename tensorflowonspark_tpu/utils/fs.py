@@ -12,7 +12,6 @@ fsspec is an optional dependency: importing this module never requires
 it, and :func:`is_remote` paths raise a clear error if it is missing.
 """
 
-import glob as _glob
 import logging
 import os
 import posixpath
@@ -97,13 +96,13 @@ def join(path, *parts):
 
 def list_files(path):
     """Non-recursive listing of the *files* directly under ``path``,
-    as full paths (remote results keep their scheme), sorted."""
+    as full paths (remote results keep their scheme), sorted.  Both
+    branches include dotfiles — callers filter (``fs.ls`` lists them,
+    and a glob-based local branch silently would not)."""
     if not is_remote(path):
         base = local_path(path)
         return sorted(
-            f
-            for f in _glob.glob(os.path.join(base, "*"))
-            if os.path.isfile(f)
+            e.path for e in os.scandir(base) if e.is_file()
         )
     scheme, _ = split_scheme(path)
     fs, fs_path = _fs_for(path)

@@ -31,3 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "spark: end-to-end tests against a real pyspark local-cluster "
+        "(skipped when pyspark is not installed; CI runs them)",
+    )

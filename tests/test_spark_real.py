@@ -1,0 +1,99 @@
+"""End-to-end SparkEngine tests against REAL pyspark executors.
+
+The reference's whole suite ran on a live 2-worker Spark Standalone
+cluster (reference: test/run_tests.sh:16-27) because local mode hides
+the process boundaries TFoS depends on.  Same posture here:
+``local-cluster[2,1,1024]`` gives two genuine executor JVMs, each with
+its own python worker — the flagship claim ("turn a Spark job's
+executors into a TPU cluster") exercised on Spark itself.
+
+Gated: pyspark is not in the TPU image; CI installs it (see
+.github/workflows/ci.yml job ``spark``) and runs ``pytest -m spark``.
+"""
+
+import pytest
+
+pyspark = pytest.importorskip("pyspark")
+
+pytestmark = pytest.mark.spark
+
+
+@pytest.fixture(scope="module")
+def sc():
+    from pyspark import SparkConf, SparkContext
+
+    conf = (
+        SparkConf()
+        .setMaster("local-cluster[2,1,1024]")
+        .setAppName("tfos-tpu-spark-e2e")
+        .set("spark.executor.instances", "2")
+        .set("spark.cores.max", "2")
+        .set("spark.executor.memory", "1g")
+        .set("spark.python.worker.reuse", "true")
+    )
+    sc = SparkContext(conf=conf)
+    yield sc
+    sc.stop()
+
+
+def _square_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=False)
+    while not feed.should_stop():
+        batch = feed.next_batch(10)
+        if batch:
+            feed.batch_results([x * x for x in batch])
+
+
+def _consume_fn(args, ctx):
+    feed = ctx.get_data_feed(train_mode=True)
+    total = 0
+    while not feed.should_stop():
+        total += len(feed.next_batch(16))
+    ctx.mgr.set("consumed", total)
+
+
+def test_spark_engine_metadata(sc):
+    from tensorflowonspark_tpu.engine import SparkEngine
+
+    eng = SparkEngine(sc)
+    assert eng.num_executors == 2
+    assert eng.run_job(lambda it: [sum(it)], [[1, 2], [3]], collect=True) == [3, 3]
+
+
+def test_cluster_inference_roundtrip_on_spark(sc):
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+
+    cluster = tpu_cluster.run(
+        sc,  # raw SparkContext: run() wraps it in SparkEngine
+        _square_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    data = list(range(100))
+    rdd = sc.parallelize(data, 4)
+    # native path: the RDD is fed in place (mapPartitions), and the
+    # lazy result RDD is the reference's inference() contract
+    result_rdd = cluster.inference(rdd, feed_timeout=120, lazy=True)
+    results = result_rdd.collect()
+    assert sorted(results) == sorted(x * x for x in data)
+    cluster.shutdown(grace_secs=2, timeout=120)
+
+
+def test_cluster_train_rdd_native_on_spark(sc):
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+
+    cluster = tpu_cluster.run(
+        sc,
+        _consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    rdd = sc.parallelize(
+        [(float(i), float(2 * i)) for i in range(200)], 4
+    )
+    cluster.train(rdd, num_epochs=2, feed_timeout=120)
+    cluster.shutdown(grace_secs=2, timeout=120)
