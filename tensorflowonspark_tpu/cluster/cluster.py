@@ -164,6 +164,30 @@ class TPUCluster(object):
         logger.info("stream feed complete after %d batches", fed)
         return fed
 
+    def train_dstream(self, dstream, feed_timeout=600, qname="input"):
+        """Hook a Spark DStream: each micro-batch RDD is fed in place as
+        it arrives (reference: TFCluster.py:83-85 ``foreachRDD`` +
+        examples/mnist/estimator/mnist_spark_streaming.py).  Call
+        ``ssc.start()`` afterwards; stop feeding with
+        ``reservation.Client(addr).request_stop()`` (reference:
+        examples/utils/stop_streaming.py) or by stopping the context.
+        """
+        assert self.input_mode == InputMode.SPARK, (
+            "train_dstream() requires InputMode.SPARK"
+        )
+        feed_fn = node.train(
+            self.cluster_info, self.cluster_meta, feed_timeout, qname
+        )
+        server = self.server
+
+        def _each_rdd(rdd):
+            if server.stop_requested:
+                logger.info("stop requested; skipping stream micro-batch")
+                return
+            rdd.foreachPartition(feed_fn)
+
+        dstream.foreachRDD(_each_rdd)
+
     def inference(self, data, feed_timeout=600, qname="input", lazy=False):
         """Feed data for inference and return results
         (reference: TFCluster.py:96-115).

@@ -32,3 +32,109 @@ class Block(Marker):
 
     def __len__(self):
         return len(self.items)
+
+
+class ColumnarBlock(Marker):
+    """A batch of feed rows shipped as stacked numpy COLUMNS.
+
+    One step beyond :class:`Block`: instead of N pickled row objects,
+    the block carries one contiguous array per column — serialization
+    is a few buffer copies, and the consumer slices batches out with
+    zero per-row Python (``DataFeed.next_arrays``).  This is the
+    Spark→HBM staging layout: columns go straight to ``device_put``.
+
+    ``columns`` is a tuple of arrays (tuple/list rows, in field order)
+    or a dict of arrays (dict rows); every array shares the leading
+    row dimension ``count``.
+
+    Row-identity caveat (documented, deliberate): values delivered
+    through the row-compat path (:meth:`rows` / ``DataFeed.next_batch``)
+    are numpy-typed — ``np.int64(3)`` where the feeder saw ``3``.
+    Numerics are identical; code that needs exact Python types (e.g.
+    ``json.dumps`` of rows) should disable packing with
+    ``TFOS_COLUMNAR_FEED=0``.  :func:`pack_columnar` refuses blocks
+    whose columns mix Python element types, so an int is never silently
+    promoted to float.
+    """
+
+    __slots__ = ("columns", "count", "_scalar", "_list_rows")
+
+    def __init__(self, columns, count, _scalar=False, _list_rows=False):
+        self.columns = columns
+        self.count = count
+        #: True when the block packs *scalar* rows into one column —
+        #: rows() then yields scalars, not 1-tuples
+        self._scalar = _scalar
+        #: True when the source rows were lists (rows() preserves that)
+        self._list_rows = _list_rows
+
+    def __len__(self):
+        return self.count
+
+    def rows(self):
+        """Row-objects view (compat path for row-mode consumers)."""
+        if isinstance(self.columns, dict):
+            keys = sorted(self.columns)
+            cols = [self.columns[k] for k in keys]
+            return [
+                dict(zip(keys, vals)) for vals in zip(*cols)
+            ]
+        if len(self.columns) == 1 and self._scalar:
+            return list(self.columns[0])
+        if self._list_rows:
+            return [list(vals) for vals in zip(*self.columns)]
+        return list(zip(*self.columns))
+
+
+def _column_array(values):
+    """Stack one column; ``None`` unless all elements share one Python
+    type and the result is a non-object array (mixed int/float rows
+    must NOT silently promote — an exact int delivered as 1.0 through
+    the row-compat path corrupts label/index semantics)."""
+    import numpy as np
+
+    t0 = type(values[0])
+    for v in values:
+        if type(v) is not t0:
+            return None
+    arr = np.asarray(values)
+    if arr.dtype == object:
+        return None
+    return arr
+
+
+def pack_columnar(rows):
+    """Try to pack a list of rows into a :class:`ColumnarBlock`;
+    ``None`` when the rows are not fixed-shape homogeneous numerics
+    (ragged, mixed element types, arbitrary objects) — callers fall
+    back to :class:`Block`."""
+    if not rows:
+        return None
+    first = rows[0]
+    try:
+        if isinstance(first, dict):
+            keys = list(first)
+            cols = {}
+            for k in keys:
+                arr = _column_array([r[k] for r in rows])
+                if arr is None:
+                    return None
+                cols[k] = arr
+            return ColumnarBlock(cols, len(rows))
+        if isinstance(first, (tuple, list)):
+            width = len(first)
+            out = []
+            for i in range(width):
+                arr = _column_array([r[i] for r in rows])
+                if arr is None:
+                    return None
+                out.append(arr)
+            return ColumnarBlock(
+                tuple(out), len(rows), _list_rows=isinstance(first, list)
+            )
+        arr = _column_array(rows)
+        if arr is None:
+            return None
+        return ColumnarBlock((arr,), len(rows), _scalar=True)
+    except (ValueError, TypeError, KeyError, IndexError):
+        return None

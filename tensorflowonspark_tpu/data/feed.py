@@ -29,7 +29,12 @@ import queue as queue_mod
 
 import numpy as np
 
-from tensorflowonspark_tpu.cluster.marker import Block, EndPartition
+from tensorflowonspark_tpu.cluster.marker import (
+    Block,
+    ColumnarBlock,
+    EndPartition,
+    pack_columnar,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -71,39 +76,22 @@ class DataFeed(object):
         #: next_batch blocks on the hot source, polls the other
         self._hot_source = "ring"
 
-    def next_batch(self, batch_size):
-        """Gets a batch of items from the input queue.
+    _RING_SENTINEL = object()  # internal: ring produced a block
 
-        Blocks until items are available (or the ``None`` end-of-feed
-        sentinel is seen).  Returns a list of items, or — when
-        ``input_mapping`` was provided — a dict of named column lists
-        (reference: TFNode.py:243-288).
+    def _fetch(self):
+        """Block until the next feed element arrives; returns it.
+
+        Ring elements are installed as pending directly and signalled
+        with ``_RING_SENTINEL``; queue elements (rows, Blocks, markers,
+        the ``None`` end-of-feed sentinel) are returned raw with
+        ``task_done`` left to the caller's handling here.
         """
         if self._qin is None:
             self._qin = self.mgr.get_queue(self.qname_in)
         queue_in = self._qin
-        tensors = [] if self.input_tensors is None else {
-            tensor: [] for tensor in self.input_tensors
-        }
-        count = 0
-
-        def _consume(item):
-            if self.input_tensors is None:
-                tensors.append(item)
-            else:
-                for i, tensor in enumerate(self.input_tensors):
-                    tensors[tensor].append(item[i])
-
         if not self._ring_checked:
             self._attach_ring()
-        while count < batch_size:
-            # drain Block leftovers first (feeders ship rows in Blocks —
-            # one manager RPC per block, marker.Block)
-            if self._pending_pos < len(self._pending):
-                _consume(self._pending[self._pending_pos])
-                self._pending_pos += 1
-                count += 1
-                continue
+        while True:
             if self._ring is not None:
                 # shm fast path: rows usually arrive through the ring,
                 # but control sentinels (None / EndPartition) and
@@ -119,36 +107,97 @@ class DataFeed(object):
 
                 if self._hot_source == "queue":
                     try:
-                        item = queue_in.get(block=True, timeout=0.05)
+                        return queue_in.get(block=True, timeout=0.05)
                     except queue_mod.Empty:
                         rec = self._ring.pop(timeout=0)
                         if rec is None:
                             continue
                         self._hot_source = "ring"
-                        self._pending = _p.loads(rec)
-                        self._pending_pos = 0
-                        continue
+                        self._set_pending(_p.loads(rec))
+                        return self._RING_SENTINEL
                 else:
                     rec = self._ring.pop(timeout=0.05)
                     if rec is not None:
-                        self._pending = _p.loads(rec)
-                        self._pending_pos = 0
-                        continue
+                        self._set_pending(_p.loads(rec))
+                        return self._RING_SENTINEL
                     try:
                         item = queue_in.get(block=False)
                         self._hot_source = "queue"
+                        return item
                     except queue_mod.Empty:
                         continue
             else:
-                item = queue_in.get(block=True)
+                return queue_in.get(block=True)
+
+    def _set_pending(self, obj):
+        """Install a ring/queue block as the pending element (a row list
+        or a :class:`ColumnarBlock`)."""
+        self._pending = obj
+        self._pending_pos = 0
+
+    def _pending_left(self):
+        n = (
+            self._pending.count
+            if isinstance(self._pending, ColumnarBlock)
+            else len(self._pending)
+        )
+        return n - self._pending_pos
+
+    def _pending_rows(self):
+        """Row-objects view of the pending element (converts a columnar
+        block ONCE — the row-mode compat path)."""
+        if isinstance(self._pending, ColumnarBlock):
+            self._pending = self._pending.rows()
+        return self._pending
+
+    def next_batch(self, batch_size):
+        """Gets a batch of items from the input queue.
+
+        Blocks until items are available (or the ``None`` end-of-feed
+        sentinel is seen).  Returns a list of items, or — when
+        ``input_mapping`` was provided — a dict of named column lists
+        (reference: TFNode.py:243-288).  Training loops should prefer
+        :meth:`next_arrays`, which consumes columnar blocks with zero
+        per-row Python.
+        """
+        queue_in = None
+        tensors = [] if self.input_tensors is None else {
+            tensor: [] for tensor in self.input_tensors
+        }
+        count = 0
+
+        def _consume(item):
+            if self.input_tensors is None:
+                tensors.append(item)
+            else:
+                for i, tensor in enumerate(self.input_tensors):
+                    tensors[tensor].append(item[i])
+
+        while count < batch_size:
+            if self._pending_left() > 0:
+                rows = self._pending_rows()
+                _consume(rows[self._pending_pos])
+                self._pending_pos += 1
+                count += 1
+                continue
+            if self.done_feeding:
+                # calls after end-of-feed return what's left instead of
+                # blocking on a drained queue (reference: TFNode.py:258
+                # loops `while not done_feeding`)
+                break
+            item = self._fetch()
+            if item is self._RING_SENTINEL:
+                continue  # pending installed by _fetch
+            queue_in = self._qin
             if item is None:
                 # End-of-feed: mark done and stop (reference: TFNode.py:265-268)
                 queue_in.task_done()
                 self.done_feeding = True
                 break
-            elif isinstance(item, Block):
-                self._pending = item.items
-                self._pending_pos = 0
+            elif isinstance(item, (Block, ColumnarBlock)):
+                self._set_pending(
+                    item.items if isinstance(item, Block) else item
+                )
                 queue_in.task_done()
             elif isinstance(item, EndPartition):
                 # Truncate the batch at a partition boundary
@@ -162,6 +211,97 @@ class DataFeed(object):
                 queue_in.task_done()
         logger.debug("next_batch() returning %d items", count)
         return tensors
+
+    def next_arrays(self, batch_size):
+        """Columnar fast path: a batch as stacked numpy columns.
+
+        Consumes :class:`ColumnarBlock` elements by SLICING — no
+        per-row Python objects anywhere (the Spark→HBM staging layout;
+        row Blocks interleaved in the stream are stacked as a fallback).
+
+        Returns ``(columns, count)`` where ``columns`` is a tuple of
+        arrays (tuple/field rows), a dict of arrays (dict rows or
+        ``input_mapping``), or a single array (scalar rows); ``count``
+        is the number of rows (< ``batch_size`` at a partition
+        boundary; 0 with ``columns=None`` at end-of-feed).
+        """
+        pieces = []  # per-fragment column sets
+        count = 0
+        scalar = False
+        while count < batch_size:
+            left = self._pending_left()
+            if left == 0 and self.done_feeding:
+                break  # post-end-of-feed calls must not block
+            if left > 0:
+                if isinstance(self._pending, ColumnarBlock):
+                    take = min(batch_size - count, left)
+                    pos = self._pending_pos
+                    cols = self._pending.columns
+                    sl = (
+                        {
+                            k: v[pos : pos + take]
+                            for k, v in cols.items()
+                        }
+                        if isinstance(cols, dict)
+                        else tuple(c[pos : pos + take] for c in cols)
+                    )
+                    scalar = scalar or self._pending._scalar
+                    pieces.append(sl)
+                    self._pending_pos += take
+                    count += take
+                else:
+                    # row fallback: stack the pending rows into columns
+                    take = min(batch_size - count, left)
+                    rows = self._pending[
+                        self._pending_pos : self._pending_pos + take
+                    ]
+                    blk = pack_columnar(rows)
+                    if blk is None:
+                        raise TypeError(
+                            "next_arrays() requires fixed-shape numeric "
+                            "rows; use next_batch() for object rows"
+                        )
+                    scalar = scalar or blk._scalar
+                    pieces.append(blk.columns)
+                    self._pending_pos += take
+                    count += take
+                continue
+            item = self._fetch()
+            if item is self._RING_SENTINEL:
+                continue
+            queue_in = self._qin
+            if item is None:
+                queue_in.task_done()
+                self.done_feeding = True
+                break
+            elif isinstance(item, ColumnarBlock):
+                self._set_pending(item)
+                queue_in.task_done()
+            elif isinstance(item, Block):
+                self._set_pending(item.items)
+                queue_in.task_done()
+            elif isinstance(item, EndPartition):
+                queue_in.task_done()
+                if count > 0:
+                    break
+            else:
+                self._set_pending([item])
+                queue_in.task_done()
+        if count == 0:
+            return None, 0
+        cols = _concat_pieces(pieces)
+        if self.input_tensors is not None:
+            if isinstance(cols, dict):
+                # dict rows: select + order by the mapping's sorted keys
+                # (mirrors next_batch's sorted-column contract)
+                cols = {k: cols[k] for k in self.input_tensors}
+            else:
+                seq = (cols,) if not isinstance(cols, tuple) else cols
+                cols = dict(zip(self.input_tensors, seq))
+        elif scalar and isinstance(cols, tuple) and len(cols) == 1:
+            cols = cols[0]
+        logger.debug("next_arrays() returning %d rows", count)
+        return cols, count
 
     def _attach_ring(self):
         """Attach the node's shm feed ring if the runtime advertised one
@@ -253,6 +393,20 @@ class DataFeed(object):
                 yield batch, n
             else:
                 yield batch
+
+
+def _concat_pieces(pieces):
+    """Join per-fragment column sets (single fragment: no copy)."""
+    first = pieces[0]
+    if len(pieces) == 1:
+        return first
+    if isinstance(first, dict):
+        return {
+            k: np.concatenate([p[k] for p in pieces]) for k in first
+        }
+    return tuple(
+        np.concatenate([p[i] for p in pieces]) for i in range(len(first))
+    )
 
 
 def _batch_len(batch):

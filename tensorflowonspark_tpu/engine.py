@@ -225,14 +225,39 @@ def _executor_main(
 
 
 class LocalEngine(Engine):
-    """N executor processes on one host with Spark-like task scheduling."""
+    """N executor processes on one host with Spark-like task scheduling.
+
+    ``deterministic=True`` (or env ``TFOS_DETERMINISTIC_FEED=1``) routes
+    task ``i`` to executor ``i % N`` instead of letting free executors
+    race for tasks — partition→worker assignment becomes reproducible,
+    which turns flaky closeness assertions into sharp ones in
+    integration tests (the reference had no such mode; its Spark
+    scheduling was nondeterministic too).
+    """
 
     num_executors_exact = True
 
-    def __init__(self, num_executors, env=None, start_method="spawn"):
+    def __init__(
+        self, num_executors, env=None, start_method="spawn",
+        deterministic=None,
+    ):
+        if deterministic is None:
+            deterministic = (
+                os.environ.get("TFOS_DETERMINISTIC_FEED") == "1"
+            )
+        self._deterministic = bool(deterministic)
         self._num_executors = num_executors
         self._ctx = multiprocessing.get_context(start_method)
-        self._task_queue = self._ctx.Queue()
+        #: shared work-stealing queue (default mode) XOR one private
+        #: queue per executor (deterministic mode)
+        self._task_queue = (
+            None if self._deterministic else self._ctx.Queue()
+        )
+        self._task_queues = (
+            [self._ctx.Queue() for _ in range(num_executors)]
+            if self._deterministic
+            else None
+        )
         self._result_queue = self._ctx.Queue()
         # shared cancelled-job registry (see _executor_main); a Manager
         # dict so executor processes observe cancellations immediately
@@ -262,7 +287,9 @@ class LocalEngine(Engine):
                 args=(
                     i,
                     workdir,
-                    self._task_queue,
+                    self._task_queues[i]
+                    if self._deterministic
+                    else self._task_queue,
                     self._result_queue,
                     env or {},
                     self._cancelled,
@@ -323,9 +350,12 @@ class LocalEngine(Engine):
                 # callables ship as-is (lazy, executor-side generation);
                 # anything else materializes to a row list
                 payload = part if callable(part) else list(part)
-                self._task_queue.put(
-                    (job_id, task_id, fn_bytes, _pickle.dumps(payload))
+                q = (
+                    self._task_queues[task_id % self._num_executors]
+                    if self._deterministic
+                    else self._task_queue
                 )
+                q.put((job_id, task_id, fn_bytes, _pickle.dumps(payload)))
             buffered = {}
             next_yield = 0
             remaining = ntasks
@@ -391,9 +421,12 @@ class LocalEngine(Engine):
             return self._active_jobs
 
     def stop(self):
-        for _ in self._procs:
+        for i, _ in enumerate(self._procs):
             try:
-                self._task_queue.put(None)
+                if self._deterministic:
+                    self._task_queues[i].put(None)
+                else:
+                    self._task_queue.put(None)
             except (OSError, ValueError):
                 pass
         try:

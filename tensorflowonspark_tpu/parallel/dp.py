@@ -227,14 +227,16 @@ class SyncTrainer(object):
         log_every=100,
         steps_per_execution=1,
         metrics_callback=None,
+        columnar=None,
     ):
         """Run the synchronized feed loop: pull batches from a
         :class:`~tensorflowonspark_tpu.data.feed.DataFeed`, stop globally
         when any host runs dry (see module docstring).
 
         Args:
-          preprocess: ``fn(list_of_rows) -> batch pytree`` (default:
-            ``np.asarray`` stacking).
+          preprocess: ``fn(batch) -> batch pytree``.  In row mode
+            ``batch`` is the list of rows; in columnar mode it is the
+            stacked-columns pytree from ``feed.next_arrays``.
           steps_per_execution: fuse up to this many steps into one
             :meth:`multi_step` dispatch (per-batch readiness stays
             globally agreed, so every host fuses the same count; a
@@ -243,6 +245,10 @@ class SyncTrainer(object):
             each executed group with the (device-resident) metrics of
             its last step — losses are global (psum over the mesh), so
             every host observes identical values.
+          columnar: consume via ``feed.next_arrays`` (zero per-row
+            Python; requires fixed-shape numeric rows).  Default: auto —
+            columnar when no ``preprocess`` is given, since the batch
+            pytree is then identical to the row path's stacking.
         Returns the final state.
         """
         if steps_per_execution < 1:
@@ -252,6 +258,8 @@ class SyncTrainer(object):
                 )
             )
         rng = rng if rng is not None else jax.random.PRNGKey(0)
+        if columnar is None:
+            columnar = preprocess is None
         steps = 0
         stop = False
         while not stop:
@@ -267,21 +275,28 @@ class SyncTrainer(object):
             # same data the reference's '90% of steps' trick dropped).
             group, subs = [], []
             for _ in range(limit):
-                rows = feed.next_batch(batch_size)
-                have = (
-                    bool(rows)
-                    and len(rows) == batch_size
-                    and not feed.should_stop()
-                )
+                if columnar:
+                    batch, n = feed.next_arrays(batch_size)
+                    have = n == batch_size and not feed.should_stop()
+                else:
+                    rows = feed.next_batch(batch_size)
+                    have = (
+                        bool(rows)
+                        and len(rows) == batch_size
+                        and not feed.should_stop()
+                    )
                 if not all_hosts_ready(have):
                     if have:
                         logger.info("dropping one ready batch at global stop")
                     logger.info("global stop after %d steps", steps)
                     stop = True
                     break
-                group.append(
-                    preprocess(rows) if preprocess else _default_batch(rows)
-                )
+                if columnar:
+                    group.append(preprocess(batch) if preprocess else batch)
+                else:
+                    group.append(
+                        preprocess(rows) if preprocess else _default_batch(rows)
+                    )
                 rng, sub = jax.random.split(rng)
                 subs.append(sub)
             if not group:

@@ -216,3 +216,154 @@ def test_block_with_input_mapping(mgr):
     _feed(mgr, [Block([[0, 10], [1, 11]]), None])
     feed = DataFeed(mgr, input_mapping={"x": "a", "y": "b"})
     assert feed.next_batch(4) == {"x": [0, 1], "y": [10, 11]}
+
+
+# ----------------------------------------------------------------------
+# columnar fast path (ColumnarBlock + next_arrays)
+# ----------------------------------------------------------------------
+
+
+def test_pack_columnar_shapes():
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    rows = [(np.arange(4, dtype=np.float32) + i, i) for i in range(6)]
+    blk = pack_columnar(rows)
+    assert blk is not None and blk.count == 6
+    assert blk.columns[0].shape == (6, 4)
+    assert blk.columns[1].shape == (6,)
+    # rows() round-trips
+    back = blk.rows()
+    np.testing.assert_array_equal(back[2][0], rows[2][0])
+    # ragged rows fall back
+    assert pack_columnar([[1, 2], [3]]) is None
+    # dict rows
+    dblk = pack_columnar([{"a": i, "b": [i, i]} for i in range(3)])
+    assert dblk.columns["b"].shape == (3, 2)
+    # scalar rows
+    sblk = pack_columnar([1, 2, 3])
+    assert sblk._scalar and sblk.rows() == [1, 2, 3]
+
+
+def test_next_arrays_slices_columnar_blocks(mgr):
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    rows = [(np.full(3, i, np.float32), np.int64(i)) for i in range(10)]
+    _feed(mgr, [pack_columnar(rows[:6]), pack_columnar(rows[6:]), None])
+    feed = DataFeed(mgr, train_mode=True)
+    cols, n = feed.next_arrays(4)
+    assert n == 4 and cols[0].shape == (4, 3)
+    np.testing.assert_array_equal(cols[1], [0, 1, 2, 3])
+    cols, n = feed.next_arrays(4)  # spans the block boundary
+    assert n == 4
+    np.testing.assert_array_equal(cols[1], [4, 5, 6, 7])
+    cols, n = feed.next_arrays(4)  # short tail then sentinel
+    assert n == 2
+    np.testing.assert_array_equal(cols[1], [8, 9])
+    assert feed.should_stop()
+    cols, n = feed.next_arrays(4)
+    assert n == 0 and cols is None
+
+
+def test_next_arrays_mixed_row_and_columnar(mgr):
+    from tensorflowonspark_tpu.cluster.marker import Block, pack_columnar
+
+    a = [(np.float32(i), np.float32(2 * i)) for i in range(4)]
+    b = [(np.float32(i), np.float32(2 * i)) for i in range(4, 8)]
+    _feed(mgr, [pack_columnar(a), Block(b), None])
+    feed = DataFeed(mgr, train_mode=True)
+    cols, n = feed.next_arrays(8)
+    assert n == 8
+    np.testing.assert_array_equal(cols[0], np.arange(8, dtype=np.float32))
+    np.testing.assert_array_equal(cols[1], 2 * np.arange(8, dtype=np.float32))
+
+
+def test_next_arrays_input_mapping(mgr):
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    rows = [(np.float32(i), np.float32(10 + i)) for i in range(4)]
+    _feed(mgr, [pack_columnar(rows), None])
+    feed = DataFeed(mgr, input_mapping={"x": "inp", "y": "label"})
+    cols, n = feed.next_arrays(4)
+    assert n == 4 and set(cols) == {"x", "y"}
+    np.testing.assert_array_equal(cols["x"], [0, 1, 2, 3])
+    np.testing.assert_array_equal(cols["y"], [10, 11, 12, 13])
+
+
+def test_next_batch_unpacks_columnar_blocks(mgr):
+    # row-mode consumers keep working when the feeder ships columnar
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    _feed(mgr, [pack_columnar(list(range(5))), None])
+    feed = DataFeed(mgr)
+    batch = feed.next_batch(10)
+    assert [int(x) for x in batch] == [0, 1, 2, 3, 4]
+
+
+def test_train_on_feed_columnar_matches_row_mode(mgr):
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+    from tensorflowonspark_tpu.parallel import dp
+
+    rng_np = np.random.RandomState(1)
+    w_true = np.array([0.5, -1.0, 2.0], np.float32)
+    rows = []
+    for _ in range(6 * 8):
+        x = rng_np.rand(3).astype(np.float32)
+        rows.append((x, np.float32(x @ w_true)))
+
+    def loss(params, batch, rng):
+        import jax.numpy as jnp
+
+        x, y = batch
+        pred = jnp.dot(x, params["w"])
+        return jnp.mean((pred - y) ** 2)
+
+    def run(columnar, as_blocks):
+        items = (
+            [pack_columnar(rows[i : i + 16]) for i in range(0, len(rows), 16)]
+            if as_blocks
+            else list(rows)
+        )
+        _feed(mgr, items + [None])
+        feed = DataFeed(mgr, train_mode=True)
+        trainer = dp.SyncTrainer(loss, optax.adam(0.05))
+        state = trainer.create_state({"w": np.zeros(3, np.float32)})
+        state = trainer.train_on_feed(
+            state,
+            feed,
+            batch_size=8,
+            rng=jax.random.PRNGKey(0),
+            columnar=columnar,
+        )
+        return np.asarray(state.params["w"]), int(state.step)
+
+    w_col, n_col = run(True, True)
+    w_row, n_row = run(False, False)
+    assert n_col == n_row == 6
+    np.testing.assert_allclose(w_col, w_row, rtol=1e-6)
+
+
+def test_pack_columnar_rejects_mixed_types_and_keeps_list_rows():
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    # int/float mix must NOT silently promote (exact-int labels)
+    assert pack_columnar([(1, 0), (2.5, 1)]) is None
+    # list rows come back as lists through the compat path
+    blk = pack_columnar([[1, 2], [3, 4]])
+    rows = blk.rows()
+    assert rows == [[1, 2], [3, 4]]
+    assert all(isinstance(r, list) for r in rows)
+
+
+def test_next_arrays_dict_rows_input_mapping(mgr):
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    rows = [{"a": np.float32(i), "b": np.float32(10 + i), "junk": np.float32(0)}
+            for i in range(4)]
+    _feed(mgr, [pack_columnar(rows), None])
+    feed = DataFeed(mgr, input_mapping={"a": "inp", "b": "label"})
+    cols, n = feed.next_arrays(4)
+    assert n == 4 and set(cols) == {"a", "b"}  # selected + ordered
+    np.testing.assert_array_equal(cols["a"], [0, 1, 2, 3])
