@@ -97,9 +97,9 @@ def compute_bench(model_name="resnet56"):
 
     if model_name == "resnet50":
         img, nclass = 224, 1000
-        batch = 64 if on_accel else 8
+        batch = 128 if on_accel else 8
         timed = 100 if on_accel else 2
-        K = 10 if on_accel else 2
+        K = 50 if on_accel else 2
         model = resnet.ResNet50(
             num_classes=nclass, dtype="bfloat16" if on_accel else "float32"
         )
@@ -156,17 +156,26 @@ def compute_bench(model_name="resnet56"):
     ]
     rngs = jax.random.split(jax.random.PRNGKey(0), K)
 
-    for i in range(2):  # compile + settle
-        state, metrics = trainer.multi_step(state, stacked[i % 2], rngs)
-    jax.block_until_ready(metrics["loss"])
-
-    # FLOPs of the exact compiled K-step program (fwd+bwd+update)
+    # Device-resident synthetic batches (the reference's own synthetic
+    # benchmark pattern, examples/resnet/common.py:315-363): the timed
+    # region measures CHIP training throughput; host->HBM feeding is
+    # measured separately (spark_feed) and by the e2e examples.
     from tensorflowonspark_tpu.parallel import sharding as sh
 
-    device_batch = sh.shard_batch(
-        stacked[0], mesh, trainer.data_axes, leading_dims=1
+    device_stacked = [
+        sh.shard_batch(s, mesh, trainer.data_axes, leading_dims=1)
+        for s in stacked
+    ]
+    for i in range(2):  # compile + settle
+        state, metrics = trainer.multi_step_on_device(
+            state, device_stacked[i % 2], rngs
+        )
+    float(metrics["loss"][-1])  # definitive device sync (see note below)
+
+    # FLOPs of the exact compiled K-step program (fwd+bwd+update)
+    group_flops = _step_flops(
+        trainer._multi_fn, state, device_stacked[0], rngs
     )
-    group_flops = _step_flops(trainer._multi_fn, state, device_batch, rngs)
 
     # three measurement windows, best sustained reported (tunnel/host
     # jitter between the driver and the chip dominates run-to-run noise)
@@ -174,8 +183,15 @@ def compute_bench(model_name="resnet56"):
     for _ in range(3 if on_accel else 1):
         t0 = time.perf_counter()
         for i in range(rounds):
-            state, metrics = trainer.multi_step(state, stacked[i % 2], rngs)
-        jax.block_until_ready(metrics["loss"])
+            state, metrics = trainer.multi_step_on_device(
+                state, device_stacked[i % 2], rngs
+            )
+        # scalar pull, NOT jax.block_until_ready: on the tunneled axon
+        # platform block_until_ready can return before execution
+        # finishes (observed: a 23s window reported as 0.02s), which
+        # would inflate every number here.  Pulling the last loss to
+        # host forces the full dependency chain for real.
+        float(metrics["loss"][-1])
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
     dt = best_dt
@@ -216,6 +232,111 @@ def compute_bench(model_name="resnet56"):
     out["vs_baseline"] = round(img_per_sec / baseline_img_s, 4)
     print(
         "platform=%s batch=%d steps=%d wall=%.3fs" % (platform, batch, timed, dt),
+        file=sys.stderr,
+    )
+    return out
+
+
+def transformer_bench():
+    """Flagship long-context LM: decoder-only Transformer with the
+    pallas flash-attention kernel, bf16, seq 2048.  Reports tokens/s,
+    achieved TFLOP/s and MFU (PaLM-style accounting: 6*N_params +
+    12*L*H*Dh*S FLOPs per trained token), and vs_baseline against an
+    A100 running the same model at the ~50% MFU large-LM training
+    systems (Megatron-class) publish."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.models import transformer as tr
+    from tensorflowonspark_tpu.parallel import dp
+    from tensorflowonspark_tpu.parallel.mesh import build_mesh
+
+    platform = jax.devices()[0].platform
+    on_accel = platform in ("tpu", "gpu")
+    if on_accel:
+        L, H, Dh, Dm, Dff, V, S, B = 16, 16, 64, 1024, 4096, 32000, 2048, 8
+        timed, K = 40, 4
+        impl = "flash"
+    else:
+        L, H, Dh, Dm, Dff, V, S, B = 2, 4, 16, 64, 128, 256, 128, 4
+        timed, K = 2, 2
+        impl = "dot"
+
+    cfg = tr.TransformerConfig(
+        vocab_size=V, num_layers=L, num_heads=H, head_dim=Dh,
+        embed_dim=Dm, mlp_dim=Dff, max_seq_len=S,
+        dtype="bfloat16" if on_accel else "float32",
+        attention_impl=impl, remat=on_accel,
+    )
+    model = tr.Transformer(cfg)
+    tokens0 = jnp.zeros((1, S), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+    n_params = sum(
+        int(np.prod(x.shape)) for x in jax.tree.leaves(params)
+    )
+
+    trainer = dp.SyncTrainer(
+        tr.loss_fn(model), optax.adamw(1e-4), mesh=build_mesh()
+    )
+    state = trainer.create_state(params)
+
+    rng_np = np.random.RandomState(0)
+    stacked = {
+        "tokens": rng_np.randint(0, V, size=(K, B, S)).astype(np.int32)
+    }
+    rngs = jax.random.split(jax.random.PRNGKey(0), K)
+    from tensorflowonspark_tpu.parallel import sharding as sh
+
+    device_stacked = sh.shard_batch(
+        stacked, trainer.mesh, trainer.data_axes, leading_dims=1
+    )
+    for _ in range(2):
+        state, metrics = trainer.multi_step_on_device(
+            state, device_stacked, rngs
+        )
+    float(metrics["loss"][-1])  # definitive device sync
+
+    rounds = max(1, timed // K)
+    best_dt = None
+    for _ in range(3 if on_accel else 1):
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            state, metrics = trainer.multi_step_on_device(
+                state, device_stacked, rngs
+            )
+        float(metrics["loss"][-1])  # scalar pull: see compute_bench note
+        dt = time.perf_counter() - t0
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    steps = rounds * K
+    tokens_per_sec = steps * B * S / best_dt
+
+    flops_per_token = 6.0 * n_params + 12.0 * L * H * Dh * S
+    achieved = tokens_per_sec * flops_per_token
+    out = {
+        "metric": "transformer_lm_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "platform": platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", ""),
+        "model": "L%d H%d Dm%d S%d (%.0fM params, %s attention)"
+        % (L, H, Dm, S, n_params / 1e6, impl),
+        "flops_per_token_gflop": round(flops_per_token / 1e9, 3),
+        "tflops_per_sec": round(achieved / 1e12, 2),
+        "baseline_source": (
+            "A100 at the ~50% MFU Megatron-class LM systems publish: "
+            "156 TFLOP/s effective"
+        ),
+    }
+    peak = _peak_flops(jax.devices()[0])
+    if peak:
+        out["mfu"] = round(achieved / peak, 4)
+    baseline_tps = 0.5 * A100_PEAK_FLOPS / flops_per_token
+    out["baseline_tokens_per_sec"] = round(baseline_tps, 1)
+    out["vs_baseline"] = round(tokens_per_sec / baseline_tps, 4)
+    print(
+        "transformer: %d steps of B%dxS%d in %.2fs" % (steps, B, S, best_dt),
         file=sys.stderr,
     )
     return out
@@ -281,7 +402,7 @@ def _feed_main_fun(args, ctx):
         np.zeros((FEED_SPE, FEED_BATCH), np.int64),
     )
     state, m = trainer.multi_step(state, stacked, wk)
-    jax.block_until_ready(m["loss"])
+    float(m["loss"][-1])  # definitive device sync
 
     # exact step budget: the feeder ships FEED_ROWS rows and the consumer
     # stops at max_steps rather than blocking for a never-coming short
@@ -436,5 +557,7 @@ if __name__ == "__main__":
         feed_worker()
     elif "resnet50" in sys.argv:
         main_with_retry(model_name="resnet50", with_feed=False)
+    elif "transformer" in sys.argv:
+        print(json.dumps(transformer_bench()))
     else:
         main_with_retry()

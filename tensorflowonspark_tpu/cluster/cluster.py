@@ -179,12 +179,15 @@ class TPUCluster(object):
             self.cluster_info, self.cluster_meta, feed_timeout, qname
         )
         server = self.server
+        engine = self.engine
 
         def _each_rdd(rdd):
             if server.stop_requested:
                 logger.info("stop requested; skipping stream micro-batch")
                 return
-            rdd.foreachPartition(feed_fn)
+            # through the engine so DataFrame micro-batches normalize
+            # and engine-side feed instrumentation applies
+            engine.run_data_job(feed_fn, rdd)
 
         dstream.foreachRDD(_each_rdd)
 

@@ -88,15 +88,21 @@ class ColumnarBlock(Marker):
 
 def _column_array(values):
     """Stack one column; ``None`` unless all elements share one Python
-    type and the result is a non-object array (mixed int/float rows
-    must NOT silently promote — an exact int delivered as 1.0 through
-    the row-compat path corrupts label/index semantics)."""
+    type (and, for array elements, one dtype) and the result is a
+    non-object array — mixed int/float rows must NOT silently promote:
+    an exact int delivered as 1.0 through the row-compat path corrupts
+    label/index semantics."""
     import numpy as np
 
     t0 = type(values[0])
     for v in values:
         if type(v) is not t0:
             return None
+    if isinstance(values[0], np.ndarray):
+        d0 = values[0].dtype
+        for v in values:
+            if v.dtype != d0:
+                return None
     arr = np.asarray(values)
     if arr.dtype == object:
         return None

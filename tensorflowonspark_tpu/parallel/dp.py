@@ -176,6 +176,18 @@ class SyncTrainer(object):
           rngs: ``[K, 2]`` stacked PRNG keys.
         Returns ``(state, metrics)`` with metrics stacked ``[K]``.
         """
+        device_batch = sh.shard_batch(
+            stacked_batch, self.mesh, self.data_axes, leading_dims=1
+        )
+        return self.multi_step_on_device(state, device_batch, rngs)
+
+    def multi_step_on_device(self, state, device_stacked, rngs):
+        """K fused steps on an already device-resident ``[K, ...]``
+        stack (the primitive :meth:`multi_step` calls after placing the
+        host batch; place yours once with
+        :func:`~tensorflowonspark_tpu.parallel.sharding.shard_batch`
+        at ``leading_dims=1``).  The benchmarking/high-throughput path:
+        no host→device transfer inside the loop."""
         if self._multi_fn is None:
             step_fn = self._step_fn
 
@@ -187,10 +199,7 @@ class SyncTrainer(object):
                 return jax.lax.scan(body, state, (batches, rngs))
 
             self._multi_fn = jax.jit(multi, donate_argnums=(0,))
-        device_batch = sh.shard_batch(
-            stacked_batch, self.mesh, self.data_axes, leading_dims=1
-        )
-        return self._multi_fn(state, device_batch, rngs)
+        return self._multi_fn(state, device_stacked, rngs)
 
     def step_on_device(self, state, device_batch, rng):
         """One step on an already device-resident (sharded) batch.
@@ -227,7 +236,7 @@ class SyncTrainer(object):
         log_every=100,
         steps_per_execution=1,
         metrics_callback=None,
-        columnar=None,
+        columnar=False,
     ):
         """Run the synchronized feed loop: pull batches from a
         :class:`~tensorflowonspark_tpu.data.feed.DataFeed`, stop globally
@@ -246,9 +255,10 @@ class SyncTrainer(object):
             its last step — losses are global (psum over the mesh), so
             every host observes identical values.
           columnar: consume via ``feed.next_arrays`` (zero per-row
-            Python; requires fixed-shape numeric rows).  Default: auto —
-            columnar when no ``preprocess`` is given, since the batch
-            pytree is then identical to the row path's stacking.
+            Python, ~4x the row path's throughput; requires fixed-shape
+            homogeneous numeric rows — ``next_arrays`` raises on object
+            rows).  Default False: the row path accepts anything, so
+            opting in is an explicit contract with your data.
         Returns the final state.
         """
         if steps_per_execution < 1:
@@ -258,8 +268,7 @@ class SyncTrainer(object):
                 )
             )
         rng = rng if rng is not None else jax.random.PRNGKey(0)
-        if columnar is None:
-            columnar = preprocess is None
+        columnar = bool(columnar)
         steps = 0
         stop = False
         while not stop:

@@ -367,3 +367,17 @@ def test_next_arrays_dict_rows_input_mapping(mgr):
     cols, n = feed.next_arrays(4)
     assert n == 4 and set(cols) == {"a", "b"}  # selected + ordered
     np.testing.assert_array_equal(cols["a"], [0, 1, 2, 3])
+
+
+def test_pack_columnar_rejects_mixed_array_dtypes():
+    from tensorflowonspark_tpu.cluster.marker import pack_columnar
+
+    # ndarray elements with differing dtypes must NOT silently promote
+    assert pack_columnar(
+        [(np.array([1, 2]),), (np.array([1.5, 2.5]),)]
+    ) is None
+    # same dtype packs fine
+    blk = pack_columnar(
+        [(np.array([1, 2]),), (np.array([3, 4]),)]
+    )
+    assert blk is not None and blk.columns[0].dtype == np.int64

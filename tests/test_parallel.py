@@ -240,3 +240,44 @@ class TestGlobalStop:
         rows = [(1, 2.0), (3, 4.0)]
         batch = dp._default_batch(rows)
         assert batch[0].tolist() == [1, 3]
+
+
+def test_multi_step_on_device_matches_multi_step():
+    # the device-resident benchmarking path must be numerically
+    # identical to multi_step (which places host batches itself)
+    import jax
+    import numpy as np
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp, sharding as sh
+
+    def loss(params, batch, rng):
+        import jax.numpy as jnp
+
+        x, y = batch
+        return jnp.mean((jnp.dot(x, params["w"]) - y) ** 2)
+
+    rng_np = np.random.RandomState(0)
+    K = 3
+    stacked = (
+        rng_np.rand(K, 8, 4).astype(np.float32),
+        rng_np.rand(K, 8).astype(np.float32),
+    )
+    rngs = jax.random.split(jax.random.PRNGKey(0), K)
+
+    def run(on_device):
+        trainer = dp.SyncTrainer(loss, optax.adam(0.05))
+        state = trainer.create_state({"w": np.zeros(4, np.float32)})
+        if on_device:
+            dev = sh.shard_batch(
+                stacked, trainer.mesh, trainer.data_axes, leading_dims=1
+            )
+            state, m = trainer.multi_step_on_device(state, dev, rngs)
+        else:
+            state, m = trainer.multi_step(state, stacked, rngs)
+        return np.asarray(state.params["w"]), np.asarray(m["loss"])
+
+    w_host, l_host = run(False)
+    w_dev, l_dev = run(True)
+    np.testing.assert_allclose(w_host, w_dev, rtol=1e-6)
+    np.testing.assert_allclose(l_host, l_dev, rtol=1e-6)
