@@ -185,9 +185,14 @@ class TPUCluster(object):
             if server.stop_requested:
                 logger.info("stop requested; skipping stream micro-batch")
                 return
-            # through the engine so DataFrame micro-batches normalize
-            # and engine-side feed instrumentation applies
-            engine.run_data_job(feed_fn, rdd)
+            if engine.is_native_dataset(rdd):
+                # through the engine so DataFrame micro-batches
+                # normalize and engine-side instrumentation applies
+                engine.run_data_job(feed_fn, rdd)
+            else:
+                # duck-typed RDD on an engine without a native dataset
+                # type (e.g. LocalEngine tests)
+                rdd.foreachPartition(feed_fn)
 
         dstream.foreachRDD(_each_rdd)
 

@@ -231,3 +231,36 @@ def test_serving_builder_guards():
     predict = transformer.serving_builder(jax.tree.map(np.asarray, tp), cfg)
     out = predict({"tokens": np.zeros((2, 8), np.int64)})
     assert out["logits"].shape == (2, 8, 32)
+
+
+def test_resnet50_s2d_stem_exact_equivalence():
+    # space-to-depth stem == conv7x7/s2 stem exactly, via the kernel
+    # transform (the MXU-friendly MLPerf stem; models/resnet.py)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorflowonspark_tpu.models import resnet
+
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    m7 = resnet.ResNet50(
+        num_classes=10, dtype="float32", stage_sizes=(1,), stem="conv7"
+    )
+    ms = resnet.ResNet50(
+        num_classes=10, dtype="float32", stage_sizes=(1,), stem="s2d"
+    )
+    v7 = m7.init(jax.random.PRNGKey(0), x)
+    p7 = dict(v7["params"])
+    ps = dict(p7)
+    ps["stem_conv"] = {
+        "kernel": resnet.conv7_to_s2d_kernel(p7["stem_conv"]["kernel"])
+    }
+    out7 = m7.apply(
+        {"params": p7, "batch_stats": v7["batch_stats"]}, x, train=False
+    )
+    outs = ms.apply(
+        {"params": ps, "batch_stats": v7["batch_stats"]}, x, train=False
+    )
+    np.testing.assert_allclose(
+        np.asarray(out7), np.asarray(outs), atol=1e-5
+    )
