@@ -2,16 +2,17 @@
 
 Workloads
 ---------
-- default (``python bench.py``): the reference's headline benchmark —
-  ResNet56 on CIFAR-10-shaped synthetic data at batch 128 (reference
-  defaults: examples/resnet/resnet_cifar_dist.py:33-35; measurement
-  machinery modeled on the reference's TimeHistory/build_stats
-  ``exp_per_second``, examples/resnet/common.py:175-246) — plus an
-  end-to-end InputMode.SPARK feed benchmark (mnist-class model trained
-  through LocalEngine + DataFeed, queue and shm-ring modes), closing
+- default (``python bench.py``): ResNet50 at 224px — the reference's
+  ImageNet example (examples/resnet/resnet_imagenet_main.py) and the
+  workload with a directly comparable PUBLISHED A100 number
+  (measurement machinery modeled on the reference's
+  TimeHistory/build_stats ``exp_per_second``,
+  examples/resnet/common.py:175-246) — plus an end-to-end
+  InputMode.SPARK feed benchmark (mnist-class model trained through
+  LocalEngine + DataFeed, queue and shm-ring modes), closing
   BASELINE.md's "examples/mnist steps/sec (InputMode.SPARK)" row.
-- ``python bench.py resnet50``: ResNet50 at 224px (the reference's
-  ImageNet example, examples/resnet/resnet_imagenet_main.py).
+- ``python bench.py resnet56``: the reference's CIFAR example
+  (examples/resnet/resnet_cifar_dist.py defaults, batch 128).
 - ``python bench.py --feed-worker``: internal — the feed benchmark
   subprocess (runs before the parent touches the accelerator so the
   compute process can own the chip).
@@ -426,6 +427,11 @@ def _feed_main_fun(args, ctx):
     # stops at max_steps rather than blocking for a never-coming short
     # batch (the end-of-feed sentinel only arrives at shutdown)
     max_steps = FEED_ROWS // FEED_BATCH
+    # Timing: dispatches stay pipelined (no per-group sync — that
+    # would serialize feed against compute), completion is forced by
+    # pulling a param scalar AFTER the loop (dispatch returns long
+    # before execution on the tunneled platform), and the feed
+    # terminate/drain runs after the clock stops.
     t0 = time.monotonic()
     state = trainer.train_on_feed(
         state,
@@ -436,7 +442,9 @@ def _feed_main_fun(args, ctx):
         max_steps=max_steps,
         log_every=0,
         columnar=True,
+        terminate_on_max_steps=False,
     )
+    float(jnp.ravel(jax.tree.leaves(state.params)[0])[0])  # completion
     dt = time.monotonic() - t0
     steps = int(state.step) - 1 - FEED_SPE  # minus warmup steps
     ctx.mgr.set("feed_bench", {"wall": dt, "steps": steps})
@@ -543,7 +551,7 @@ def run_feed_bench():
         return None
 
 
-def main(model_name="resnet56", with_feed=True):
+def main(model_name="resnet50", with_feed=True):
     feed = run_feed_bench() if with_feed else None
     out = compute_bench(model_name)
     if feed:
@@ -551,14 +559,14 @@ def main(model_name="resnet56", with_feed=True):
     print(json.dumps(out))
 
 
-def main_with_retry(attempts=3, **kw):
-    """The driver's record depends on this one invocation; the tunneled
-    chip occasionally throws transient RPC/compile errors (HTTP 500
-    from remote_compile), so retry before giving up."""
+def with_retry(fn, attempts=3):
+    """The driver's record depends on one invocation; the tunneled chip
+    occasionally throws transient RPC/compile errors (HTTP 500 from
+    remote_compile), so retry before giving up."""
     last = None
     for i in range(attempts):
         try:
-            return main(**kw)
+            return fn()
         except Exception as e:  # noqa: BLE001 - retry boundary
             last = e
             print(
@@ -570,25 +578,18 @@ def main_with_retry(attempts=3, **kw):
     raise last
 
 
+def main_with_retry(attempts=3, **kw):
+    return with_retry(lambda: main(**kw), attempts)
+
+
 if __name__ == "__main__":
     if "--feed-worker" in sys.argv:
         feed_worker()
+    elif "resnet56" in sys.argv:
+        main_with_retry(model_name="resnet56", with_feed=False)
     elif "resnet50" in sys.argv:
         main_with_retry(model_name="resnet50", with_feed=False)
     elif "transformer" in sys.argv:
-        last = None
-        for i in range(3):  # same transient-tunnel retry as the others
-            try:
-                print(json.dumps(transformer_bench()))
-                break
-            except Exception as e:  # noqa: BLE001 - retry boundary
-                last = e
-                print(
-                    "transformer bench attempt %d/3 failed: %s" % (i + 1, e),
-                    file=sys.stderr,
-                )
-                if i == 2:
-                    raise
-                time.sleep(5)
+        print(json.dumps(with_retry(transformer_bench)))
     else:
         main_with_retry()

@@ -51,10 +51,16 @@ def main_fun(args, ctx):
 
     feed = ctx.get_data_feed(train_mode=True)
 
-    def preprocess(rows):
-        images = np.stack([np.asarray(r[0], np.float32) for r in rows])
-        labels = np.asarray([int(np.ravel(r[1])[0]) for r in rows], np.int64)
-        return {"image": images, "label": labels}
+    # columnar mode: the feeder ships stacked numpy columns
+    # (ColumnarBlock) and preprocess receives (images, labels) arrays —
+    # no per-row Python anywhere on the consume path (~4x the row-mode
+    # data-plane throughput; see data/feed.py next_arrays)
+    def preprocess(cols):
+        images, labels = cols
+        return {
+            "image": np.asarray(images, np.float32),
+            "label": np.asarray(labels, np.int64).reshape(-1),
+        }
 
     state = trainer.train_on_feed(
         state,
@@ -63,6 +69,7 @@ def main_fun(args, ctx):
         preprocess=preprocess,
         max_steps=args.steps,
         log_every=10,
+        columnar=True,
     )
 
     if ctx.job_name in ("chief", "master") or (

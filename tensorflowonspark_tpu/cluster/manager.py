@@ -122,14 +122,22 @@ def drain(q, timeout=0):
     terminate-side drain).
 
     Args:
-      timeout: seconds to keep blocking for in-flight puts before
-        declaring the queue dry (``DataFeed.terminate`` uses 5 so racing
-        feeder tasks drain too; 0 = non-blocking sweep).
+      timeout: overall budget to keep absorbing *racing* in-flight puts
+        (``DataFeed.terminate`` uses 5 so concurrent feeder tasks drain
+        too; 0 = non-blocking sweep).  A queue that stays quiet for 2s
+        is declared dry — an already-empty queue costs ~2s, not the
+        full budget, while a feeder pickling a large block between
+        puts still gets a realistic gap tolerance.
     """
+    import time as _time
+
     count = 0
+    deadline = _time.monotonic() + timeout
     while True:
+        remaining = deadline - _time.monotonic()
+        grace = min(2.0, max(0.0, remaining)) if timeout else 0.0
         try:
-            q.get(block=timeout > 0, timeout=timeout or None)
+            q.get(block=grace > 0, timeout=grace or None)
             q.task_done()
             count += 1
         except _queue_mod.Empty:

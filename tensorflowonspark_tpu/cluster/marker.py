@@ -98,17 +98,15 @@ def _column_array(values):
     for v in values:
         if type(v) is not t0:
             return None
+    if isinstance(values[0], (list, tuple)):
+        # convert ONCE, then dtype-check the arrays (np.asarray of the
+        # raw nested lists would both promote mixed int/float columns
+        # silently and pay a second full conversion)
+        values = [np.asarray(v) for v in values]
     if isinstance(values[0], np.ndarray):
         d0 = values[0].dtype
         for v in values:
             if v.dtype != d0:
-                return None
-    elif isinstance(values[0], (list, tuple)):
-        # list/tuple elements: per-element dtype must agree too, or
-        # np.asarray promotes ([1,2] next to [1.5,2.5] -> float64)
-        d0 = np.asarray(values[0]).dtype
-        for v in values:
-            if np.asarray(v).dtype != d0:
                 return None
     arr = np.asarray(values)
     if arr.dtype == object:

@@ -161,6 +161,10 @@ class TFRecordReader(object):
         self._lib = None if fs_utils.is_remote(self.path) else _load_native()
         if self._lib is not None:
             local = fs_utils.local_path(self.path)
+            if not os.path.exists(local):
+                # match builtin open()'s error class — callers catch
+                # FileNotFoundError to fall back to synthetic data
+                raise FileNotFoundError(local)
             self._h = self._lib.tfr_reader_open(local.encode())
             if not self._h:
                 raise IOError("cannot open {0}".format(path))

@@ -59,7 +59,7 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
 
 
 def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
-              seq_axis="seq", block_q=512, block_k=512):
+              seq_axis="seq", block_q=1024, block_k=1024):
     """Dispatch to an attention implementation (see module docstring).
 
     ``ring``/``ulysses`` dispatch on ``mesh``: with ``mesh=None`` the

@@ -25,7 +25,7 @@ from tensorflowonspark_tpu.ops.attention import dot_attention
 
 
 def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
-                      local_impl="dot", block_q=512, block_k=512):
+                      local_impl="dot", block_q=1024, block_k=1024):
     """Attention over sequence shards; call under ``shard_map``.
 
     Args:

@@ -237,6 +237,7 @@ class SyncTrainer(object):
         steps_per_execution=1,
         metrics_callback=None,
         columnar=False,
+        terminate_on_max_steps=True,
     ):
         """Run the synchronized feed loop: pull batches from a
         :class:`~tensorflowonspark_tpu.data.feed.DataFeed`, stop globally
@@ -259,6 +260,12 @@ class SyncTrainer(object):
             homogeneous numeric rows — ``next_arrays`` raises on object
             rows).  Default False: the row path accepts anything, so
             opting in is an explicit contract with your data.
+          terminate_on_max_steps: when the step cap ends training with
+            data still in flight, terminate the feed (drain + mark the
+            node 'terminating' — the reference's StopFeedHook contract)
+            so the feeder's ``queue.join()`` doesn't block until
+            feed_timeout.  Pass False for incremental training that
+            resumes consuming from the same feed.
         Returns the final state.
         """
         if steps_per_execution < 1:
@@ -325,6 +332,20 @@ class SyncTrainer(object):
                 logger.info(
                     "step %d loss %.4f", steps, float(metrics["loss"])
                 )
+        if (
+            terminate_on_max_steps
+            and max_steps is not None
+            and steps >= max_steps
+            and not feed.should_stop()
+        ):
+            # A step cap ended training with data still in flight: the
+            # feeder would block on queue.join() until feed_timeout.
+            # Terminate the feed — drain leftovers, mark the node
+            # 'terminating' so later feed tasks skip (the reference's
+            # StopFeedHook contract, reference:
+            # examples/mnist/estimator/mnist_spark.py:16-24).
+            logger.info("max_steps reached; terminating the feed")
+            feed.terminate()
         return state
 
 
