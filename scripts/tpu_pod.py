@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""TPU pod bring-up — the deployment-tooling role of the reference's
+``scripts/spark_ec2.py`` (launch a cluster, wire the nodes together,
+run a workload), re-targeted at Cloud TPU pod slices.
+
+The reference script provisioned EC2 instances and started a Spark
+master + workers on them (reference: scripts/spark_ec2.py — cluster
+launch, security groups, master/worker bootstrap).  The TPU analogue is
+smaller because the substrate does more: a TPU pod slice is already a
+named group of hosts with ICI between chips, and ``jax.distributed``
+handles rendezvous from one coordinator address, so "bring-up" is:
+
+1. ``create``  — provision the slice (one ``gcloud compute tpus tpu-vm
+   create``);
+2. ``bootstrap`` — install this framework on every host (``gcloud ...
+   ssh --worker=all``);
+3. ``run``     — execute a script on every host with the rendezvous
+   environment exported (coordinator = worker 0, process id = worker
+   index); the in-framework ``parallel.mesh.distributed_init_from_env``
+   (called by every ``build_mesh``) reads exactly these variables;
+4. ``delete``  — tear the slice down.
+
+Every subcommand supports ``--dry-run``: print the fully rendered
+commands without executing anything (also what the unit tests assert
+on — this repo's CI has no GCP credentials, the same posture as the
+reference which never ran spark_ec2 in CI).
+
+Example:
+
+    python scripts/tpu_pod.py create  --name tfos-pod --zone us-east5-a \\
+        --accelerator v5litepod-16 --version v2-alpha-tpuv5-lite
+    python scripts/tpu_pod.py bootstrap --name tfos-pod --zone us-east5-a \\
+        --repo https://github.com/you/tensorflowonspark-tpu
+    python scripts/tpu_pod.py run     --name tfos-pod --zone us-east5-a \\
+        -- python examples/mnist/mnist_spark.py --cluster_size 4
+    python scripts/tpu_pod.py delete  --name tfos-pod --zone us-east5-a
+"""
+
+import argparse
+import dataclasses
+import shlex
+import subprocess
+import sys
+
+#: coordinator port for jax.distributed rendezvous (any free port; one
+#: constant so `run` and the in-framework bootstrap agree)
+COORDINATOR_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class PodConfig:
+    """One pod slice (the spark_ec2 'cluster name + instance type' pair)."""
+
+    name: str
+    zone: str
+    accelerator: str = "v5litepod-16"
+    version: str = "v2-alpha-tpuv5-lite"
+    project: str = None  # gcloud default when None
+
+
+def _gcloud_base(cfg):
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
+    return cmd
+
+
+def _common_flags(cfg):
+    flags = ["--zone", cfg.zone]
+    if cfg.project:
+        flags += ["--project", cfg.project]
+    return flags
+
+
+def render_create(cfg):
+    """The `launch_cluster` role (reference: spark_ec2.py launch path)."""
+    return [
+        _gcloud_base(cfg)
+        + ["create", cfg.name]
+        + _common_flags(cfg)
+        + [
+            "--accelerator-type", cfg.accelerator,
+            "--version", cfg.version,
+        ]
+    ]
+
+
+def render_delete(cfg):
+    return [
+        _gcloud_base(cfg)
+        + ["delete", cfg.name]
+        + _common_flags(cfg)
+        + ["--quiet"]
+    ]
+
+
+def render_ssh_all(cfg, remote_command):
+    """One command fanned out to every host of the slice
+    (``--worker=all`` is gcloud's per-host fan-out; the reference
+    looped ssh over instances, spark_ec2.py deploy path)."""
+    return [
+        _gcloud_base(cfg)
+        + ["ssh", cfg.name]
+        + _common_flags(cfg)
+        + ["--worker=all", "--command", remote_command]
+    ]
+
+
+def render_bootstrap(cfg, repo, ref="main"):
+    """Install the framework on every host (the setup-and-deploy role
+    of the reference's deploy.generic templates)."""
+    script = " && ".join(
+        [
+            "sudo apt-get -y install git || true",
+            "rm -rf ~/tfos-tpu",
+            "git clone --depth 1 -b {0} {1} ~/tfos-tpu".format(
+                shlex.quote(ref), shlex.quote(repo)
+            ),
+            "pip install -e ~/tfos-tpu",
+            "make -C ~/tfos-tpu/native",
+        ]
+    )
+    return render_ssh_all(cfg, script)
+
+
+def render_run(cfg, argv, workdir="~/tfos-tpu"):
+    """Run ``argv`` on every host with the rendezvous env exported.
+
+    TPU VMs expose the slice topology through instance metadata; worker
+    0's address is the coordinator.  The exported variables are exactly
+    what ``jax.distributed.initialize`` (and this framework's
+    ``parallel/mesh.py`` bootstrap) consume: coordinator address plus
+    num_processes/process_id, which JAX's TPU backend can also infer
+    from the metadata server — they are exported explicitly so the same
+    command works on CPU hosts in tests.
+    """
+    inner = " ".join(shlex.quote(a) for a in argv)
+    script = " && ".join(
+        [
+            # worker 0's internal IP from the slice metadata
+            'COORD=$(curl -s -H "Metadata-Flavor: Google" '
+            '"http://metadata.google.internal/computeMetadata/v1/instance/'
+            'attributes/worker-network-endpoints" | cut -d, -f1 | '
+            "cut -d: -f3)",
+            'WID=$(curl -s -H "Metadata-Flavor: Google" '
+            '"http://metadata.google.internal/computeMetadata/v1/instance/'
+            'attributes/agent-worker-number")',
+            "cd {0}".format(workdir),
+            "TFOS_COORDINATOR=$COORD:{0} TFOS_PROCESS_ID=$WID {1}".format(
+                COORDINATOR_PORT, inner
+            ),
+        ]
+    )
+    return render_ssh_all(cfg, script)
+
+
+def _execute(commands, dry_run):
+    rendered = [" ".join(shlex.quote(c) for c in cmd) for cmd in commands]
+    for line in rendered:
+        print(line)
+    if dry_run:
+        return 0
+    rc = 0
+    for cmd in commands:
+        rc = subprocess.call(cmd)
+        if rc != 0:
+            break
+    return rc
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "action", choices=["create", "bootstrap", "run", "delete"]
+    )
+    parser.add_argument("--name", required=True)
+    parser.add_argument("--zone", required=True)
+    parser.add_argument("--accelerator", default="v5litepod-16")
+    parser.add_argument("--version", default="v2-alpha-tpuv5-lite")
+    parser.add_argument("--project", default=None)
+    parser.add_argument("--repo", help="git URL for bootstrap")
+    parser.add_argument("--ref", default="main", help="git ref for bootstrap")
+    parser.add_argument(
+        "--dry-run", action="store_true",
+        help="print the rendered gcloud commands without executing",
+    )
+    # `run` takes the per-host command after `--`; argparse.REMAINDER
+    # would swallow the option flags too, so collect leftovers instead
+    args, extra = parser.parse_known_args(argv)
+    args.command = extra
+
+    cfg = PodConfig(
+        name=args.name, zone=args.zone, accelerator=args.accelerator,
+        version=args.version, project=args.project,
+    )
+    if args.action == "create":
+        cmds = render_create(cfg)
+    elif args.action == "delete":
+        cmds = render_delete(cfg)
+    elif args.action == "bootstrap":
+        if not args.repo:
+            parser.error("bootstrap requires --repo")
+        cmds = render_bootstrap(cfg, args.repo, args.ref)
+    else:  # run
+        argv_rest = args.command
+        if argv_rest and argv_rest[0] == "--":
+            argv_rest = argv_rest[1:]
+        if not argv_rest:
+            parser.error("run requires a command after `--`")
+        cmds = render_run(cfg, argv_rest)
+    return _execute(cmds, args.dry_run)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,7 +116,7 @@ def connect(address, authkey):
     return m
 
 
-def drain(q, timeout=0):
+def drain(q, timeout=0, quiet_gap=2.0):
     """Discard everything currently in a queue, marking each item done so
     ``join()`` callers are released (reference: TFNode.py:316-329
     terminate-side drain).
@@ -124,10 +124,14 @@ def drain(q, timeout=0):
     Args:
       timeout: overall budget to keep absorbing *racing* in-flight puts
         (``DataFeed.terminate`` uses 5 so concurrent feeder tasks drain
-        too; 0 = non-blocking sweep).  A queue that stays quiet for 2s
-        is declared dry — an already-empty queue costs ~2s, not the
-        full budget, while a feeder pickling a large block between
-        puts still gets a realistic gap tolerance.
+        too; 0 = non-blocking sweep).
+      quiet_gap: a queue that stays quiet this long is declared dry —
+        an already-empty queue costs ~quiet_gap, not the full budget.
+        The default tolerates the inter-put gap of a feeder pickling
+        one FEED_BLOCK_SIZE block (well under 1s for the ≤64MB ring /
+        block caps that bound payload size); a feeder that can stall
+        longer between puts should pass a larger gap (up to ``timeout``
+        to restore the block-the-full-budget behavior).
     """
     import time as _time
 
@@ -135,7 +139,7 @@ def drain(q, timeout=0):
     deadline = _time.monotonic() + timeout
     while True:
         remaining = deadline - _time.monotonic()
-        grace = min(2.0, max(0.0, remaining)) if timeout else 0.0
+        grace = min(quiet_gap, max(0.0, remaining)) if timeout else 0.0
         try:
             q.get(block=grace > 0, timeout=grace or None)
             q.task_done()

@@ -122,8 +122,12 @@ def pack_columnar(rows):
     if not rows:
         return None
     first = rows[0]
+    # exact-type checks: tuple/dict SUBCLASSES (namedtuples, pyspark
+    # Rows, OrderedDicts) carry identity — field-name access, _fields —
+    # that columnar stacking would flatten away, so they take the row
+    # Block path unchanged
     try:
-        if isinstance(first, dict):
+        if type(first) is dict:
             keys = list(first)
             cols = {}
             for k in keys:
@@ -132,7 +136,7 @@ def pack_columnar(rows):
                     return None
                 cols[k] = arr
             return ColumnarBlock(cols, len(rows))
-        if isinstance(first, (tuple, list)):
+        if type(first) in (tuple, list):
             width = len(first)
             out = []
             for i in range(width):
@@ -141,8 +145,10 @@ def pack_columnar(rows):
                     return None
                 out.append(arr)
             return ColumnarBlock(
-                tuple(out), len(rows), _list_rows=isinstance(first, list)
+                tuple(out), len(rows), _list_rows=type(first) is list
             )
+        if isinstance(first, (dict, tuple, list)):
+            return None  # subclass of a container type: keep row identity
         arr = _column_array(rows)
         if arr is None:
             return None

@@ -30,6 +30,7 @@ manager over TCP to kill tensorboard, post end-of-feed sentinels, and
 check the error queue (no shutdown job on the executors).
 """
 
+import atexit
 import collections
 import json
 import logging
@@ -226,6 +227,13 @@ def _evict_stale_rings(current_cluster_id):
     _LOCAL_RINGS[:] = kept
 
 
+@atexit.register
+def _unlink_local_rings():
+    """The FINAL run's ring has no successor run to evict it: unlink at
+    executor exit or the resource tracker reports a leaked segment."""
+    _evict_stale_rings(current_cluster_id=object())  # matches nothing
+
+
 _MANAGER_FILE = "tfos_manager.json"
 
 
@@ -264,11 +272,19 @@ def _get_manager(cluster_info, executor_id):
             key = (addr, node["authkey"])
             m = _MANAGER_CONNS.get(key)
             if m is not None:
+                # Bounded liveness probe: BaseManager clients open a
+                # FRESH connection per registered-method call (there is
+                # no persistent socket on the cached object to wedge or
+                # to close on eviction — dropping the reference is the
+                # whole cleanup), so a short-timeout TCP connect to the
+                # server is the right check and cannot block the feed
+                # task for a kernel TCP timeout the way an unbounded
+                # probe RPC could.
                 try:
-                    m.get("state")  # liveness probe (~1ms RPC)
+                    socket.create_connection(addr, timeout=2.0).close()
                     _MANAGER_CONNS.move_to_end(key)
                     return m
-                except Exception:  # noqa: BLE001 - stale: reconnect below
+                except OSError:  # stale/unreachable: reconnect below
                     _MANAGER_CONNS.pop(key, None)
             authkey = bytes.fromhex(node["authkey"])
             m = manager.connect(addr, authkey)
@@ -429,10 +445,21 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
                         "TFOS_SHM_FEED_BYTES", shm_ring.DEFAULT_CAPACITY
                     )
                 )
-                _evict_stale_rings(cluster_meta["id"])
+                # All ring-registry access goes through the MODULE, not
+                # bare globals: this closure ships to the executor by
+                # value (cloudpickle), so its captured globals are
+                # per-function COPIES; appending to the copy would pin
+                # the ring only until this function object is GC'd, and
+                # the segment would vanish mid-run (observed as the r2
+                # BufferError-at-GC + leaked-segment pair).  Module-level
+                # functions like _evict_stale_rings DO pickle by
+                # reference and see the real registry, but routing them
+                # the same way keeps the invariant visible.
+                from tensorflowonspark_tpu.cluster import node as _node
+
+                _node._evict_stale_rings(cluster_meta["id"])
                 ring = shm_ring.ShmRing(ring_name, ring_cap, create=True)
-                # keepalive until a later run evicts it
-                _LOCAL_RINGS.append((cluster_meta["id"], ring))
+                _node._LOCAL_RINGS.append((cluster_meta["id"], ring))
                 mgr.set(
                     "shm_ring", {"name": ring_name, "capacity": ring_cap}
                 )
@@ -716,8 +743,14 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         if block:
             _ship(block)
         # wait for consumption, surfacing compute errors promptly
-        # (reference: TFSparkNode.py:472-483)
-        timeout = feed_timeout
+        # (reference: TFSparkNode.py:472-483).  Wall-clock deadline —
+        # decrementing a counter by the nominal sleep would inflate the
+        # effective feed_timeout by the manager-RPC latency of each
+        # error poll; the error queue is polled at ~1/s (each poll is a
+        # manager RPC, and a 10/s rate per in-flight feed task is real
+        # load at reference scale) while the wakeup stays at 0.1s.
+        deadline = time.monotonic() + feed_timeout
+        next_err_poll = 0.0
         if ring is not None:
             while True:
                 sz = ring.size()
@@ -727,19 +760,21 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     )
                 if sz == 0:
                     break
-                _check_error_queue(mgr, err_q)
+                if time.monotonic() >= next_err_poll:
+                    _check_error_queue(mgr, err_q)
+                    next_err_poll = time.monotonic() + 1.0
                 time.sleep(0.05)
-                timeout -= 0.05
-                if timeout <= 0:
+                if time.monotonic() >= deadline:
                     raise RuntimeError(
                         "timed out waiting for ring consumption "
                         "(feed_timeout exceeded)"
                     )
         joinThr = _JoinWatcher(queue)
         while not joinThr.wait(0.1):
-            _check_error_queue(mgr, err_q)
-            timeout -= 0.1
-            if timeout <= 0:
+            if time.monotonic() >= next_err_poll:
+                _check_error_queue(mgr, err_q)
+                next_err_poll = time.monotonic() + 1.0
+            if time.monotonic() >= deadline:
                 raise RuntimeError(
                     "timed out waiting for consumption of all batches "
                     "(feed_timeout exceeded)"

@@ -356,6 +356,10 @@ class DataFeed(object):
                 ring_count += 1
                 idle_end = _time.monotonic() + 2
             logger.info("terminate() drained %d ring blocks", ring_count)
+            # release this consumer's mapping — a feed outliving its
+            # cluster run must not pin the (unlinked) segment in memory
+            self._ring.close(unlink=False)
+            self._ring = None
         if self._qin is None:
             self._qin = self.mgr.get_queue(self.qname_in)
         count = manager.drain(self._qin, timeout=5)

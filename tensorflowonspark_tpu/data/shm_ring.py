@@ -160,18 +160,37 @@ class ShmRing(object):
         return int(self._lib.shmring_size(self._base()))
 
     def close(self, unlink=None):
-        self._cbase = None  # release the exported-buffer pin
-        gc.collect()  # the pin is freed only once the array is collected
-        try:
-            self.shm.close()
-        except BufferError:
-            # a stray export (e.g. an in-flight ctypes call) still pins
-            # the mapping; it unmaps at process exit — log and move on
-            logger.debug("segment %s still pinned; deferring unmap", self.name)
-        except FileNotFoundError:
-            pass
+        # dropping the last reference releases the from_buffer export
+        # synchronously (refcount); a cycle-trapped array needs a
+        # collection pass first, so retry once behind gc.collect()
+        self._cbase = None
+        for attempt in range(2):
+            try:
+                self.shm.close()
+                break
+            except BufferError:
+                if attempt == 0:
+                    gc.collect()
+                    continue
+                # a stray export (e.g. an in-flight ctypes call) still
+                # pins the mapping; it unmaps at process exit
+                logger.debug(
+                    "segment %s still pinned; deferring unmap", self.name
+                )
+            except FileNotFoundError:
+                break
         if unlink if unlink is not None else self._owner:
             try:
                 self.shm.unlink()
             except FileNotFoundError:
                 pass
+
+    def __del__(self):
+        # a dropped ring must not reach SharedMemory.__del__ with the
+        # ctypes pin alive (member finalization order is arbitrary, so
+        # shm.close() could raise BufferError into stderr) nor leak the
+        # owner's segment registration; close() is idempotent
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter may be tearing down
+            pass

@@ -45,6 +45,18 @@ class TransformerConfig:
     mesh: object = None
     seq_axis: str = "seq"
     remat: bool = False  # jax.checkpoint each block (HBM for FLOPs)
+    #: remat granularity when ``remat`` is set: ``"block"`` recomputes
+    #: the whole block in backward (max HBM savings, ~+1/3 step FLOPs);
+    #: ``"dots"`` saves matmul outputs and recomputes only elementwise
+    #: ops (checkpoint_policies.dots_with_no_batch_dims_saveable) — the
+    #: MXU does no second pass, so MFU stays at the 6N accounting.
+    remat_policy: str = "block"
+    #: one fused [embed -> 3*heads*head_dim] projection instead of three
+    #: separate q/k/v matmuls — fewer, larger MXU calls
+    fused_qkv: bool = False
+    #: pallas flash-attention block shape (attention_impl="flash")
+    block_q: int = 1024
+    block_k: int = 1024
     # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
     # MoE FFN (models/moe.py) in every block
     num_experts: int = 0
@@ -93,9 +105,13 @@ class Attention(nn.Module):
         dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, dtype=cfg.jdtype, name=name
         )
-        q = dense("q", (h, d))(x)
-        k = dense("k", (h, d))(x)
-        v = dense("v", (h, d))(x)
+        if cfg.fused_qkv:
+            qkv = dense("qkv", (3, h, d))(x)  # [B,S,3,H,D]
+            q, k, v = (qkv[..., i, :, :] for i in range(3))
+        else:
+            q = dense("q", (h, d))(x)
+            k = dense("k", (h, d))(x)
+            v = dense("v", (h, d))(x)
         q = rope(q, positions)
         k = rope(k, positions)
         out = attention(
@@ -106,6 +122,8 @@ class Attention(nn.Module):
             causal=True,
             mesh=cfg.mesh,
             seq_axis=cfg.seq_axis,
+            block_q=cfg.block_q,
+            block_k=cfg.block_k,
         )
         return nn.DenseGeneral(
             cfg.embed_dim,
@@ -175,7 +193,17 @@ class Transformer(nn.Module):
         )
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=())
+            policy = None
+            if cfg.remat_policy == "dots":
+                policy = (
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                )
+            elif cfg.remat_policy != "block":
+                raise ValueError(
+                    "remat_policy must be 'block' or 'dots', got %r"
+                    % (cfg.remat_policy,)
+                )
+            block = nn.remat(Block, static_argnums=(), policy=policy)
         for i in range(cfg.num_layers):
             x = block(cfg, name="block_%d" % i)(x, positions)
         x = RMSNorm(name="ln_f")(x)
@@ -191,6 +219,7 @@ class Transformer(nn.Module):
 LOGICAL_AXES_RULES = (
     (r"embedding$", ("vocab", "embed")),
     (r"attn/(q|k|v)/kernel", ("embed", "heads", None)),
+    (r"attn/qkv/kernel", ("embed", None, "heads", None)),
     (r"attn/out/kernel", ("heads", None, "embed")),
     (r"mlp/(wi|wg)/kernel", ("embed", "mlp")),
     (r"mlp/wo/kernel", ("mlp", "embed")),

@@ -81,13 +81,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(relevant)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
-        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
-        v = v_ref[0, 0].astype(jnp.float32)
+        # operands stay in their input dtype (bf16 on TPU): the MXU
+        # multiplies bf16 natively with f32 accumulation via
+        # preferred_element_type — upcasting to f32 first would run the
+        # matmuls at the ~4x slower f32 rate.  Softmax state (m, l, p)
+        # is f32 for stability; p is cast back to the operand dtype for
+        # the PV matmul (FlashAttention-2's mixed-precision recipe).
+        q = q_ref[0, 0]  # [block_q, d]
+        k = k_ref[0, 0]  # [block_k, d]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [block_q, block_k]
+        ) * scale  # [block_q, block_k] f32
         if causal:
             s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
         m_prev = m_scr[:, 0]
@@ -98,7 +104,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_scr[:, 0] = m_new
         l_scr[:, 0] = l_prev * alpha + jnp.sum(p, axis=1)
         acc_scr[...] = acc_scr[...] * alpha[:, None] + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -124,12 +130,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(relevant)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # operand-dtype matmuls (see _fwd_kernel note); p/ds are f32
+        # intermediates cast to the operand dtype at the MXU boundary
+        q = q_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]  # [block_q]
         delta = delta_ref[0, 0, :, 0]
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -143,7 +151,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         )
         ds = p * (dp - delta[:, None]) * scale
         dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -170,10 +178,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(relevant)
     def _compute():
-        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, d]
-        v = v_ref[0, 0].astype(jnp.float32)
-        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, d]
-        do = do_ref[0, 0].astype(jnp.float32)
+        # operand-dtype matmuls (see _fwd_kernel note)
+        k = k_ref[0, 0]  # [block_k, d]
+        v = v_ref[0, 0]
+        q = q_ref[0, 0]  # [block_q, d]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0, :, 0]
         delta = delta_ref[0, 0, :, 0]
         s = jax.lax.dot_general(
@@ -182,9 +191,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         if causal:
             s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k] f32
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
@@ -193,7 +202,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta[:, None]) * scale
         dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 

@@ -56,8 +56,9 @@ def plan(
         #: routed assignments that fit if routing were perfectly
         #: balanced (k per token); >1.0 slack absorbs imbalance
         "slack": (cap * num_experts) / float(k * tokens_per_batch),
-        #: fraction of assignments dropped at worst-case imbalance
-        #: where one expert attracts 2x its balanced share
+        #: fraction of the HOTSPOT EXPERT'S OWN assignments dropped when
+        #: that one expert attracts 2x its balanced share (the global
+        #: dropped fraction is ~this / num_experts for a single hotspot)
         "drop_at_2x_hotspot": max(
             0.0, 1.0 - cap / (2.0 * k * tokens_per_batch / num_experts)
         ),

@@ -47,6 +47,44 @@ CANONICAL_ORDER = (
 )
 
 
+def distributed_init_from_env(environ=None):
+    """``jax.distributed`` bootstrap for pod-launched hosts.
+
+    ``scripts/tpu_pod.py run`` exports ``TFOS_COORDINATOR``
+    (host:port of worker 0) and ``TFOS_PROCESS_ID`` on every host of
+    the slice; this reads them and initializes the process group
+    (num_processes from ``TFOS_NUM_PROCESSES`` when set, otherwise the
+    TPU backend infers it from the slice metadata).  No-op when the
+    variables are absent (single-host runs) or when jax.distributed is
+    already initialized.  The Spark/LocalEngine path wires the same
+    thing from the reservation server instead
+    (``cluster.node.NodeContext.initialize_distributed``).
+
+    Returns True when initialization ran.
+    """
+    import os
+
+    env = os.environ if environ is None else environ
+    coord = env.get("TFOS_COORDINATOR")
+    if not coord:
+        return False
+    import jax
+
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return False
+    kwargs = {"coordinator_address": coord}
+    if env.get("TFOS_PROCESS_ID") is not None:
+        kwargs["process_id"] = int(env["TFOS_PROCESS_ID"])
+    if env.get("TFOS_NUM_PROCESSES") is not None:
+        kwargs["num_processes"] = int(env["TFOS_NUM_PROCESSES"])
+    jax.distributed.initialize(**kwargs)
+    logger.info(
+        "jax.distributed initialized from env: %s process %s",
+        coord, env.get("TFOS_PROCESS_ID"),
+    )
+    return True
+
+
 class MeshSpec(object):
     """Declarative mesh shape: ordered ``(axis_name, size)`` pairs.
 
@@ -113,6 +151,12 @@ def build_mesh(axes=None, devices=None, allow_split_physical=True):
     The device order is delegated to ``jax.experimental.mesh_utils`` so
     ICI-adjacent chips land adjacent on the fastest-varying axes.
     """
+    # Pod-launched hosts (scripts/tpu_pod.py run) carry the rendezvous
+    # in env vars; joining the process group must precede the first
+    # device query, and every program path funnels through build_mesh —
+    # a no-op unless TFOS_COORDINATOR is set and not yet initialized.
+    distributed_init_from_env()
+
     import jax
     from jax.sharding import Mesh
 

@@ -102,21 +102,37 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name="pipe",
     return outs
 
 
-def stack_stage_params(per_layer_params, num_stages):
+def stack_stage_params(per_layer_params, num_stages, interleave=1):
     """Stack an L-element list of per-layer param pytrees into a
     ``[num_stages, L/num_stages, ...]`` pytree (leading stage dim for
-    ``pipe`` sharding, second dim scanned within a stage)."""
+    ``pipe`` sharding, second dim scanned within a stage).
+
+    With ``interleave=v > 1`` (Megatron interleaved schedule) the
+    result is ``[num_stages, v, L/(num_stages*v), ...]``: element
+    ``[d, c]`` holds the layers of *absolute* virtual stage
+    ``c*num_stages + d`` — device d owns chunks ``d, d+P, ...``."""
     n = len(per_layer_params)
-    if n % num_stages != 0:
+    v = interleave
+    if n % (num_stages * v) != 0:
         raise ValueError(
-            "num_layers ({0}) must divide by num_stages ({1})".format(
-                n, num_stages
-            )
+            "num_layers ({0}) must divide by num_stages*interleave "
+            "({1}*{2})".format(n, num_stages, v)
         )
-    per_stage = n // num_stages
+    per_chunk = n // (num_stages * v)
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
+    if v == 1:
+        return jax.tree.map(
+            lambda x: x.reshape((num_stages, per_chunk) + x.shape[1:]),
+            stacked,
+        )
+    # layers of abs chunk a = c*P + d sit at [a*per_chunk : ...]; a
+    # reshape to [v, P, per_chunk] puts chunk a at [c, d] — swap to the
+    # device-major [P, v, per_chunk] the pipe sharding wants
     return jax.tree.map(
-        lambda x: x.reshape((num_stages, per_stage) + x.shape[1:]), stacked
+        lambda x: jnp.swapaxes(
+            x.reshape((v, num_stages, per_chunk) + x.shape[1:]), 0, 1
+        ),
+        stacked,
     )
 
 
@@ -167,21 +183,32 @@ class PipelineTrainer(object):
         axis_name="pipe",
         data_axes=("data", "fsdp"),
         schedule="gpipe",
+        interleave=2,
     ):
         """``schedule``: ``"gpipe"`` (fwd scan + AD backward; activation
-        memory O(M) microbatches/stage) or ``"1f1b"`` (hand-scheduled
+        memory O(M) microbatches/stage), ``"1f1b"`` (hand-scheduled
         PipeDream-flush: same bubble, activation stash bounded at O(P)
         stage *inputs* with the stage forward recomputed in the
         backward unit — the remat trade, ~1.3-1.7x stage FLOPs for
-        M/P x less activation memory; see parallel/pp_schedule.py for
-        the schedule tables and their measured properties)."""
+        M/P x less activation memory), or ``"interleaved"`` (Megatron
+        interleaved 1F1B: each device runs ``interleave`` virtual-stage
+        chunks — ``params["stages"]`` is ``[P, v, L/(P*v), ...]``, see
+        :func:`stack_stage_params` — cutting the bubble fraction by
+        ~1/v; see parallel/pp_schedule.py for the schedule tables and
+        their measured properties).  ``interleave`` is only read for
+        the interleaved schedule."""
         if mesh.shape.get(axis_name, 1) < 2:
             raise ValueError(
                 "PipelineTrainer needs a mesh with a >=2-wide {0!r} axis, "
                 "got {1}".format(axis_name, dict(mesh.shape))
             )
-        if schedule not in ("gpipe", "1f1b"):
+        if schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError("unknown schedule {0!r}".format(schedule))
+        if schedule == "interleaved" and interleave < 2:
+            raise ValueError(
+                "interleaved schedule needs interleave >= 2, got "
+                "{0}".format(interleave)
+            )
         self.layer_fn = layer_fn
         self.first_stage_fn = first_stage_fn
         self.last_stage_fn = last_stage_fn
@@ -190,14 +217,16 @@ class PipelineTrainer(object):
         self.num_microbatches = num_microbatches
         self.axis_name = axis_name
         self.schedule = schedule
+        self.interleave = interleave if schedule == "interleaved" else 1
         self.data_axes = tuple(
             a for a in data_axes if mesh.shape.get(a, 1) > 1
         )
-        self._step = (
-            self._build_step()
-            if schedule == "gpipe"
-            else self._build_step_1f1b()
-        )
+        if schedule == "gpipe":
+            self._step = self._build_step()
+        elif schedule == "1f1b":
+            self._step = self._build_step_1f1b()
+        else:
+            self._step = self._build_step_interleaved()
 
     # -- sharding ------------------------------------------------------
 
@@ -518,6 +547,274 @@ class PipelineTrainer(object):
             grads = {
                 # restore the leading (local size-1) stage dim for the
                 # P(pipe) out_spec
+                "stages": jax.tree.map(
+                    lambda g: _dmean(g * inv_m)[None], carry["stage_g"]
+                ),
+                "first": jax.tree.map(
+                    lambda g: _dmean(lax.psum(g * inv_m, pipe)), d_first
+                ),
+                "last": jax.tree.map(
+                    lambda g: _dmean(lax.psum(g * inv_m, pipe)),
+                    carry["last_g"],
+                ),
+            }
+            metrics = dict(carry["metrics"])
+            metrics["loss"] = carry["loss"]
+            metrics = jax.tree.map(
+                lambda x: _dmean(lax.psum(x * inv_m, pipe)), metrics
+            )
+            return grads, metrics
+
+        grad_fn = functools.partial(
+            jax.shard_map,
+            mesh=mesh,
+            in_specs=(param_specs, batch_spec),
+            out_specs=(param_specs, P()),
+            check_vma=False,
+        )(local_grads)
+
+        def train_step(state, batch):
+            grads, metrics = grad_fn(state.params, batch)
+            updates, opt_state = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            import optax
+
+            params = optax.apply_updates(state.params, updates)
+            from tensorflowonspark_tpu.parallel.dp import TrainState
+
+            return TrainState(state.step + 1, params, opt_state), metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
+    # -- interleaved 1F1B ----------------------------------------------
+
+    def _build_step_interleaved(self):
+        """Megatron interleaved-1F1B train step.
+
+        Same masked-SPMD structure as :meth:`_build_step_1f1b`, with
+        ``interleave`` virtual-stage chunks per device: stage params
+        carry a leading ``[v, ...]`` chunk axis the tick program
+        dynamic-indexes, and the single-slot handoff buffers become
+        per-chunk slot banks whose depths come from the *static* buffer
+        analysis (``pp_schedule.analyze_program``) — the schedule is
+        property-checked at build time, so an overrun is impossible at
+        run time rather than merely untested.  Activation hand-off
+        routing: absolute chunk ``a = c*P + d`` forwards to
+        ``a+1`` — the ring neighbor ``d+1`` at the same local chunk,
+        except device P-1 wraps to device 0 at local chunk ``c+1``.
+        """
+        from tensorflowonspark_tpu.parallel import pp_schedule
+
+        layer_fn = self.layer_fn
+        first_fn = self.first_stage_fn
+        last_fn = self.last_stage_fn
+        optimizer = self.optimizer
+        pipe = self.axis_name
+        m = self.num_microbatches
+        v = self.interleave
+        data_axes = self.data_axes
+        mesh = self.mesh
+        p = mesh.shape[pipe]
+
+        table = pp_schedule.simulate(p, m, "1f1b", interleave=v)
+        geom = pp_schedule.analyze_program(table, p, interleave=v)
+        prog = pp_schedule.stage_program(p, m, "1f1b", interleave=v)
+        do_f = jnp.asarray(prog["do_f"])
+        f_mb = jnp.asarray(prog["f_mb"])
+        f_ch = jnp.asarray(prog["f_chunk"])
+        do_b = jnp.asarray(prog["do_b"])
+        b_mb = jnp.asarray(prog["b_mb"])
+        b_ch = jnp.asarray(prog["b_chunk"])
+        n_ticks = int(prog["do_f"].shape[0])
+        stash_slots = geom["stash_slots"]
+        qf = geom["fwd_slots"]
+        qb = geom["bwd_slots"]
+
+        batch_spec = P(data_axes if data_axes else None)
+        param_specs = {"stages": P(pipe), "first": P(), "last": P()}
+
+        stage_fn = functools.partial(_layers_scan, layer_fn)
+
+        def _pick_chunk(tree_v, c):
+            return jax.tree.map(
+                lambda x: lax.dynamic_index_in_dim(x, c, 0, keepdims=False),
+                tree_v,
+            )
+
+        def _bank_get(bank, c, s):
+            """``bank[c, s]`` with traced scalar indices."""
+            row = lax.dynamic_index_in_dim(bank, c, 0, keepdims=False)
+            return lax.dynamic_index_in_dim(row, s, 0, keepdims=False)
+
+        def _bank_put(bank, val, c, s, pred):
+            start = (c, s) + (0,) * val.ndim
+            return jnp.where(
+                pred,
+                lax.dynamic_update_slice(bank, val[None, None], start),
+                bank,
+            )
+
+        def local_grads(params, batch):
+            idx = lax.axis_index(pipe)
+            is_first = idx == 0
+            is_last = idx == p - 1
+            fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+            bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+
+            stage_params = local_stage(params["stages"])  # [v, lc, ...]
+            h0 = first_fn(params["first"], batch)
+            b = h0.shape[0]
+            if b % m != 0:
+                raise ValueError(
+                    "local batch {0} not divisible by num_microbatches "
+                    "{1}".format(b, m)
+                )
+            mb = b // m
+            micro = h0.reshape((m, mb) + h0.shape[1:])
+            batch_micro = jax.tree.map(
+                lambda x: x.reshape((m, mb) + x.shape[1:]), batch
+            )
+
+            mb_batch0 = jax.tree.map(lambda x: x[0], batch_micro)
+            _, metrics_shape = jax.eval_shape(
+                last_fn, params["last"], jax.ShapeDtypeStruct(
+                    micro.shape[1:], micro.dtype
+                ), mb_batch0,
+            )
+            metrics0 = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), metrics_shape
+            )
+
+            act = micro.shape[1:]
+            carry = dict(
+                fwd_recv=jnp.zeros((v, qf) + act, micro.dtype),
+                bwd_recv=jnp.zeros((v, qb) + act, micro.dtype),
+                stash=jnp.zeros((v, stash_slots) + act, micro.dtype),
+                d_h0=jnp.zeros_like(micro),
+                stage_g=jax.tree.map(jnp.zeros_like, stage_params),
+                last_g=jax.tree.map(jnp.zeros_like, params["last"]),
+                loss=jnp.zeros((), jnp.float32),
+                metrics=metrics0,
+            )
+
+            def acc(flag, old, new):
+                return jax.tree.map(
+                    lambda o, n: jnp.where(flag, o + n, o), old, new
+                )
+
+            def tick(carry, t):
+                myf = do_f[t, idx].astype(bool)
+                myb = do_b[t, idx].astype(bool)
+                fj, fc = f_mb[t, idx], f_ch[t, idx]
+                bj, bc = b_mb[t, idx], b_ch[t, idx]
+
+                # ---- forward unit (masked; chunk fc) ----------------
+                params_f = _pick_chunk(stage_params, fc)
+                inject = jnp.logical_and(is_first, fc == 0)
+                x_in = jnp.where(
+                    inject, micro[fj], _bank_get(carry["fwd_recv"], fc, fj % qf)
+                )
+                y = stage_fn(params_f, x_in)
+                stash = _bank_put(
+                    carry["stash"], x_in, fc, fj % stash_slots, myf
+                )
+
+                # ---- backward unit (masked; chunk bc; remat) --------
+                params_b = _pick_chunk(stage_params, bc)
+                x_b = _bank_get(carry["stash"], bc, bj % stash_slots)
+                y_b, pull = jax.vjp(stage_fn, params_b, x_b)
+                mb_batch = jax.tree.map(lambda a: a[bj], batch_micro)
+                loss_j, last_pull, metrics_j = jax.vjp(
+                    lambda lp, h: last_fn(lp, h, mb_batch),
+                    params["last"],
+                    y_b,
+                    has_aux=True,
+                )
+                d_last, d_y_last = last_pull(jnp.ones_like(loss_j))
+                own_loss = jnp.logical_and(is_last, bc == v - 1)
+                ct = jnp.where(
+                    own_loss, d_y_last, _bank_get(carry["bwd_recv"], bc, bj % qb)
+                )
+                d_chunk, d_x = pull(ct)
+
+                bl = jnp.logical_and(myb, own_loss)
+                stage_g = jax.tree.map(
+                    lambda gacc, gnew: jnp.where(
+                        myb,
+                        lax.dynamic_update_index_in_dim(
+                            gacc,
+                            lax.dynamic_index_in_dim(
+                                gacc, bc, 0, keepdims=False
+                            ) + gnew,
+                            bc,
+                            axis=0,
+                        ),
+                        gacc,
+                    ),
+                    carry["stage_g"],
+                    d_chunk,
+                )
+                new = dict(
+                    stash=stash,
+                    stage_g=stage_g,
+                    last_g=acc(bl, carry["last_g"], d_last),
+                    loss=jnp.where(
+                        bl, carry["loss"] + loss_j.astype(jnp.float32),
+                        carry["loss"],
+                    ),
+                    metrics=acc(bl, carry["metrics"], metrics_j),
+                    d_h0=jnp.where(
+                        jnp.logical_and(
+                            myb, jnp.logical_and(is_first, bc == 0)
+                        ),
+                        lax.dynamic_update_index_in_dim(
+                            carry["d_h0"], d_x, bj, axis=0
+                        ),
+                        carry["d_h0"],
+                    ),
+                )
+
+                # ---- handoffs (per-chunk slot banks; static routing)
+                recv_y = lax.ppermute(y, pipe, fwd_perm)
+                recv_ct = lax.ppermute(d_x, pipe, bwd_perm)
+                sd = (idx - 1) % p  # fwd sender on the ring
+                sent_f = do_f[t, sd].astype(bool)
+                s_ch = f_ch[t, sd] + jnp.where(idx == 0, 1, 0)
+                s_mb = f_mb[t, sd]
+                valid_f = jnp.logical_and(sent_f, s_ch < v)
+                new["fwd_recv"] = _bank_put(
+                    carry["fwd_recv"], recv_y,
+                    jnp.clip(s_ch, 0, v - 1), s_mb % qf, valid_f,
+                )
+                su = (idx + 1) % p  # bwd sender on the ring
+                sent_b = do_b[t, su].astype(bool)
+                r_ch = b_ch[t, su] - jnp.where(idx == p - 1, 1, 0)
+                r_mb = b_mb[t, su]
+                valid_b = jnp.logical_and(sent_b, r_ch >= 0)
+                new["bwd_recv"] = _bank_put(
+                    carry["bwd_recv"], recv_ct,
+                    jnp.clip(r_ch, 0, v - 1), r_mb % qb, valid_b,
+                )
+                return new, None
+
+            carry, _ = lax.scan(tick, carry, jnp.arange(n_ticks))
+
+            _, first_pull = jax.vjp(
+                lambda fp: first_fn(fp, batch), params["first"]
+            )
+            (d_first,) = first_pull(
+                carry["d_h0"].reshape((b,) + carry["d_h0"].shape[2:])
+            )
+            d_first = jax.tree.map(
+                lambda g: jnp.where(is_first, g, jnp.zeros_like(g)), d_first
+            )
+
+            def _dmean(g):
+                return lax.pmean(g, data_axes) if data_axes else g
+
+            inv_m = 1.0 / m
+            grads = {
                 "stages": jax.tree.map(
                     lambda g: _dmean(g * inv_m)[None], carry["stage_g"]
                 ),

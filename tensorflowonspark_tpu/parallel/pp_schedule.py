@@ -26,7 +26,7 @@ tick.
 
 import collections
 
-__all__ = ["simulate", "stats", "stage_program"]
+__all__ = ["simulate", "stats", "stage_program", "analyze_program"]
 
 
 #: one scheduled unit: kind is "F" or "B", mb the microbatch index,
@@ -216,23 +216,30 @@ def stats(table, unit_time=1.0):
     }
 
 
-def stage_program(num_stages, num_microbatches, schedule="1f1b"):
-    """Flatten a (non-interleaved) schedule into per-tick static arrays
-    for the SPMD execution in pp.py.
+def stage_program(num_stages, num_microbatches, schedule="1f1b",
+                  interleave=1):
+    """Flatten a schedule into per-tick static arrays for the SPMD
+    execution in pp.py.
 
     Returns dict of numpy int arrays, each ``[T, P]``:
       ``do_f``/``f_mb`` — whether/which microbatch device d forwards at
-      tick t; ``do_b``/``b_mb`` — same for backward.
+      tick t; ``do_b``/``b_mb`` — same for backward; ``f_chunk`` /
+      ``b_chunk`` — the device-local virtual-stage chunk of each unit
+      (all zero unless ``interleave > 1``).
     """
     import numpy as np
 
-    table = simulate(num_stages, num_microbatches, schedule, interleave=1)
+    table = simulate(
+        num_stages, num_microbatches, schedule, interleave=interleave
+    )
     p = num_stages
     t_len = len(table[0])
     do_f = np.zeros((t_len, p), np.int32)
     f_mb = np.zeros((t_len, p), np.int32)
+    f_chunk = np.zeros((t_len, p), np.int32)
     do_b = np.zeros((t_len, p), np.int32)
     b_mb = np.zeros((t_len, p), np.int32)
+    b_chunk = np.zeros((t_len, p), np.int32)
     for d in range(p):
         for t, u in enumerate(table[d]):
             if u is None:
@@ -240,7 +247,107 @@ def stage_program(num_stages, num_microbatches, schedule="1f1b"):
             if u.kind == "F":
                 do_f[t, d] = 1
                 f_mb[t, d] = u.mb
+                f_chunk[t, d] = u.chunk
             else:
                 do_b[t, d] = 1
                 b_mb[t, d] = u.mb
-    return {"do_f": do_f, "f_mb": f_mb, "do_b": do_b, "b_mb": b_mb}
+                b_chunk[t, d] = u.chunk
+    return {
+        "do_f": do_f, "f_mb": f_mb, "f_chunk": f_chunk,
+        "do_b": do_b, "b_mb": b_mb, "b_chunk": b_chunk,
+    }
+
+
+def _handoff_depth_ok(table, p, v, kind, depth):
+    """Check a handoff-buffer geometry of ``depth`` slots per (device,
+    chunk), indexed ``mb % depth``, against the executor's timing: a
+    unit consumes its incoming slot at the START of its tick; a
+    producer's send LANDS at the end of its tick (a ppermute result is
+    visible the next tick)."""
+    num_chunks = p * v
+    buf = {}  # (device, chunk, mb % depth) -> mb pending
+    for t in range(len(table[0])):
+        for d in range(p):
+            u = table[d][t]
+            if u is None or u.kind != kind:
+                continue
+            a = u.chunk * p + d
+            edge = 0 if kind == "F" else num_chunks - 1
+            if a != edge:  # chunk 0 injects / last chunk owns the loss
+                key = (d, u.chunk, u.mb % depth)
+                if buf.get(key) != u.mb:
+                    return False
+                del buf[key]
+        for d in range(p):
+            u = table[d][t]
+            if u is None or u.kind != kind:
+                continue
+            a = u.chunk * p + d
+            if kind == "F" and a != num_chunks - 1:
+                key = ((a + 1) % p, (a + 1) // p, u.mb % depth)
+            elif kind == "B" and a != 0:
+                key = ((a - 1) % p, (a - 1) // p, u.mb % depth)
+            else:
+                continue
+            if key in buf:
+                return False  # overwrite of an unconsumed slot
+            buf[key] = u.mb
+    return not buf  # everything produced was consumed
+
+
+def analyze_program(table, num_stages, interleave=1):
+    """Static safety analysis of a (possibly interleaved) 1F1B table
+    for the SPMD executor's buffer geometry.
+
+    Returns ``{"stash_slots", "fwd_slots", "bwd_slots"}`` — the minimal
+    per-chunk depths for the activation stash and the two ppermute
+    handoff buffers (all modularly indexed by microbatch).  Classic
+    1F1B (v=1) needs single-slot handoffs; the interleaved schedule's
+    chunk cycling keeps up to two forwards of one chunk in flight.
+    Raises ``RuntimeError`` when no depth works (a schedule bug, not a
+    user error).
+    """
+    p, v = num_stages, interleave
+    t_len = len(table[0])
+    m = 1 + max(
+        (u.mb for row in table for u in row if u is not None), default=0
+    )
+
+    def min_depth(kind):
+        for depth in range(1, m + 1):
+            if _handoff_depth_ok(table, p, v, kind, depth):
+                return depth
+        raise RuntimeError(
+            "no {0}-handoff depth <= {1} microbatches works for this "
+            "schedule".format(kind, m)
+        )
+
+    # stash occupancy: F stashes its input, B releases it
+    alive = collections.defaultdict(set)  # (device, chunk) -> live mbs
+    snapshots = []
+    for t in range(t_len):
+        for d in range(p):
+            u = table[d][t]
+            if u is None:
+                continue
+            key = (d, u.chunk)
+            if u.kind == "F":
+                alive[key].add(u.mb)
+            else:
+                alive[key].discard(u.mb)
+            snapshots.append(frozenset(alive[key]))
+    if any(alive.values()):
+        raise RuntimeError("schedule left stashed activations unconsumed")
+    max_alive = max((len(s) for s in snapshots), default=1)
+    stash = m
+    for slots in range(max(1, max_alive), m + 1):
+        if all(
+            len({mb % slots for mb in s}) == len(s) for s in snapshots
+        ):
+            stash = slots
+            break
+    return {
+        "stash_slots": stash,
+        "fwd_slots": min_depth("F"),
+        "bwd_slots": min_depth("B"),
+    }

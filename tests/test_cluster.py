@@ -247,6 +247,86 @@ def test_train_stream_stops_on_request(engine):
     cluster.shutdown(timeout=60)
 
 
+class _FakeRDD(object):
+    """Duck-typed micro-batch RDD: ``foreachPartition`` dispatches the
+    feed function through the engine, exactly as Spark runs it on
+    executors (covers cluster.train_dstream's non-native branch)."""
+
+    def __init__(self, engine, partitions):
+        self.engine = engine
+        self.partitions = partitions
+
+    def foreachPartition(self, fn):
+        self.engine.run_job(fn, self.partitions)
+
+
+class _FakeDStream(object):
+    """foreachRDD contract of a pyspark DStream, driven synchronously."""
+
+    def __init__(self, rdds):
+        self.rdds = rdds
+        self.callback = None
+
+    def foreachRDD(self, fn):
+        self.callback = fn
+        for rdd in self.rdds:
+            fn(rdd)
+
+
+def test_train_dstream_duck_typed(engine):
+    # the DStream hook (reference: TFCluster.py:83-85 foreachRDD +
+    # examples/mnist/estimator/mnist_spark_streaming.py) without
+    # pyspark: three micro-batch RDDs fed in place, clean shutdown
+    cluster = tpu_cluster.run(
+        engine,
+        _train_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    rdds = [
+        _FakeRDD(engine, [list(range(i * 20, i * 20 + 10)),
+                          list(range(i * 20 + 10, i * 20 + 20))])
+        for i in range(3)
+    ]
+    cluster.train_dstream(_FakeDStream(rdds), feed_timeout=60)
+    cluster.shutdown(grace_secs=1, timeout=60)
+
+
+def test_train_dstream_stops_on_request(engine):
+    # request_stop makes the foreachRDD callback skip later
+    # micro-batches (reference: examples/utils/stop_streaming.py)
+    from tensorflowonspark_tpu.cluster import reservation
+
+    cluster = tpu_cluster.run(
+        engine,
+        _train_consume_fn,
+        args={},
+        num_executors=2,
+        input_mode=InputMode.SPARK,
+    )
+    fed = []
+
+    class _CountingRDD(_FakeRDD):
+        def foreachPartition(self, fn):
+            fed.append(1)
+            super(_CountingRDD, self).foreachPartition(fn)
+
+    stream = _FakeDStream([])
+    cluster.train_dstream(stream, feed_timeout=60)  # registers callback
+    stream.callback(_CountingRDD(engine, [[1, 2, 3]]))
+    client = reservation.Client(tuple(cluster.cluster_meta["server_addr"]))
+    client.request_stop()
+    client.close()
+    deadline = time.time() + 10
+    while not cluster.server.stop_requested and time.time() < deadline:
+        time.sleep(0.05)
+    assert cluster.server.stop_requested
+    stream.callback(_CountingRDD(engine, [[4, 5, 6]]))  # must be skipped
+    assert len(fed) == 1
+    cluster.shutdown(grace_secs=1, timeout=60)
+
+
 def _eval_role_fn(args, ctx):
     # evaluator runs in the background like ps (service node); record
     # the role so the test can assert it actually launched

@@ -7,6 +7,7 @@ compiled model on synthetic inputs)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tensorflowonspark_tpu.models import (
     MNISTNet,
@@ -106,6 +107,50 @@ class TestTransformer:
             state, m = trainer.step(state, {"tokens": tokens})
             losses.append(float(m["loss"]))
         assert losses[-1] < losses[0]
+
+    def test_fused_qkv_matches_unfused(self):
+        """One [embed -> 3,H,D] projection is numerically identical to
+        three separate q/k/v matmuls when fed the same weights."""
+        model_f, _ = self._tiny(fused_qkv=True)
+        model_u, _ = self._tiny(fused_qkv=False)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        pf = model_f.init(jax.random.PRNGKey(0), tokens)["params"]
+        pu = jax.tree.map(lambda x: x, model_u.init(
+            jax.random.PRNGKey(0), tokens
+        )["params"])
+        # graft the fused kernel's three slices into the unfused tree
+        for blk in ("block_0", "block_1"):
+            kern = pf[blk]["attn"]["qkv"]["kernel"]  # [Dm, 3, H, D]
+            for i, name in enumerate(("q", "k", "v")):
+                pu[blk]["attn"][name]["kernel"] = kern[:, i]
+            for shared in ("out",):
+                pu[blk]["attn"][shared] = pf[blk]["attn"][shared]
+            for other in ("ln1", "ln2", "mlp"):
+                pu[blk][other] = pf[blk][other]
+        for top in ("embedding", "ln_f", "lm_head"):
+            pu[top] = pf[top]
+        np.testing.assert_allclose(
+            np.asarray(model_f.apply({"params": pf}, tokens)),
+            np.asarray(model_u.apply({"params": pu}, tokens)),
+            atol=1e-5,
+        )
+
+    def test_remat_policy_invariant(self):
+        """remat (block or dots policy) must not change the forward."""
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        base, _ = self._tiny(remat=False)
+        params = base.init(jax.random.PRNGKey(0), tokens)["params"]
+        ref = base.apply({"params": params}, tokens)
+        for policy in ("block", "dots"):
+            m, _ = self._tiny(remat=True, remat_policy=policy)
+            np.testing.assert_allclose(
+                np.asarray(m.apply({"params": params}, tokens)),
+                np.asarray(ref),
+                atol=1e-6,
+            )
+        with pytest.raises(ValueError, match="remat_policy"):
+            m, _ = self._tiny(remat=True, remat_policy="nope")
+            m.apply({"params": params}, tokens)
 
     def test_logical_axes_cover_params(self):
         from tensorflowonspark_tpu.models import transformer as tr

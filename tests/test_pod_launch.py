@@ -1,0 +1,75 @@
+"""Pod bring-up script (scripts/tpu_pod.py) — config/command rendering.
+
+The reference's deployment tooling (scripts/spark_ec2.py) was never
+exercised in its CI either; what IS testable without GCP credentials is
+that every action renders complete, correctly-quoted gcloud commands
+and that the rendezvous env the `run` action exports matches what
+``parallel.mesh.distributed_init_from_env`` consumes.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "tpu_pod.py")
+
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+import tpu_pod  # noqa: E402
+
+
+CFG = tpu_pod.PodConfig(name="tfos-pod", zone="us-east5-a")
+
+
+def test_create_renders_accelerator_and_zone():
+    (cmd,) = tpu_pod.render_create(CFG)
+    assert cmd[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+    assert "tfos-pod" in cmd
+    assert cmd[cmd.index("--zone") + 1] == "us-east5-a"
+    assert cmd[cmd.index("--accelerator-type") + 1] == "v5litepod-16"
+
+
+def test_delete_is_quiet():
+    (cmd,) = tpu_pod.render_delete(CFG)
+    assert "delete" in cmd and "--quiet" in cmd
+
+
+def test_bootstrap_clones_and_builds_native():
+    (cmd,) = tpu_pod.render_bootstrap(
+        CFG, "https://example.com/r.git", ref="v1.0"
+    )
+    assert "--worker=all" in cmd  # every host of the slice
+    remote = cmd[cmd.index("--command") + 1]
+    assert "git clone" in remote and "v1.0" in remote
+    assert "make -C ~/tfos-tpu/native" in remote
+
+
+def test_run_exports_rendezvous_env():
+    (cmd,) = tpu_pod.render_run(
+        CFG, ["python", "examples/mnist/mnist_spark.py", "--cluster_size", "4"]
+    )
+    remote = cmd[cmd.index("--command") + 1]
+    # the exported variables are exactly what
+    # mesh.distributed_init_from_env consumes
+    assert "TFOS_COORDINATOR=$COORD:%d" % tpu_pod.COORDINATOR_PORT in remote
+    assert "TFOS_PROCESS_ID=$WID" in remote
+    assert "examples/mnist/mnist_spark.py" in remote
+
+
+def test_cli_dry_run_prints_without_executing(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable, SCRIPT, "run", "--name", "p", "--zone", "z",
+            "--dry-run", "--", "python", "x.py",
+        ],
+        stdout=subprocess.PIPE, text=True, check=True,
+    ).stdout
+    assert out.startswith("gcloud ")
+    assert "x.py" in out
+
+
+def test_distributed_init_env_contract():
+    from tensorflowonspark_tpu.parallel import mesh
+
+    # absent vars -> no-op (single host)
+    assert mesh.distributed_init_from_env(environ={}) is False
