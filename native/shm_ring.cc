@@ -80,7 +80,7 @@ int shmring_push(uint8_t* base, const uint8_t* rec, uint64_t len) {
   Header* h = H(base);
   if (h->magic != kMagic) return -3;
   uint64_t cap = h->capacity;
-  if (len + 4 > cap) return -2;
+  if (len > UINT32_MAX - 4 || len + 4 > cap) return -2;
   uint64_t head = h->head.load(std::memory_order_relaxed);
   uint64_t tail = h->tail.load(std::memory_order_acquire);
   if (head - tail + len + 4 > cap) return -1;  // not enough free space
@@ -88,6 +88,36 @@ int shmring_push(uint8_t* base, const uint8_t* rec, uint64_t len) {
   RingWrite(Data(base), cap, head,
             reinterpret_cast<const uint8_t*>(&len32), 4);
   RingWrite(Data(base), cap, head + 4, rec, len);
+  h->head.store(head + 4 + len, std::memory_order_release);
+  return 0;
+}
+
+// Scatter-gather push: one record assembled from `nparts` segments
+// (header + raw column buffers) with a single head advance — the
+// zero-pickle columnar path writes numpy buffers straight into the
+// ring instead of concatenating them into an intermediate bytes.
+// Same return codes as shmring_push.
+int shmring_pushv(uint8_t* base, const uint8_t** parts,
+                  const uint64_t* lens, uint64_t nparts) {
+  Header* h = H(base);
+  if (h->magic != kMagic) return -3;
+  uint64_t cap = h->capacity;
+  uint64_t len = 0;
+  for (uint64_t i = 0; i < nparts; ++i) len += lens[i];
+  // the frame length field is u32: a >4GiB record would silently wrap
+  // and corrupt the ring framing on multi-GiB rings
+  if (len > UINT32_MAX - 4 || len + 4 > cap) return -2;
+  uint64_t head = h->head.load(std::memory_order_relaxed);
+  uint64_t tail = h->tail.load(std::memory_order_acquire);
+  if (head - tail + len + 4 > cap) return -1;  // not enough free space
+  uint32_t len32 = static_cast<uint32_t>(len);
+  RingWrite(Data(base), cap, head,
+            reinterpret_cast<const uint8_t*>(&len32), 4);
+  uint64_t pos = head + 4;
+  for (uint64_t i = 0; i < nparts; ++i) {
+    RingWrite(Data(base), cap, pos, parts[i], lens[i]);
+    pos += lens[i];
+  }
   h->head.store(head + 4 + len, std::memory_order_release);
   return 0;
 }

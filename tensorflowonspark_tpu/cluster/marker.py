@@ -86,6 +86,187 @@ class ColumnarBlock(Marker):
         return list(zip(*self.columns))
 
 
+# ----------------------------------------------------------------------
+# Zero-pickle wire format for ColumnarBlock (the shm-ring fast path):
+# [8B magic][u32 header len][json header][raw column buffers...].
+# pickle of a ColumnarBlock copies every column into the pickle stream
+# and back out at loads; this format writes the numpy buffers straight
+# into the ring (ShmRing.pushv) and reconstructs them as zero-copy
+# np.frombuffer views over the popped record.
+# ----------------------------------------------------------------------
+
+COLUMNAR_MAGIC = b"TFOSCB1\x00"
+
+
+def encode_columnar_parts(block):
+    """``(header_bytes, [column buffers])`` for ``ShmRing.pushv``, or
+    ``None`` when the block is not wire-encodable (dict columns with
+    non-string keys — the JSON header only round-trips str keys).
+
+    Buffers are the blocks' own contiguous column arrays (no copy
+    here); total record size is ``len(header) + sum(buffer sizes)``.
+    """
+    import json as _json
+    import struct
+
+    import numpy as np
+
+    cols = block.columns
+    if isinstance(cols, dict):
+        keys = list(cols)
+        if not all(isinstance(k, str) for k in keys):
+            # the JSON header can only round-trip string keys (bytes
+            # keys fail json.dumps; tuple keys decode as unhashable
+            # lists) — such blocks ship via pickle
+            return None
+        arrs = [cols[k] for k in keys]
+        kind = "dict"
+    else:
+        keys = None
+        arrs = list(cols)
+        kind = (
+            "scalar" if block._scalar else
+            ("list" if block._list_rows else "tuple")
+        )
+    arrs = [np.ascontiguousarray(a) for a in arrs]
+    meta = {
+        "kind": kind,
+        "keys": keys,
+        "count": int(block.count),
+        "dtypes": [a.dtype.str for a in arrs],
+        "shapes": [list(a.shape) for a in arrs],
+    }
+    hdr = _json.dumps(meta).encode("utf-8")
+    header = COLUMNAR_MAGIC + struct.pack("<I", len(hdr)) + hdr
+    return header, arrs
+
+
+def encode_rows_parts(rows):
+    """Encode a block of rows for ``ShmRing.pushv`` WITHOUT stacking
+    them first: each fixed-shape ndarray column contributes its per-row
+    buffers as separate scatter-gather parts, and the ring's contiguous
+    record write IS the stack — the feeder's only data copy.  The
+    record decodes with :func:`decode_columnar_record` (identical wire
+    format: parts of one column laid out back-to-back equal the stacked
+    column buffer).
+
+    Returns ``(header, parts, total_bytes)`` or ``None`` when rows are
+    not fixed-shape homogeneous (callers fall back to
+    :func:`pack_columnar` / pickle).  Eligibility mirrors
+    ``pack_columnar``: exact-type tuple/list/dict rows, per-column
+    uniform dtype+shape; scalar numeric columns are stacked here (one
+    tiny array), big ndarray columns are the win.
+    """
+    import json as _json
+    import struct
+
+    import numpy as np
+
+    if not rows:
+        return None
+    first = rows[0]
+    if type(first) is dict:
+        keys = list(first)
+        if not all(isinstance(k, str) for k in keys):
+            return None  # JSON header: string keys only (see above)
+        get = lambda r, i: r[keys[i]]  # noqa: E731
+        width = len(keys)
+        kind = "dict"
+    elif type(first) in (tuple, list):
+        keys = None
+        get = lambda r, i: r[i]  # noqa: E731
+        width = len(first)
+        kind = "list" if type(first) is list else "tuple"
+    else:
+        return None  # scalar rows: the pack path handles them
+    if any(type(r) is not type(first) or len(r) != width for r in rows):
+        return None
+
+    n = len(rows)
+    parts = []
+    dtypes = []
+    shapes = []
+    try:
+        for i in range(width):
+            v0 = get(first, i)
+            if isinstance(v0, np.ndarray):
+                dt, shape = v0.dtype, v0.shape
+                if dt == object or dt.hasobject:
+                    return None
+                col_parts = []
+                for r in rows:
+                    v = get(r, i)
+                    if (
+                        not isinstance(v, np.ndarray)
+                        or v.dtype != dt
+                        or v.shape != shape
+                    ):
+                        return None
+                    col_parts.append(np.ascontiguousarray(v))
+                parts.append(col_parts)
+                dtypes.append(dt.str)
+                shapes.append([n] + list(shape))
+            else:
+                arr = _column_array([get(r, i) for r in rows])
+                if arr is None or arr.shape[0] != n:
+                    return None
+                parts.append([np.ascontiguousarray(arr)])
+                dtypes.append(arr.dtype.str)
+                shapes.append(list(arr.shape))
+    except (TypeError, ValueError, KeyError, IndexError):
+        # KeyError/IndexError: rows with mismatched key sets / widths —
+        # same fallback contract as pack_columnar
+        return None
+
+    meta = {
+        "kind": kind,
+        "keys": keys,
+        "count": n,
+        "dtypes": dtypes,
+        "shapes": shapes,
+    }
+    hdr = _json.dumps(meta).encode("utf-8")
+    header = COLUMNAR_MAGIC + struct.pack("<I", len(hdr)) + hdr
+    flat = [p for col in parts for p in col]
+    total = len(header) + sum(p.nbytes for p in flat)
+    return header, flat, total
+
+
+def decode_columnar_record(buf):
+    """Rebuild a :class:`ColumnarBlock` from one wire record, or return
+    ``None`` when ``buf`` is not in the columnar wire format (callers
+    fall back to pickle).  Column arrays are zero-copy views over
+    ``buf`` — the caller must hand in a buffer it will not reuse."""
+    import json as _json
+    import struct
+
+    import numpy as np
+
+    if len(buf) < 12 or bytes(buf[:8]) != COLUMNAR_MAGIC:
+        return None
+    (hlen,) = struct.unpack("<I", buf[8:12])
+    meta = _json.loads(bytes(buf[12:12 + hlen]))
+    off = 12 + hlen
+    arrs = []
+    for dt, shape in zip(meta["dtypes"], meta["shapes"]):
+        dtype = np.dtype(dt)
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        a = np.frombuffer(buf, dtype=dtype, count=n, offset=off)
+        arrs.append(a.reshape(shape))
+        off += n * dtype.itemsize
+    kind = meta["kind"]
+    if kind == "dict":
+        cols = dict(zip(meta["keys"], arrs))
+    else:
+        cols = tuple(arrs)
+    return ColumnarBlock(
+        cols,
+        meta["count"],
+        _scalar=kind == "scalar",
+        _list_rows=kind == "list",
+    )
+
+
 def _column_array(values):
     """Stack one column; ``None`` unless all elements share one Python
     type (and, for array elements, one dtype) and the result is a

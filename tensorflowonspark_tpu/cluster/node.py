@@ -44,7 +44,10 @@ import uuid
 from tensorflowonspark_tpu.cluster import manager, reservation, tpu_info
 from tensorflowonspark_tpu.cluster.marker import (
     Block,
+    ColumnarBlock,
     EndPartition,
+    encode_columnar_parts,
+    encode_rows_parts,
     pack_columnar,
 )
 from tensorflowonspark_tpu.utils import paths as path_utils
@@ -712,9 +715,51 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
 
         def _ship(rows):
             if ring is not None:
+                if columnar_ok:
+                    # zero-copy fast path: per-row buffers scatter-gather
+                    # straight into the ring — the contiguous record
+                    # write IS the column stack (no pack, no pickle).
+                    # Worth it only for LARGE rows (images): per-part
+                    # ctypes setup costs ~μs, so kilobyte rows are
+                    # faster through one stacked-column copy.
+                    enc = encode_rows_parts(rows)
+                    if enc is not None and (
+                        enc[2] < (len(enc[1]) + 1) * 65536
+                    ):
+                        enc = None  # mean part < 64KB: stack instead
+                    if enc is not None:
+                        header, parts, total = enc
+                        if total + 8 < ring.capacity:
+                            ring.pushv(
+                                [header] + parts,
+                                timeout=feed_timeout,
+                                error_check=lambda: _check_error_queue(
+                                    mgr, err_q
+                                ),
+                            )
+                            return
+                packed = _pack(rows)
+                if isinstance(packed, ColumnarBlock):
+                    # stacked-columns fallback (e.g. scalar rows):
+                    # still zero-pickle — one copy instead of three.
+                    # None = not wire-encodable (non-string dict keys);
+                    # such blocks ship pickled below.
+                    enc2 = encode_columnar_parts(packed)
+                    if enc2 is not None:
+                        header, arrs = enc2
+                        total = len(header) + sum(a.nbytes for a in arrs)
+                        if total + 8 < ring.capacity:
+                            ring.pushv(
+                                [header] + arrs,
+                                timeout=feed_timeout,
+                                error_check=lambda: _check_error_queue(
+                                    mgr, err_q
+                                ),
+                            )
+                            return
                 import pickle as _p
 
-                payload = _p.dumps(_pack(rows), protocol=5)
+                payload = _p.dumps(packed, protocol=5)
                 # a block that outgrows the ring is split, not fatal —
                 # the queue path never had a size cap; a single giant
                 # row falls back to the queue

@@ -33,8 +33,21 @@ from tensorflowonspark_tpu.cluster.marker import (
     Block,
     ColumnarBlock,
     EndPartition,
+    decode_columnar_record,
     pack_columnar,
 )
+
+
+def _decode_ring_record(rec):
+    """Ring records are either the zero-pickle columnar wire format
+    (magic-prefixed; decoded as zero-copy views over ``rec``) or a
+    pickled Block/row-list fallback."""
+    block = decode_columnar_record(rec)
+    if block is not None:
+        return block
+    import pickle
+
+    return pickle.loads(rec)
 
 logger = logging.getLogger(__name__)
 
@@ -103,8 +116,6 @@ class DataFeed(object):
                 # queue-fed rows at ~2.5k rows/s — the ADVICE.md r1
                 # finding; blocking on the wrong source starved the
                 # other.)
-                import pickle as _p
-
                 if self._hot_source == "queue":
                     try:
                         return queue_in.get(block=True, timeout=0.05)
@@ -113,12 +124,12 @@ class DataFeed(object):
                         if rec is None:
                             continue
                         self._hot_source = "ring"
-                        self._set_pending(_p.loads(rec))
+                        self._set_pending(_decode_ring_record(rec))
                         return self._RING_SENTINEL
                 else:
                     rec = self._ring.pop(timeout=0.05)
                     if rec is not None:
-                        self._set_pending(_p.loads(rec))
+                        self._set_pending(_decode_ring_record(rec))
                         return self._RING_SENTINEL
                     try:
                         item = queue_in.get(block=False)

@@ -53,6 +53,13 @@ def _configure(lib):
     lib.shmring_init.argtypes = [u8p, ctypes.c_uint64]
     lib.shmring_push.restype = ctypes.c_int
     lib.shmring_push.argtypes = [u8p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.shmring_pushv.restype = ctypes.c_int
+    lib.shmring_pushv.argtypes = [
+        u8p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+    ]
     lib.shmring_pop.restype = ctypes.c_int64
     lib.shmring_pop.argtypes = [
         u8p, u8p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_uint64),
@@ -91,7 +98,7 @@ class ShmRing(object):
         self._owner = create
         #: usable data-region bytes (segment minus the 64B header)
         self.capacity = self.shm.size - 64
-        self._out = ctypes.create_string_buffer(1 << 20)
+        self._out = ctypes.create_string_buffer(8)  # length-probe target
         # one ctypes view for the segment's lifetime: from_buffer pins
         # the exported buffer, so it must be dropped before close()
         self._cbase = (ctypes.c_uint8 * self.shm.size).from_buffer(
@@ -133,8 +140,62 @@ class ShmRing(object):
                 error_check()
             time.sleep(0.001)
 
+    def pushv(self, parts, timeout=None, error_check=None):
+        """Scatter-gather push: one record from multiple buffer-protocol
+        segments (header + raw numpy column buffers), copied into the
+        ring WITHOUT first concatenating into an intermediate bytes —
+        the zero-pickle columnar path's single feeder-side copy.
+        """
+        views = [memoryview(p).cast("B") for p in parts]
+        n = len(views)
+        ptrs = (ctypes.c_void_p * n)()
+        lens = (ctypes.c_uint64 * n)()
+        # keep ctypes casts alive for the duration of the call
+        holders = []
+        for i, v in enumerate(views):
+            c = (ctypes.c_uint8 * len(v)).from_buffer_copy(v) if v.readonly \
+                else (ctypes.c_uint8 * len(v)).from_buffer(v)
+            holders.append(c)
+            ptrs[i] = ctypes.cast(c, ctypes.c_void_p)
+            lens[i] = len(v)
+        total = sum(len(v) for v in views)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        base = self._base()
+        try:
+            while True:
+                rc = self._lib.shmring_pushv(
+                    base,
+                    ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                    lens,
+                    n,
+                )
+                if rc == 0:
+                    return
+                if rc == -2:
+                    raise ValueError(
+                        "record of {0} bytes exceeds ring capacity".format(
+                            total
+                        )
+                    )
+                if rc == -3:
+                    raise RuntimeError("corrupt ring segment")
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError("ring full for {0}s".format(timeout))
+                if error_check is not None:
+                    error_check()
+                time.sleep(0.001)
+        finally:
+            del holders
+
     def pop(self, timeout=0):
-        """Pop one record; returns ``None`` when empty past ``timeout``."""
+        """Pop one record into an exactly-sized buffer; returns ``None``
+        when empty past ``timeout``.
+
+        Two C calls per record — a zero-capacity probe for the length,
+        then the copy straight into a fresh ``bytearray`` — so the data
+        crosses ring→consumer with exactly ONE memcpy and no shared
+        scratch (a scratch would need a second copy before handing the
+        record out, since the next pop overwrites it)."""
         deadline = time.monotonic() + timeout
         base = self._base()
         need = ctypes.c_uint64(0)
@@ -142,14 +203,26 @@ class ShmRing(object):
             n = self._lib.shmring_pop(
                 base,
                 ctypes.cast(self._out, ctypes.POINTER(ctypes.c_uint8)),
-                len(self._out),
+                0,
                 ctypes.byref(need),
             )
-            if n >= 0:
-                return self._out.raw[:n]
-            if n == -2:  # grow the scratch buffer and retry
-                self._out = ctypes.create_string_buffer(int(need.value))
-                continue
+            if n == 0:
+                return b""  # zero-length record
+            if n == -2:
+                buf = bytearray(int(need.value))
+                carr = (ctypes.c_uint8 * len(buf)).from_buffer(buf)
+                n2 = self._lib.shmring_pop(
+                    base,
+                    ctypes.cast(carr, ctypes.POINTER(ctypes.c_uint8)),
+                    len(buf),
+                    ctypes.byref(need),
+                )
+                del carr
+                if n2 < 0:  # cannot happen for SPSC (sole consumer)
+                    raise RuntimeError(
+                        "ring record vanished between probe and pop"
+                    )
+                return buf
             if n == -3:
                 raise RuntimeError("corrupt ring segment")
             if time.monotonic() >= deadline:

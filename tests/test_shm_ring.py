@@ -116,3 +116,104 @@ def test_cluster_train_through_shm_ring():
         assert total == 4 * 500 * 2
     finally:
         engine.stop()
+
+
+# --- zero-pickle columnar wire format ----------------------------------
+
+
+def _wire(hdr, parts):
+    return hdr + b"".join(
+        np.ascontiguousarray(p).tobytes() for p in parts
+    )
+
+
+def test_columnar_wire_roundtrip_matches_pack():
+    from tensorflowonspark_tpu.cluster.marker import (
+        decode_columnar_record,
+        encode_columnar_parts,
+        encode_rows_parts,
+        pack_columnar,
+    )
+
+    rows = [
+        (np.random.RandomState(i).randint(0, 255, (8, 8, 3)).astype(np.uint8), i)
+        for i in range(6)
+    ]
+    packed = pack_columnar(rows)
+    hdr_s, arrs = encode_columnar_parts(packed)
+    hdr_r, parts, total = encode_rows_parts(rows)
+    rec_s, rec_r = _wire(hdr_s, arrs), _wire(hdr_r, parts)
+    assert total == len(rec_r)
+    out_s, out_r = (
+        decode_columnar_record(rec_s), decode_columnar_record(rec_r)
+    )
+    for o in (out_s, out_r):
+        assert o.count == 6
+        np.testing.assert_array_equal(o.columns[0], packed.columns[0])
+        np.testing.assert_array_equal(o.columns[1], packed.columns[1])
+        assert o.rows()[3][1] == 3
+
+
+def test_rows_parts_rejects_heterogeneous():
+    import collections
+
+    from tensorflowonspark_tpu.cluster.marker import encode_rows_parts
+
+    NT = collections.namedtuple("NT", "x y")
+    assert encode_rows_parts([NT(1, 2)]) is None  # tuple subclass
+    assert encode_rows_parts(
+        [(np.zeros(3),), (np.zeros(4),)]
+    ) is None  # ragged
+    assert encode_rows_parts(
+        [(np.zeros(3, np.float32),), (np.zeros(3, np.float64),)]
+    ) is None  # mixed dtype
+    assert encode_rows_parts([1, 2, 3]) is None  # scalar rows
+
+
+def test_decode_falls_back_on_pickle_records():
+    import pickle
+
+    from tensorflowonspark_tpu.cluster.marker import decode_columnar_record
+
+    assert decode_columnar_record(pickle.dumps(["x"], protocol=5)) is None
+
+
+def test_pushv_pop_roundtrip(ring):
+    from tensorflowonspark_tpu.cluster.marker import (
+        decode_columnar_record,
+        encode_rows_parts,
+    )
+
+    p, c = ring
+    rows = [(np.full((4, 4), i, np.int32), float(i)) for i in range(5)]
+    hdr, parts, total = encode_rows_parts(rows)
+    p.pushv([hdr] + parts, timeout=5)
+    rec = c.pop(timeout=2)
+    assert len(rec) == total
+    out = decode_columnar_record(rec)
+    np.testing.assert_array_equal(
+        out.columns[0], np.stack([r[0] for r in rows])
+    )
+    np.testing.assert_array_equal(out.columns[1], [r[1] for r in rows])
+
+
+def test_wire_encoders_reject_unjsonable_keys_and_mismatched_dicts():
+    from tensorflowonspark_tpu.cluster.marker import (
+        encode_columnar_parts,
+        encode_rows_parts,
+        pack_columnar,
+    )
+
+    # mismatched key sets: fall back (pack_columnar contract), no raise
+    assert encode_rows_parts(
+        [{"a": np.zeros((4, 4))}, {"b": np.zeros((4, 4))}]
+    ) is None
+    # bytes keys: json header cannot carry them
+    assert encode_rows_parts([{b"x": np.zeros(3)} for _ in range(2)]) is None
+    blk = pack_columnar([{b"x": 1.0}, {b"x": 2.0}])
+    assert blk is not None  # packable in-process...
+    assert encode_columnar_parts(blk) is None  # ...but not wire-encodable
+    # tuple keys would decode as unhashable lists: refused at encode
+    blk2 = pack_columnar([{(1, 2): 1.0}, {(1, 2): 2.0}])
+    if blk2 is not None:
+        assert encode_columnar_parts(blk2) is None
