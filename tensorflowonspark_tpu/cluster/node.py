@@ -713,57 +713,67 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     return packed
             return Block(rows)
 
+        # largest record one ring frame can carry: the frame length
+        # field is u32, so a multi-GiB ring still caps records below
+        # 4GiB — oversize blocks must take the split path, not a fatal
+        # push error
+        wire_cap = min(ring.capacity, (1 << 32) - 4) if ring else 0
+
+        def _row_is_large(first):
+            """Cheap first-row probe: the per-row scatter-gather encode
+            only pays off when a row carries a >=64KB array (images);
+            kilobyte rows ship faster as one stacked-column copy, and
+            this probe avoids running the O(rows) encode just to
+            discard it."""
+            vals = (
+                first.values() if isinstance(first, dict)
+                else first if isinstance(first, (tuple, list))
+                else (first,)
+            )
+            try:
+                return any(getattr(v, "nbytes", 0) >= 65536 for v in vals)
+            except TypeError:
+                return False
+
+        def _push_record(header, bufs):
+            """Push one wire-format record; False when it doesn't fit
+            a frame (caller falls through to the pickle/split path)."""
+            total = len(header) + sum(b.nbytes for b in bufs)
+            if total + 8 >= wire_cap:
+                return False
+            ring.pushv(
+                [header] + bufs,
+                timeout=feed_timeout,
+                error_check=lambda: _check_error_queue(mgr, err_q),
+            )
+            return True
+
         def _ship(rows):
             if ring is not None:
-                if columnar_ok:
-                    # zero-copy fast path: per-row buffers scatter-gather
-                    # straight into the ring — the contiguous record
-                    # write IS the column stack (no pack, no pickle).
-                    # Worth it only for LARGE rows (images): per-part
-                    # ctypes setup costs ~μs, so kilobyte rows are
-                    # faster through one stacked-column copy.
+                if columnar_ok and _row_is_large(rows[0]):
+                    # zero-copy fast path: per-row buffers scatter-
+                    # gather straight into the ring — the contiguous
+                    # record write IS the column stack (no pack, no
+                    # pickle)
                     enc = encode_rows_parts(rows)
-                    if enc is not None and (
-                        enc[2] < (len(enc[1]) + 1) * 65536
-                    ):
-                        enc = None  # mean part < 64KB: stack instead
-                    if enc is not None:
-                        header, parts, total = enc
-                        if total + 8 < ring.capacity:
-                            ring.pushv(
-                                [header] + parts,
-                                timeout=feed_timeout,
-                                error_check=lambda: _check_error_queue(
-                                    mgr, err_q
-                                ),
-                            )
-                            return
+                    if enc is not None and _push_record(enc[0], enc[1]):
+                        return
                 packed = _pack(rows)
                 if isinstance(packed, ColumnarBlock):
-                    # stacked-columns fallback (e.g. scalar rows):
+                    # stacked-columns path (small or scalar rows):
                     # still zero-pickle — one copy instead of three.
                     # None = not wire-encodable (non-string dict keys);
                     # such blocks ship pickled below.
                     enc2 = encode_columnar_parts(packed)
-                    if enc2 is not None:
-                        header, arrs = enc2
-                        total = len(header) + sum(a.nbytes for a in arrs)
-                        if total + 8 < ring.capacity:
-                            ring.pushv(
-                                [header] + arrs,
-                                timeout=feed_timeout,
-                                error_check=lambda: _check_error_queue(
-                                    mgr, err_q
-                                ),
-                            )
-                            return
+                    if enc2 is not None and _push_record(enc2[0], enc2[1]):
+                        return
                 import pickle as _p
 
                 payload = _p.dumps(packed, protocol=5)
-                # a block that outgrows the ring is split, not fatal —
-                # the queue path never had a size cap; a single giant
-                # row falls back to the queue
-                if len(payload) + 8 >= ring.capacity:
+                # a block that outgrows a ring frame is split, not
+                # fatal — the queue path never had a size cap; a single
+                # giant row falls back to the queue
+                if len(payload) + 8 >= wire_cap:
                     if len(rows) == 1:
                         queue.put(Block(rows), block=True)
                         return

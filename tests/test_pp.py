@@ -228,20 +228,30 @@ class TestPipelineTrainer:
                 err_msg=str(path),
             )
 
-    def test_interleaved_loss_and_grads_match_reference(self):
+    @pytest.mark.parametrize(
+        "axes,layers,m",
+        [
+            ({"data": 2, "pipe": 4}, 8, 4),
+            # m=8 > stash depth: exercises the modular stash/handoff
+            # slot reuse the static analysis sized
+            ({"data": 4, "pipe": 2}, 8, 8),
+        ],
+    )
+    def test_interleaved_loss_and_grads_match_reference(self, axes, layers, m):
         # the interleaved tick program computes the SAME gradients as
         # the sequential reference (hence also GPipe/1F1B, which match
         # it by the tests above)
         mesh, params, first_fn, last_fn, ref_loss = self._setup(
-            {"data": 2, "pipe": 4}, num_layers=8, interleave=2
+            axes, num_layers=layers, stages=axes["pipe"], interleave=2
         )
+        rows = 16 * m // 4  # local batch must divide by m on every shard
         batch = {
-            "x": np.random.RandomState(4).randn(16, 8).astype(np.float32),
-            "y": np.random.RandomState(5).randn(16).astype(np.float32),
+            "x": np.random.RandomState(4).randn(rows, 8).astype(np.float32),
+            "y": np.random.RandomState(5).randn(rows).astype(np.float32),
         }
         trainer = pp.PipelineTrainer(
             _layer_fn, first_fn, last_fn, optax.sgd(1.0), mesh,
-            num_microbatches=4, schedule="interleaved", interleave=2,
+            num_microbatches=m, schedule="interleaved", interleave=2,
         )
         state = trainer.create_state(jax.tree.map(jnp.asarray, params))
         old_params = jax.tree.map(np.asarray, state.params)

@@ -217,3 +217,11 @@ def test_wire_encoders_reject_unjsonable_keys_and_mismatched_dicts():
     blk2 = pack_columnar([{(1, 2): 1.0}, {(1, 2): 2.0}])
     if blk2 is not None:
         assert encode_columnar_parts(blk2) is None
+
+
+def test_zero_length_record(ring):
+    p, c = ring
+    p.push(b"", timeout=2)
+    p.push(b"after", timeout=2)
+    assert c.pop(timeout=1) == b""
+    assert c.pop(timeout=1) == b"after"
