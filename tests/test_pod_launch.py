@@ -73,3 +73,71 @@ def test_distributed_init_env_contract():
 
     # absent vars -> no-op (single host)
     assert mesh.distributed_init_from_env(environ={}) is False
+
+
+def test_pod_env_rendezvous_forms_process_group(tmp_path):
+    """The launcher's exported env actually forms a multi-process JAX
+    group: two subprocesses with TFOS_COORDINATOR/TFOS_PROCESS_ID (what
+    `tpu_pod.py run` exports on every host) call nothing but
+    build_mesh() and end up in ONE 2-process Gloo mesh computing a
+    global sum — the pod path's analogue of test_distributed.py."""
+    import socket
+    import time
+
+    child = tmp_path / "pod_child.py"
+    child.write_text(
+        "import os\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "from tensorflowonspark_tpu.parallel.mesh import MeshSpec, "
+        "build_mesh\n"
+        "mesh = build_mesh(MeshSpec(data=-1))\n"
+        "x = jax.make_array_from_process_local_data(\n"
+        "    NamedSharding(mesh, P('data')),\n"
+        "    np.ones((1,), np.float32),\n"
+        "    global_shape=(jax.process_count(),),\n"
+        ")\n"
+        "s = jax.jit(lambda a: jnp.sum(a),\n"
+        "            out_shardings=NamedSharding(mesh, P()))(x)\n"
+        "print('RESULT', jax.process_count(), float(s), flush=True)\n"
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env_base = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        TFOS_COORDINATOR="127.0.0.1:%d" % port,
+        TFOS_NUM_PROCESSES="2",
+        PYTHONPATH=os.pathsep.join([REPO] + sys.path),
+        # one CPU device per process (the conftest's 8-device forcing
+        # would make a 16-device global mesh)
+        XLA_FLAGS=" ".join(
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if not f.startswith("--xla_force_host_platform_device_count")
+        ),
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(child)],
+            env=dict(env_base, TFOS_PROCESS_ID=str(i)),
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    deadline = time.time() + 180
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        outs.append(out)
+    for out in outs:
+        assert "RESULT 2 2.0" in out, outs
