@@ -53,6 +53,19 @@ def _scratch(shape, dtype):
     return pltpu.VMEM(shape, dtype)
 
 
+def _compiler_params():
+    """Grid semantics for Mosaic: batch/heads/outer-block dims are
+    embarrassingly parallel; only the trailing streaming dim (the
+    online-softmax / gradient accumulation) is order-dependent.
+    Declaring this lets the compiler schedule/pipeline the parallel
+    dims freely instead of assuming a fully sequential grid."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+    )
+
+
 def _causal_mask(qi, kj, block_q, block_k):
     qpos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
@@ -271,6 +284,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             _scratch((bq, d), jnp.float32),  # output accumulator
         ],
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(qt, kt, vt)
     return jnp.swapaxes(out, 1, 2), (q, k, v, jnp.swapaxes(out, 1, 2), lse)
 
@@ -308,6 +322,7 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
         out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
         scratch_shapes=[_scratch((bq, d), jnp.float32)],
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(qt, kt, vt, dot_, lse, delta)
 
     dkv_kernel = functools.partial(
@@ -338,6 +353,7 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
             _scratch((bk, d), jnp.float32),
         ],
         interpret=_interpret(),
+        compiler_params=_compiler_params(),
     )(qt, kt, vt, dot_, lse, delta)
 
     return (
