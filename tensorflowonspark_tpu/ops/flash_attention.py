@@ -22,8 +22,9 @@ TPU mapping:
 - causal q/k block pairs that are fully masked are skipped with
   ``pl.when`` (no wasted MXU work on the upper triangle);
 - the matmuls hit the MXU with ``preferred_element_type=f32`` (bf16
-  operands stay MXU-native); block sizes default to 512×512 —
-  multiples of the (8,128) f32 / (16,128) bf16 tile shapes;
+  operands stay MXU-native — no f32 upcast; softmax state alone is
+  f32); block sizes default to 1024×1024 (swept fastest on v5e at
+  head_dim 128) — multiples of the (8,128) f32 / (16,128) bf16 tiles;
 - lse/delta tensors carry a trailing singleton lane axis
   ``(B, H, S, 1)``: Mosaic requires the last two block dims to be
   (8k, 128k) or equal to the array's;

@@ -225,3 +225,60 @@ def test_zero_length_record(ring):
     p.push(b"after", timeout=2)
     assert c.pop(timeout=1) == b""
     assert c.pop(timeout=1) == b"after"
+
+
+def test_wire_roundtrip_many_shapes():
+    """Property-style sweep: every wire-encodable (kind, dtype, shape)
+    combination decodes to columns identical to pack_columnar's."""
+    from tensorflowonspark_tpu.cluster.marker import (
+        decode_columnar_record,
+        encode_columnar_parts,
+        encode_rows_parts,
+        pack_columnar,
+    )
+
+    rng = np.random.RandomState(0)
+    dtypes = [np.uint8, np.int32, np.int64, np.float32, np.float64]
+    shapes = [(), (3,), (2, 5), (4, 1, 3)]
+    for dt in dtypes:
+        for shape in shapes:
+            for kind in ("tuple", "dict", "list"):
+                vals = [
+                    np.asarray(rng.rand(*shape) * 100).astype(dt)
+                    for _ in range(4)
+                ]
+                if kind == "tuple":
+                    rows = [(v, i) for i, v in enumerate(vals)]
+                elif kind == "list":
+                    rows = [[v, i] for i, v in enumerate(vals)]
+                else:
+                    rows = [
+                        {"v": v, "i": i} for i, v in enumerate(vals)
+                    ]
+                blk = pack_columnar(rows)
+                assert blk is not None, (dt, shape, kind)
+                for enc in (
+                    encode_columnar_parts(blk),
+                    encode_rows_parts(rows)
+                    if shape != () else None,  # scalars: pack path only
+                ):
+                    if enc is None:
+                        continue
+                    hdr, parts = enc[0], enc[1]
+                    rec = hdr + b"".join(
+                        np.ascontiguousarray(p).tobytes() for p in parts
+                    )
+                    out = decode_columnar_record(rec)
+                    assert out is not None, (dt, shape, kind)
+                    assert out.count == 4
+                    cols_b = (
+                        blk.columns.values()
+                        if isinstance(blk.columns, dict) else blk.columns
+                    )
+                    cols_o = (
+                        out.columns.values()
+                        if isinstance(out.columns, dict) else out.columns
+                    )
+                    for cb, co in zip(cols_b, cols_o):
+                        np.testing.assert_array_equal(cb, co)
+                        assert cb.dtype == co.dtype
