@@ -135,16 +135,22 @@ def render_run(cfg, argv, workdir="~/tfos-tpu"):
     inner = " ".join(shlex.quote(a) for a in argv)
     script = " && ".join(
         [
-            # worker 0's internal IP from the slice metadata
-            'COORD=$(curl -s -H "Metadata-Flavor: Google" '
+            # worker 0's internal IP + host count from the slice
+            # metadata (endpoints are comma-separated, one per host)
+            'EPTS=$(curl -s -H "Metadata-Flavor: Google" '
             '"http://metadata.google.internal/computeMetadata/v1/instance/'
-            'attributes/worker-network-endpoints" | cut -d, -f1 | '
-            "cut -d: -f3)",
+            'attributes/worker-network-endpoints")',
+            "COORD=$(echo $EPTS | cut -d, -f1 | cut -d: -f3)",
+            "NPROC=$(echo $EPTS | tr , \\\\n | wc -l)",
             'WID=$(curl -s -H "Metadata-Flavor: Google" '
             '"http://metadata.google.internal/computeMetadata/v1/instance/'
             'attributes/agent-worker-number")',
             "cd {0}".format(workdir),
-            "TFOS_COORDINATOR=$COORD:{0} TFOS_PROCESS_ID=$WID {1}".format(
+            # all three rendezvous variables: num_processes must be
+            # explicit — on hosts where JAX's cluster auto-detect finds
+            # nothing, initialize() with only process_id set raises
+            "TFOS_COORDINATOR=$COORD:{0} TFOS_PROCESS_ID=$WID "
+            "TFOS_NUM_PROCESSES=$NPROC {1}".format(
                 COORDINATOR_PORT, inner
             ),
         ]

@@ -98,6 +98,27 @@ class ColumnarBlock(Marker):
 COLUMNAR_MAGIC = b"TFOSCB1\x00"
 
 
+def _wire_header(kind, keys, count, dtypes, shapes):
+    """The shared wire-format header for both encoders: magic, u32
+    JSON length, JSON meta — space-padded so the data region starts
+    64-byte aligned (JSON tolerates trailing whitespace), keeping every
+    ``np.frombuffer`` column view aligned on the zero-copy decode path.
+    """
+    import json as _json
+    import struct
+
+    meta = {
+        "kind": kind,
+        "keys": keys,
+        "count": int(count),
+        "dtypes": dtypes,
+        "shapes": shapes,
+    }
+    hdr = _json.dumps(meta).encode("utf-8")
+    hdr += b" " * ((-(len(COLUMNAR_MAGIC) + 4 + len(hdr))) % 64)
+    return COLUMNAR_MAGIC + struct.pack("<I", len(hdr)) + hdr
+
+
 def encode_columnar_parts(block):
     """``(header_bytes, [column buffers])`` for ``ShmRing.pushv``, or
     ``None`` when the block is not wire-encodable (dict columns with
@@ -106,9 +127,6 @@ def encode_columnar_parts(block):
     Buffers are the blocks' own contiguous column arrays (no copy
     here); total record size is ``len(header) + sum(buffer sizes)``.
     """
-    import json as _json
-    import struct
-
     import numpy as np
 
     cols = block.columns
@@ -129,16 +147,10 @@ def encode_columnar_parts(block):
             ("list" if block._list_rows else "tuple")
         )
     arrs = [np.ascontiguousarray(a) for a in arrs]
-    meta = {
-        "kind": kind,
-        "keys": keys,
-        "count": int(block.count),
-        "dtypes": [a.dtype.str for a in arrs],
-        "shapes": [list(a.shape) for a in arrs],
-    }
-    hdr = _json.dumps(meta).encode("utf-8")
-    header = COLUMNAR_MAGIC + struct.pack("<I", len(hdr)) + hdr
-    return header, arrs
+    return _wire_header(
+        kind, keys, block.count,
+        [a.dtype.str for a in arrs], [list(a.shape) for a in arrs],
+    ), arrs
 
 
 def encode_rows_parts(rows):
@@ -157,9 +169,6 @@ def encode_rows_parts(rows):
     uniform dtype+shape; scalar numeric columns are stacked here (one
     tiny array), big ndarray columns are the win.
     """
-    import json as _json
-    import struct
-
     import numpy as np
 
     if not rows:
@@ -218,15 +227,7 @@ def encode_rows_parts(rows):
         # same fallback contract as pack_columnar
         return None
 
-    meta = {
-        "kind": kind,
-        "keys": keys,
-        "count": n,
-        "dtypes": dtypes,
-        "shapes": shapes,
-    }
-    hdr = _json.dumps(meta).encode("utf-8")
-    header = COLUMNAR_MAGIC + struct.pack("<I", len(hdr)) + hdr
+    header = _wire_header(kind, keys, n, dtypes, shapes)
     flat = [p for col in parts for p in col]
     total = len(header) + sum(p.nbytes for p in flat)
     return header, flat, total

@@ -765,8 +765,17 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     # None = not wire-encodable (non-string dict keys);
                     # such blocks ship pickled below.
                     enc2 = encode_columnar_parts(packed)
-                    if enc2 is not None and _push_record(enc2[0], enc2[1]):
-                        return
+                    if enc2 is not None:
+                        if _push_record(enc2[0], enc2[1]):
+                            return
+                        if len(rows) > 1:
+                            # known oversize from the exact wire total:
+                            # split now, don't materialize a multi-GB
+                            # pickle just to re-measure it
+                            mid = len(rows) // 2
+                            _ship(rows[:mid])
+                            _ship(rows[mid:])
+                            return
                 import pickle as _p
 
                 payload = _p.dumps(packed, protocol=5)

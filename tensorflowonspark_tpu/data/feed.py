@@ -41,7 +41,11 @@ from tensorflowonspark_tpu.cluster.marker import (
 def _decode_ring_record(rec):
     """Ring records are either the zero-pickle columnar wire format
     (magic-prefixed; decoded as zero-copy views over ``rec``) or a
-    pickled Block/row-list fallback."""
+    pickled Block/row-list fallback.  A zero-length record (the ring
+    supports them) is an empty row block — pickle.loads(b"") would
+    raise EOFError."""
+    if not rec:
+        return Block([])
     block = decode_columnar_record(rec)
     if block is not None:
         return block

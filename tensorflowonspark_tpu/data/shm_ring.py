@@ -128,8 +128,11 @@ class ShmRing(object):
                 return
             if rc == -2:
                 raise ValueError(
-                    "record of {0} bytes exceeds ring capacity".format(
-                        len(record)
+                    "record of {0} bytes exceeds {1}".format(
+                        len(record),
+                        "the 4GiB u32 frame limit"
+                        if len(record) > (1 << 32) - 5
+                        else "ring capacity",
                     )
                 )
             if rc == -3:
@@ -173,8 +176,11 @@ class ShmRing(object):
                     return
                 if rc == -2:
                     raise ValueError(
-                        "record of {0} bytes exceeds ring capacity".format(
-                            total
+                        "record of {0} bytes exceeds {1}".format(
+                            total,
+                            "the 4GiB u32 frame limit"
+                            if total > (1 << 32) - 5
+                            else "ring capacity",
                         )
                     )
                 if rc == -3:

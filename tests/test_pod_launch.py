@@ -50,9 +50,12 @@ def test_run_exports_rendezvous_env():
     )
     remote = cmd[cmd.index("--command") + 1]
     # the exported variables are exactly what
-    # mesh.distributed_init_from_env consumes
+    # mesh.distributed_init_from_env consumes — including the explicit
+    # process count (initialize() with only process_id raises on hosts
+    # where JAX's cluster auto-detect finds nothing)
     assert "TFOS_COORDINATOR=$COORD:%d" % tpu_pod.COORDINATOR_PORT in remote
     assert "TFOS_PROCESS_ID=$WID" in remote
+    assert "TFOS_NUM_PROCESSES=$NPROC" in remote
     assert "examples/mnist/mnist_spark.py" in remote
 
 
