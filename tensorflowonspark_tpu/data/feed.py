@@ -39,19 +39,24 @@ from tensorflowonspark_tpu.cluster.marker import (
 
 
 def _decode_ring_record(rec):
-    """Ring records are either the zero-pickle columnar wire format
-    (magic-prefixed; decoded as zero-copy views over ``rec``) or a
-    pickled Block/row-list fallback.  A zero-length record (the ring
-    supports them) is an empty row block — pickle.loads(b"") would
-    raise EOFError."""
+    """Decode one ring record to a PENDING element — a row list or a
+    :class:`ColumnarBlock` (the two shapes ``_set_pending`` consumers
+    index into).  Records are either the zero-pickle columnar wire
+    format (magic-prefixed; decoded as zero-copy views over ``rec``) or
+    a pickled Block/row-list fallback — a pickled ``Block`` must be
+    unwrapped to its rows here (the queue path unwraps in the fetch
+    loop; a raw Block is not subscriptable).  A zero-length record (the
+    ring supports them) is an empty row list — ``pickle.loads(b"")``
+    would raise EOFError."""
     if not rec:
-        return Block([])
+        return []
     block = decode_columnar_record(rec)
     if block is not None:
         return block
     import pickle
 
-    return pickle.loads(rec)
+    obj = pickle.loads(rec)
+    return obj.items if isinstance(obj, Block) else obj
 
 logger = logging.getLogger(__name__)
 

@@ -39,3 +39,54 @@ def pytest_configure(config):
         "spark: end-to-end tests against a real pyspark local-cluster "
         "(skipped when pyspark is not installed; CI runs them)",
     )
+
+
+def launch_two_workers(worker_src, tmp_path, extra_env=None, timeout=300):
+    """Run a two-rank JAX-distributed worker script (used by the
+    cross-process SP and PP tests): writes ``worker_src`` to disk,
+    launches rank 0/1 with a fresh coordinator port, file-backed logs
+    (a full PIPE would stall a chatty rank inside a collective), and a
+    try/finally kill so a crashed rank never leaks its peer blocked in
+    the Gloo handshake.  Asserts both exit 0 and returns their logs.
+    """
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    script = tmp_path / "dist_worker.py"
+    script.write_text(worker_src)
+    env = dict(
+        os.environ,
+        TFOS_REPO=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        **(extra_env or {}),
+    )
+    logs = [tmp_path / ("rank%d.log" % r) for r in (0, 1)]
+    handles = [open(p, "w") for p in logs]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port)],
+            env=env,
+            stdout=handles[r],
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for r in (0, 1)
+    ]
+    try:
+        for p in procs:
+            p.wait(timeout=timeout)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        for h in handles:
+            h.close()
+    outputs = [p.read_text() for p in logs]
+    for r, p in enumerate(procs):
+        assert p.returncode == 0, outputs[r][-2000:]
+    return outputs

@@ -1,0 +1,114 @@
+"""Pipeline parallelism across PROCESS boundaries.
+
+The pipeline claim mirrors the sequence-parallel one
+(test_distributed_ring.py): the microbatched stage loop's ``ppermute``
+handoffs must ride the inter-process backend (Gloo on CPU here,
+ICI/DCN on pods), not just one process's local devices.  Two JAX
+processes (2 CPU devices each) form one 4-stage ``pipe`` mesh, march
+microbatches through ``pp.pipeline`` under ``shard_map``, and the
+result must equal applying all layers sequentially in one process.
+"""
+
+import numpy as np
+
+from tests.conftest import launch_two_workers
+
+_WORKER = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address="127.0.0.1:%s" % port, num_processes=2, process_id=rank
+)
+sys.path.insert(0, os.environ["TFOS_REPO"])
+import functools
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from tensorflowonspark_tpu.parallel import pp
+
+dim, num_layers, stages, num_micro = 16, 8, 4, 4
+rng = np.random.RandomState(0)
+layers = [
+    {
+        "w": (rng.randn(dim, dim) * 0.3).astype(np.float32),
+        "b": (rng.randn(dim) * 0.1).astype(np.float32),
+    }
+    for _ in range(num_layers)
+]
+x = rng.randn(num_micro, 4, dim).astype(np.float32)
+
+def layer_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+stacked = pp.stack_stage_params(
+    [jax.tree.map(jnp.asarray, l) for l in layers], stages
+)
+mesh = Mesh(np.array(jax.devices()).reshape(4), ("pipe",))
+
+def place_stages(t):
+    # local shard = this process's 2 stages (stage dim is axis 0)
+    spec = NamedSharding(mesh, P("pipe"))
+    lo = rank * (stages // 2)
+    return jax.tree.map(
+        lambda a: jax.make_array_from_process_local_data(
+            spec, np.asarray(a)[lo : lo + stages // 2]
+        ),
+        t,
+    )
+
+micro = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P()), x  # replicated: full value on each process
+)
+
+stage = functools.partial(pp._layers_scan, layer_fn)
+
+@functools.partial(
+    jax.shard_map,
+    mesh=mesh,
+    in_specs=(jax.tree.map(lambda _: P("pipe"), stacked), P()),
+    out_specs=P(),
+    check_vma=False,
+)
+def run(stage_params, m):
+    return pp.pipeline(
+        stage, pp.local_stage(stage_params), m, axis_name="pipe"
+    )
+
+out = run(place_stages(stacked), micro)
+from jax.experimental import multihost_utils
+full = multihost_utils.process_allgather(out, tiled=True)
+np.save(os.environ["TFOS_OUT"] + ".%d.npy" % rank, np.asarray(full))
+print("rank", rank, "pipeline out", full.shape)
+"""
+
+
+def test_pipeline_across_two_processes(tmp_path):
+    out_base = str(tmp_path / "pp_out")
+    outputs = launch_two_workers(
+        _WORKER, tmp_path, extra_env={"TFOS_OUT": out_base}
+    )
+
+    # single-process sequential reference
+    dim, num_layers, num_micro = 16, 8, 4
+    rng = np.random.RandomState(0)
+    layers = [
+        {
+            "w": (rng.randn(dim, dim) * 0.3).astype(np.float32),
+            "b": (rng.randn(dim) * 0.1).astype(np.float32),
+        }
+        for _ in range(num_layers)
+    ]
+    x = rng.randn(num_micro, 4, dim).astype(np.float32)
+    h = x.reshape(-1, dim)
+    for lp in layers:
+        h = np.tanh(h @ lp["w"] + lp["b"])
+    ref = h.reshape(x.shape)
+
+    for r in (0, 1):
+        got = np.load("{0}.{1}.npy".format(out_base, r))
+        assert got.shape == ref.shape, (got.shape, outputs[r][-500:])
+        np.testing.assert_allclose(got, ref, atol=1e-5, rtol=1e-5)

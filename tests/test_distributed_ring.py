@@ -9,13 +9,9 @@ one 4-device ``seq`` mesh, runs both sharded attentions on global
 arrays, and checks the results against single-process dense attention.
 """
 
-import os
-import socket
-import subprocess
-import sys
-
 import numpy as np
-import pytest
+
+from tests.conftest import launch_two_workers
 
 _WORKER = r"""
 import os, sys
@@ -60,43 +56,10 @@ for name, fn in (
 
 
 def test_ring_attention_across_two_processes(tmp_path):
-    port = _free_port()
-    script = tmp_path / "ring_worker.py"
-    script.write_text(_WORKER)
     out_base = str(tmp_path / "ring_out")
-    env = dict(
-        os.environ,
-        TFOS_REPO=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        TFOS_OUT=out_base,
+    outputs = launch_two_workers(
+        _WORKER, tmp_path, extra_env={"TFOS_OUT": out_base}
     )
-    # file-backed output (a full PIPE would stall a chatty rank inside a
-    # collective); try/finally so a crashed/flaky rank never leaks its
-    # peer blocked in the Gloo handshake
-    logs = [tmp_path / ("rank%d.log" % r) for r in (0, 1)]
-    handles = [open(p, "w") for p in logs]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(r), str(port)],
-            env=env,
-            stdout=handles[r],
-            stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for r in (0, 1)
-    ]
-    try:
-        for p in procs:
-            p.wait(timeout=300)
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait(timeout=30)
-        for h in handles:
-            h.close()
-    outputs = [p.read_text() for p in logs]
-    for r, p in enumerate(procs):
-        assert p.returncode == 0, outputs[r][-2000:]
 
     # reference: dense attention, single process
     from tensorflowonspark_tpu.ops.attention import dot_attention
@@ -118,11 +81,3 @@ def test_ring_attention_across_two_processes(tmp_path):
             np.testing.assert_allclose(
                 got, ref, atol=1e-5, rtol=1e-5, err_msg=name
             )
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port

@@ -282,3 +282,52 @@ def test_wire_roundtrip_many_shapes():
                     for cb, co in zip(cols_b, cols_o):
                         np.testing.assert_array_equal(cb, co)
                         assert cb.dtype == co.dtype
+
+
+def test_ring_delivers_pickled_block_rows(ring):
+    # ragged/object rows can't wire-encode: the feeder pickles a Block
+    # onto the ring, and the consumer-side decoder must unwrap it to a
+    # row LIST (a raw Block is not subscriptable as a pending element)
+    import pickle
+
+    from tensorflowonspark_tpu.cluster.marker import Block
+    from tensorflowonspark_tpu.data.feed import _decode_ring_record
+
+    p, c = ring
+    rows = [np.zeros(3), np.zeros(5), "ragged"]  # mixed: pickle path
+    p.push(pickle.dumps(Block(rows), protocol=5), timeout=2)
+    out = _decode_ring_record(c.pop(timeout=2))
+    assert isinstance(out, list) and len(out) == 3
+    assert out[2] == "ragged"
+    assert _decode_ring_record(b"") == []
+
+
+def test_cluster_ragged_rows_through_shm_ring():
+    # end to end: rows that defeat the columnar wire format still
+    # arrive through the ring path (pickled Block fallback)
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster import manager as mgr_mod
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+    from tensorflowonspark_tpu.engine import LocalEngine
+
+    engine = LocalEngine(1, env={"TFOS_SHM_FEED": "1"})
+    try:
+        cluster = tpu_cluster.run(
+            engine,
+            _count_consume_fn,
+            args={},
+            num_executors=1,
+            input_mode=InputMode.SPARK,
+        )
+        # ragged second element -> pack_columnar/encode_rows_parts None
+        parts = [
+            [(i, list(range(i % 3 + 1))) for i in range(200)]
+            for _ in range(2)
+        ]
+        cluster.train(parts, num_epochs=1)
+        cluster.shutdown(timeout=120)
+        node = cluster.cluster_info[0]
+        m = mgr_mod.connect(tuple(node["addr"]), bytes.fromhex(node["authkey"]))
+        assert int(m.get("consumed")._getvalue() or 0) == 400
+    finally:
+        engine.stop()
