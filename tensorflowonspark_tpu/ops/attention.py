@@ -59,7 +59,8 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
 
 
 def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
-              seq_axis="seq", block_q=1024, block_k=1024):
+              seq_axis="seq", block_q=1024, block_k=1024,
+              ring_impl="flash"):
     """Dispatch to an attention implementation (see module docstring).
 
     ``ring``/``ulysses`` dispatch on ``mesh``: with ``mesh=None`` the
@@ -69,7 +70,10 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
     ``shard_map`` over the mesh's ``seq`` axis (do NOT pass a mesh from
     code that is itself under ``shard_map``).  ``flash`` runs the pallas
     kernels in interpret mode off-TPU so the same model runs in CPU
-    tests.
+    tests.  ``block_q``/``block_k`` bound the pallas tiles for both the
+    ``flash`` impl and ``ring``'s flash inner step; ``ring_impl``
+    selects ring's inner step (``"flash"`` or the dense einsum
+    numerics reference).
     """
     if impl not in _IMPLS:
         raise ValueError("unknown attention impl {0!r}; one of {1}".format(impl, _IMPLS))
@@ -87,10 +91,13 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
 
         if mesh is not None:
             return ring_attention_sharded(
-                q, k, v, mesh, causal=causal, scale=scale, axis_name=seq_axis
+                q, k, v, mesh, causal=causal, scale=scale,
+                axis_name=seq_axis, impl=ring_impl,
+                block_q=block_q, block_k=block_k,
             )
         return ring_attention(
-            q, k, v, causal=causal, scale=scale, axis_name=seq_axis
+            q, k, v, causal=causal, scale=scale, axis_name=seq_axis,
+            impl=ring_impl, block_q=block_q, block_k=block_k,
         )
     if impl == "ulysses":
         from tensorflowonspark_tpu.ops.ulysses import (

@@ -254,10 +254,22 @@ def _block_sizes(seq_len, block_q, block_k):
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
-    b, s, h, d = q.shape
-    bq, bk = _block_sizes(s, block_q, block_k)
     # [B,S,H,D] -> [B,H,S,D]: heads become a grid dim, seq stays blocked
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    out_t, lse = _fwd_core(qt, kt, vt, scale, causal, block_q, block_k)
+    out = jnp.swapaxes(out_t, 1, 2)
+    return out, (q, k, v, out, lse)
+
+
+def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None):
+    """Forward on ``[B,H,S,D]`` (transposed) tensors; returns
+    ``(out_t [B,H,S,D], lse [B,H,S,1])``.  Split out so callers that
+    loop over kv chunks (ring attention) can keep everything in the
+    kernel layout and transpose exactly once.  ``out_dtype`` lets such
+    callers take the partial outputs in f32 straight from the kernel's
+    f32 accumulator (one final downcast instead of one per chunk)."""
+    b, h, s, d = qt.shape
+    bq, bk = _block_sizes(s, block_q, block_k)
     grid = (b, h, s // bq, s // bk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
@@ -276,7 +288,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), out_dtype or qt.dtype),
             jax.ShapeDtypeStruct((b, h, s, 1), jnp.float32),
         ],
         scratch_shapes=[
@@ -287,13 +299,11 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         interpret=_interpret(),
         compiler_params=_compiler_params(),
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2), (q, k, v, jnp.swapaxes(out, 1, 2), lse)
+    return out, lse
 
 
 def _bwd(scale, causal, block_q, block_k, residuals, dout):
     q, k, v, out, lse = residuals
-    b, s, h, d = q.shape
-    bq, bk = _block_sizes(s, block_q, block_k)
     qt, kt, vt, ot, dot_ = (
         jnp.swapaxes(x, 1, 2) for x in (q, k, v, out, dout)
     )
@@ -301,6 +311,24 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
     delta = jnp.sum(
         dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
     )[..., None]  # [B,H,S,1] (lane axis; see lse layout note)
+    dqt, dkt, dvt = _bwd_core(
+        scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta
+    )
+    return (
+        jnp.swapaxes(dqt, 1, 2),
+        jnp.swapaxes(dkt, 1, 2),
+        jnp.swapaxes(dvt, 1, 2),
+    )
+
+
+def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta):
+    """Backward on ``[B,H,S,D]`` (transposed) tensors with the
+    loop-invariant ``delta`` precomputed by the caller; returns
+    ``(dqt, dkt, dvt)`` in the same layout.  Ring attention calls this
+    once per visiting chunk, hoisting delta and the q/dout transposes
+    out of its hop loop."""
+    b, h, s, d = qt.shape
+    bq, bk = _block_sizes(s, block_q, block_k)
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
@@ -320,7 +348,7 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
         out_specs=pl.BlockSpec(
             (1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)
         ),
-        out_shape=jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, h, s, d), qt.dtype),
         scratch_shapes=[_scratch((bq, d), jnp.float32)],
         interpret=_interpret(),
         compiler_params=_compiler_params(),
@@ -346,8 +374,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), kt.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), vt.dtype),
         ],
         scratch_shapes=[
             _scratch((bk, d), jnp.float32),
@@ -357,11 +385,7 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
         compiler_params=_compiler_params(),
     )(qt, kt, vt, dot_, lse, delta)
 
-    return (
-        jnp.swapaxes(dq, 1, 2),
-        jnp.swapaxes(dk, 1, 2),
-        jnp.swapaxes(dv, 1, 2),
-    )
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

@@ -7,39 +7,268 @@ sequence parallelism: absent').
 Design: each device holds a ``[B, S/P, H, D]`` shard of q/k/v.  The kv
 shard rotates around the ring via ``lax.ppermute`` (XLA lowers it onto
 the ICI torus as neighbor exchanges) while every device accumulates
-attention of its resident queries against each visiting kv chunk using
-the online-softmax rules — the distributed form of the flash-attention
-recurrence, so peak memory stays O(S/P) per chip and communication
-overlaps compute across scan steps.
+attention of its resident queries against each visiting kv chunk.
 
-Causality uses *global* positions (``device_index * S/P + local_pos``):
-chunks entirely in the future contribute nothing (their logits mask to
-the finite ``NEG_INF`` sentinel, so no NaNs and no special-casing),
-diagonal chunks mask elementwise.
+Two inner-step implementations:
 
-Differentiable: the step loop is a ``lax.scan`` (reverse-mode AD
-support; ``fori_loop`` has none) and ``ppermute``'s transpose is the
-inverse permutation, so gradients counter-rotate automatically.
+- ``impl="flash"`` (default): each visiting chunk is processed by the
+  pallas flash kernels from :mod:`.flash_attention` — the per-hop
+  working set is O(block), never the ``[B,H,S_local,S_local]`` logits
+  matrix, so the multi-chip path keeps exactly the O(block)-memory
+  property the single-chip kernel was built for.  Per hop the kernel
+  returns the chunk's normalized partial output plus its log-sum-exp;
+  partials merge across hops by the standard lse rules.  The backward
+  pass is a hand-written second ring pass (``jax.custom_vjp``): dk/dv
+  accumulators travel around the ring *with* their kv chunks and are
+  home after P hops, while each hop's per-chunk gradients come from the
+  same pallas backward kernels the single-chip path uses, driven by the
+  ring-global lse/delta (the FlashAttention-2 recipe distributes
+  unchanged because ``p_ij = exp(s_ij - lse_global)``).
+- ``impl="dense"``: the original online-softmax einsum step; kept as
+  the numerics reference and for shapes the kernels cannot tile.
+
+Causality never needs dynamic position arithmetic in-kernel: a visiting
+chunk is entirely in the past (full attention), the resident diagonal
+(local causal mask — global and local masks coincide because q and k
+share the chunk offset), or entirely in the future (skipped via
+``lax.switch``, so no MXU work is wasted on it).
 
 Intended call sites: inside user ``shard_map`` code, or via
 :func:`..attention.attention` with a mesh (which wraps the shard_map).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tensorflowonspark_tpu.ops.flash_attention import (
+    _bwd_core,
+    _fit_block,
+    _fwd_core,
+)
+
 NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq"):
+def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
+                   impl="flash", block_q=1024, block_k=1024):
     """Attention over sequence shards; call under ``shard_map``.
 
     Args:
       q, k, v: local shards ``[B, S_local, H, D]`` of a global
         ``[B, S, H, D]`` tensor sharded on dim 1 over ``axis_name``.
+      impl: ``"flash"`` (pallas blockwise inner step, O(block) memory
+        per hop) or ``"dense"`` (einsum inner step, O(S_local²) logits
+        per hop; numerics reference).
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
+    if impl == "flash":
+        # custom_vjp nondiff args must be concrete, and the kernels need
+        # a lane-aligned block dividing S_local; fall back to the dense
+        # inner step when either doesn't hold so the pre-flash contract
+        # (traced scale, arbitrary shard lengths) keeps working
+        s_val = scale if scale is not None else q.shape[-1] ** -0.5
+        tileable = (
+            _fit_block(block_q, q.shape[1]) is not None
+            and _fit_block(block_k, q.shape[1]) is not None
+        )
+        if tileable and not isinstance(s_val, jax.core.Tracer):
+            return _ring_flash(
+                q, k, v, float(s_val), bool(causal), int(block_q),
+                int(block_k), axis_name,
+            )
+        impl = "dense"
+    if impl == "dense":
+        return _ring_dense(q, k, v, causal=causal, scale=scale,
+                           axis_name=axis_name)
+    raise ValueError(
+        "unknown ring attention impl {0!r}; options: flash, dense".format(
+            impl
+        )
+    )
+
+
+# --------------------------------------------------------------------------
+# flash inner step: pallas blockwise kernels per visiting chunk
+# --------------------------------------------------------------------------
+# Everything inside the hop loops stays in the kernels' [B,H,S,D]
+# layout — q/dout/out transpose exactly once per pass, and the
+# loop-invariant delta is computed once, not per hop.
+
+def _merge_partial(o, lse, o_c, lse_c):
+    """Fold a chunk's normalized partial (o_c, lse_c) into the running
+    (o, lse); all in the transposed layout (o [B,H,S,D] f32, lse
+    [B,H,S,1] f32 — the flash kernels' trailing lane axis)."""
+    m = jnp.maximum(lse, lse_c)
+    w = jnp.exp(lse - m)
+    w_c = jnp.exp(lse_c - m)
+    tot = w + w_c
+    lse_new = m + jnp.log(tot)
+    return o * (w / tot) + o_c * (w_c / tot), lse_new
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_flash(q, k, v, scale, causal, block_q, block_k, axis_name):
+    out, _ = _ring_flash_fwd(
+        q, k, v, scale, causal, block_q, block_k, axis_name
+    )
+    return out
+
+
+def _causal_branch(my_idx, t, p):
+    """0 = future chunk (skip), 1 = resident diagonal (local causal
+    mask — equals the global mask because q and k share the chunk
+    offset), 2 = past chunk (full attention)."""
+    src = (my_idx - t) % p
+    return jnp.where(src > my_idx, 0, jnp.where(src == my_idx, 1, 2))
+
+
+def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name):
+    p = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    b, s_local, h, d = q.shape
+
+    qt = jnp.swapaxes(q, 1, 2)  # [B,H,S,D], once for all hops
+    o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32)
+
+    def _chunk(o, lse, kt_cur, vt_cur, chunk_causal):
+        # f32 partials straight from the kernel accumulator: the output
+        # rounds to q.dtype exactly once (after the scan), matching the
+        # single-chip kernel's precision
+        o_c, lse_c = _fwd_core(
+            qt, kt_cur, vt_cur, scale, chunk_causal, block_q, block_k,
+            out_dtype=jnp.float32,
+        )
+        return _merge_partial(o, lse, o_c, lse_c)
+
+    def _skip(args):
+        o, lse, _, _ = args
+        return o, lse
+
+    def _diag(args):
+        o, lse, kt_cur, vt_cur = args
+        return _chunk(o, lse, kt_cur, vt_cur, True)
+
+    def _full(args):
+        o, lse, kt_cur, vt_cur = args
+        return _chunk(o, lse, kt_cur, vt_cur, False)
+
+    def step(carry, t):
+        o, lse, kt_cur, vt_cur = carry
+        if causal:
+            o, lse = lax.switch(
+                _causal_branch(my_idx, t, p),
+                (_skip, _diag, _full),
+                (o, lse, kt_cur, vt_cur),
+            )
+        else:
+            o, lse = _full((o, lse, kt_cur, vt_cur))
+        kt_nxt = lax.ppermute(kt_cur, axis_name, perm)
+        vt_nxt = lax.ppermute(vt_cur, axis_name, perm)
+        return (o, lse, kt_nxt, vt_nxt), None
+
+    kt0 = jnp.swapaxes(k, 1, 2)
+    vt0 = jnp.swapaxes(v, 1, 2)
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, kt0, vt0), jnp.arange(p))
+    out = jnp.swapaxes(o, 1, 2).astype(q.dtype)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, res, dout):
+    """Second ring pass: dk/dv accumulators rotate with their kv chunks
+    (home again after P hops); per-chunk gradients come from the flash
+    backward kernels driven by the ring-global (out, lse)."""
+    q, k, v, out, lse = res
+    p = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    f32 = jnp.float32
+    qt = jnp.swapaxes(q, 1, 2)
+    dot_ = jnp.swapaxes(dout, 1, 2)
+    ot = jnp.swapaxes(out, 1, 2)
+    # loop-invariant softmax-jacobian correction, computed once
+    delta = jnp.sum(
+        dot_.astype(f32) * ot.astype(f32), axis=-1
+    )[..., None]  # [B,H,S,1]
+
+    dq0 = jnp.zeros(qt.shape, f32)
+    dk0 = jnp.zeros(qt.shape, f32)
+    dv0 = jnp.zeros(qt.shape, f32)
+
+    def _chunk_grads(kt_cur, vt_cur, chunk_causal):
+        dq_c, dk_c, dv_c = _bwd_core(
+            scale, chunk_causal, block_q, block_k,
+            qt, kt_cur, vt_cur, dot_, lse, delta,
+        )
+        return dq_c.astype(f32), dk_c.astype(f32), dv_c.astype(f32)
+
+    def _skip(args):
+        kt_cur, vt_cur = args
+        return (
+            jnp.zeros(qt.shape, f32),
+            jnp.zeros(kt_cur.shape, f32),
+            jnp.zeros(vt_cur.shape, f32),
+        )
+
+    def _diag(args):
+        return _chunk_grads(*args, True)
+
+    def _full(args):
+        return _chunk_grads(*args, False)
+
+    def step(carry, t):
+        dq, kt_cur, vt_cur, dk_cur, dv_cur = carry
+        if causal:
+            dq_c, dk_c, dv_c = lax.switch(
+                _causal_branch(my_idx, t, p),
+                (_skip, _diag, _full),
+                (kt_cur, vt_cur),
+            )
+        else:
+            dq_c, dk_c, dv_c = _full((kt_cur, vt_cur))
+        dq = dq + dq_c
+        dk_cur = dk_cur + dk_c
+        dv_cur = dv_cur + dv_c
+        kt_cur, vt_cur, dk_cur, dv_cur = (
+            lax.ppermute(x, axis_name, perm)
+            for x in (kt_cur, vt_cur, dk_cur, dv_cur)
+        )
+        return (dq, kt_cur, vt_cur, dk_cur, dv_cur), None
+
+    kt0 = jnp.swapaxes(k, 1, 2)
+    vt0 = jnp.swapaxes(v, 1, 2)
+    (dq, _, _, dk, dv), _ = lax.scan(
+        step, (dq0, kt0, vt0, dk0, dv0), jnp.arange(p)
+    )
+    return (
+        jnp.swapaxes(dq, 1, 2).astype(q.dtype),
+        jnp.swapaxes(dk, 1, 2).astype(k.dtype),
+        jnp.swapaxes(dv, 1, 2).astype(v.dtype),
+    )
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+# --------------------------------------------------------------------------
+# dense inner step (numerics reference)
+# --------------------------------------------------------------------------
+
+def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq"):
+    """Original online-softmax einsum inner step — materializes the
+    ``[B, S_local, H, S_local]`` logits per visiting chunk.  Kept as the
+    numerics reference for the flash inner step.
+
+    Causality uses *global* positions (``device_index * S/P +
+    local_pos``): future chunks mask to the finite ``NEG_INF`` sentinel
+    (no NaNs), diagonal chunks mask elementwise.  Differentiable via
+    ``lax.scan`` AD; ``ppermute``'s transpose is the inverse
+    permutation, so gradients counter-rotate automatically."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     p = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -90,7 +319,8 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq"):
 
 
 def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None,
-                           axis_name="seq"):
+                           axis_name="seq", impl="flash",
+                           block_q=1024, block_k=1024):
     """Global-array entry point: wraps :func:`ring_attention` in a
     ``shard_map`` over ``mesh``'s ``axis_name`` (sequence dim sharded,
     batch optionally on the data axes).  Usable directly inside jit."""
@@ -103,7 +333,8 @@ def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None,
 
     def _local(ql, kl, vl):
         return ring_attention(
-            ql, kl, vl, causal=causal, scale=scale, axis_name=axis_name
+            ql, kl, vl, causal=causal, scale=scale, axis_name=axis_name,
+            impl=impl, block_q=block_q, block_k=block_k,
         )
 
     return jax.shard_map(

@@ -92,27 +92,75 @@ class TestFlashAttention:
 
 
 class TestRingAttention:
+    @pytest.mark.parametrize("impl", ["flash", "dense"])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_dot(self, causal):
+    def test_matches_dot(self, causal, impl):
         mesh = build_mesh({"data": 2, "seq": 4})
         q, k, v = _qkv(b=2, s=64, h=2, d=16)
         ref = dot_attention(q, k, v, causal=causal)
-        out = ring_attention_sharded(q, k, v, mesh, causal=causal)
+        out = ring_attention_sharded(q, k, v, mesh, causal=causal, impl=impl)
         np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
 
-    def test_gradients_match_dot(self):
+    @pytest.mark.parametrize("impl", ["flash", "dense"])
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_match_dot(self, causal, impl):
         mesh = build_mesh({"data": 2, "seq": 4})
         q, k, v = _qkv(b=2, s=32, h=2, d=16)
         ref = _grads(
-            lambda q, k, v: dot_attention(q, k, v, causal=True), q, k, v
+            lambda q, k, v: dot_attention(q, k, v, causal=causal), q, k, v
         )
         got = _grads(
             lambda q, k, v: ring_attention_sharded(
-                q, k, v, mesh, causal=True
+                q, k, v, mesh, causal=causal, impl=impl
             ),
             q, k, v,
         )
         for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r, atol=1e-4, rtol=1e-4)
+
+    def test_flash_falls_back_to_dense_for_traced_scale(self):
+        # pre-flash contract: scale may be a traced value under jit
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=32, h=2, d=16)
+        out = jax.jit(
+            lambda s: ring_attention_sharded(q, k, v, mesh, scale=s)
+        )(jnp.float32(0.125))
+        ref = dot_attention(q, k, v, scale=0.125)
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_flash_falls_back_to_dense_for_untileable_shard(self):
+        # S_local=36 has no lane-aligned block divisor at block 32 —
+        # the dense inner step must take over instead of raising
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=144, h=2, d=16)
+        out = ring_attention_sharded(
+            q, k, v, mesh, block_q=32, block_k=32
+        )
+        ref = dot_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_flash_inner_blocks_smaller_than_chunk(self):
+        # S_local=32 with 16x16 blocks: the inner step really tiles
+        # (4 blocks per visiting chunk), not one block == one chunk
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=128, h=2, d=16)
+        ref = dot_attention(q, k, v, causal=True)
+        out = ring_attention_sharded(
+            q, k, v, mesh, causal=True, impl="flash",
+            block_q=16, block_k=16,
+        )
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+        got = _grads(
+            lambda q, k, v: ring_attention_sharded(
+                q, k, v, mesh, causal=True, impl="flash",
+                block_q=16, block_k=16,
+            ),
+            q, k, v,
+        )
+        refg = _grads(
+            lambda q, k, v: dot_attention(q, k, v, causal=True), q, k, v
+        )
+        for g, r in zip(got, refg):
             np.testing.assert_allclose(g, r, atol=1e-4, rtol=1e-4)
 
     def test_under_jit(self):
