@@ -68,7 +68,9 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
         # custom_vjp nondiff args must be concrete, and the kernels need
         # a lane-aligned block dividing S_local; fall back to the dense
         # inner step when either doesn't hold so the pre-flash contract
-        # (traced scale, arbitrary shard lengths) keeps working
+        # (traced scale, arbitrary shard lengths) keeps working.  Head
+        # dim needs no gate: Mosaic compiles arbitrary D via relayout
+        # (fwd+bwd verified on TPU v5e down to D=20 non-aligned).
         s_val = scale if scale is not None else q.shape[-1] ** -0.5
         tileable = (
             _fit_block(block_q, q.shape[1]) is not None

@@ -184,6 +184,9 @@ class PipelineTrainer(object):
         data_axes=("data", "fsdp"),
         schedule="gpipe",
         interleave=2,
+        stage_specs=None,
+        first_specs=None,
+        last_specs=None,
     ):
         """``schedule``: ``"gpipe"`` (fwd scan + AD backward; activation
         memory O(M) microbatches/stage), ``"1f1b"`` (hand-scheduled
@@ -196,7 +199,18 @@ class PipelineTrainer(object):
         :func:`stack_stage_params` — cutting the bubble fraction by
         ~1/v; see parallel/pp_schedule.py for the schedule tables and
         their measured properties).  ``interleave`` is only read for
-        the interleaved schedule."""
+        the interleaved schedule.
+
+        ``stage_specs``/``first_specs``/``last_specs`` override the
+        default param PartitionSpecs (``P(pipe)`` for stages, fully
+        replicated for first/last) — pass a pytree of specs matching
+        the corresponding subtree to shard stage weights on additional
+        mesh axes (PP x TP: e.g. ``P(pipe, None, None, "model")``
+        column-parallel and ``P(pipe, None, "model", None)``
+        row-parallel, with ``layer_fn`` using
+        :func:`~tensorflowonspark_tpu.parallel.tp.tp_copy` /
+        :func:`~tensorflowonspark_tpu.parallel.tp.tp_reduce` around its
+        sharded matmuls)."""
         if mesh.shape.get(axis_name, 1) < 2:
             raise ValueError(
                 "PipelineTrainer needs a mesh with a >=2-wide {0!r} axis, "
@@ -221,6 +235,29 @@ class PipelineTrainer(object):
         self.data_axes = tuple(
             a for a in data_axes if mesh.shape.get(a, 1) > 1
         )
+        if stage_specs is not None:
+            # a spec that forgets the leading pipe dim leaves the stage
+            # stack replicated, and local_stage's x[0] would then run
+            # stage 0's weights everywhere — silently wrong numerics
+            for spec in jax.tree.leaves(
+                stage_specs, is_leaf=lambda n: isinstance(n, P)
+            ):
+                first = spec[0] if len(spec) else None
+                if not (
+                    first == axis_name
+                    or (isinstance(first, tuple) and axis_name in first)
+                ):
+                    raise ValueError(
+                        "every stage_specs leaf must shard its leading "
+                        "(stage-stack) dim on {0!r}; got {1}".format(
+                            axis_name, spec
+                        )
+                    )
+        self.stage_specs = (
+            stage_specs if stage_specs is not None else P(axis_name)
+        )
+        self.first_specs = first_specs if first_specs is not None else P()
+        self.last_specs = last_specs if last_specs is not None else P()
         if schedule == "gpipe":
             self._step = self._build_step()
         elif schedule == "1f1b":
@@ -230,20 +267,31 @@ class PipelineTrainer(object):
 
     # -- sharding ------------------------------------------------------
 
-    def _param_shardings(self, params):
-        pipe = self.axis_name
-
-        def _stage_spec(x):
-            return NamedSharding(self.mesh, P(pipe))
-
+    def _spec_tree(self):
+        """The shard_map param specs: default P(pipe)/replicated, or the
+        caller's per-subtree overrides (PP x TP)."""
         return {
-            "stages": jax.tree.map(_stage_spec, params["stages"]),
-            "first": jax.tree.map(
-                lambda x: NamedSharding(self.mesh, P()), params["first"]
-            ),
-            "last": jax.tree.map(
-                lambda x: NamedSharding(self.mesh, P()), params["last"]
-            ),
+            "stages": self.stage_specs,
+            "first": self.first_specs,
+            "last": self.last_specs,
+        }
+
+    def _param_shardings(self, params):
+        def _expand(subtree, spec):
+            if isinstance(spec, P):
+                return jax.tree.map(
+                    lambda x: NamedSharding(self.mesh, spec), subtree
+                )
+            # multi-tree map: `subtree` (arrays) fixes the structure and
+            # `spec` is flattened up to it, so P leaves survive intact
+            return jax.tree.map(
+                lambda x, s: NamedSharding(self.mesh, s), subtree, spec
+            )
+
+        specs = self._spec_tree()
+        return {
+            key: _expand(params[key], specs[key])
+            for key in ("stages", "first", "last")
         }
 
     def create_state(self, params):
@@ -284,11 +332,7 @@ class PipelineTrainer(object):
         mesh = self.mesh
 
         batch_spec = P(data_axes if data_axes else None)
-        param_specs = {
-            "stages": P(pipe),
-            "first": P(),
-            "last": P(),
-        }
+        param_specs = self._spec_tree()
 
         def local_loss(params, batch):
             """Runs under shard_map: params['stages'] is the local stage,
@@ -415,7 +459,7 @@ class PipelineTrainer(object):
         stash_slots = min(p, m)
 
         batch_spec = P(data_axes if data_axes else None)
-        param_specs = {"stages": P(pipe), "first": P(), "last": P()}
+        param_specs = self._spec_tree()
 
         stage_fn = functools.partial(_layers_scan, layer_fn)
 
@@ -632,7 +676,7 @@ class PipelineTrainer(object):
         qb = geom["bwd_slots"]
 
         batch_spec = P(data_axes if data_axes else None)
-        param_specs = {"stages": P(pipe), "first": P(), "last": P()}
+        param_specs = self._spec_tree()
 
         stage_fn = functools.partial(_layers_scan, layer_fn)
 

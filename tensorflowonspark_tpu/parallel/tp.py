@@ -14,12 +14,66 @@ up-projection column-wise (``ffn_in``), the down-projection row-wise
 (``ffn_out``), attention heads across ``model`` — one psum per block.
 """
 
+import functools
+
+import jax
+from jax import lax
+
 from tensorflowonspark_tpu.parallel.mesh import AXIS_TENSOR  # noqa: F401
 from tensorflowonspark_tpu.parallel.sharding import (  # noqa: F401
     apply_rules,
     param_specs,
     shard_params,
 )
+
+
+# -- manual-mode TP collectives (Megatron's f/g operators) -----------------
+#
+# Inside ``shard_map`` code (where the PipelineTrainer schedules run) the
+# GSPMD rule machinery above doesn't apply — TP needs its collectives
+# written out, and under ``check_vma=False`` a bare ``lax.psum`` inside
+# the differentiated region transposes to another psum (scaling
+# gradients by the axis size).  These two custom-vjp ops pin the exact
+# Megatron semantics instead: ``tp_copy`` enters a TP region (identity
+# forward, gradient all-reduce — the input is replicated across
+# ``model``, so each shard's contribution to its cotangent must sum);
+# ``tp_reduce`` exits it (all-reduce forward, identity backward — the
+# output becomes replicated, so the replicated cotangent passes
+# through).  Column-parallel matmul, then row-parallel, then one
+# ``tp_reduce``: one psum per block, gradients exact.
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_copy(x, axis_name="model"):
+    """Enter a tensor-parallel region: identity fwd, psum bwd."""
+    return x
+
+
+def _tp_copy_fwd(x, axis_name):
+    return x, None
+
+
+def _tp_copy_bwd(axis_name, _, ct):
+    return (lax.psum(ct, axis_name),)
+
+
+tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis_name="model"):
+    """Exit a tensor-parallel region: psum fwd, identity bwd."""
+    return lax.psum(x, axis_name)
+
+
+def _tp_reduce_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _tp_reduce_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
 
 #: Megatron-style rule set for the model zoo's logical axis names:
 #: embed stays replicated across ``model``; FFN in/out split col/row;

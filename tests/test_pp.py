@@ -163,12 +163,120 @@ class TestPipelineTrainer:
                 err_msg=str(path),
             )
 
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pp_x_tp_loss_and_grads_match_reference(self, schedule):
+        # 3-axis composition: pipeline stages whose inner matmuls are
+        # tensor-parallel on the ``model`` axis (Megatron column/row
+        # pair with tp_copy/tp_reduce), under data parallelism —
+        # mesh {data:2, pipe:2, model:2}.  Numerics must equal the
+        # sequential single-device reference exactly.
+        from tensorflowonspark_tpu.parallel.tp import tp_copy, tp_reduce
+
+        dim, hid, num_layers, stages = 8, 16, 4, 2
+        rng = np.random.RandomState(11)
+
+        def mk_layer():
+            return {
+                "w1": jnp.asarray(rng.randn(dim, hid).astype(np.float32) * 0.3),
+                "w2": jnp.asarray(rng.randn(hid, dim).astype(np.float32) * 0.3),
+                "b": jnp.asarray(rng.randn(dim).astype(np.float32) * 0.1),
+            }
+
+        layers = [mk_layer() for _ in range(num_layers)]
+
+        def tp_layer_fn(lp, h):
+            z = jnp.tanh(tp_copy(h, "model") @ lp["w1"])
+            return tp_reduce(z @ lp["w2"], "model") + lp["b"]
+
+        def ref_layer_fn(lp, h):
+            return jnp.tanh(h @ lp["w1"]) @ lp["w2"] + lp["b"]
+
+        mesh = build_mesh({"data": 2, "pipe": 2, "model": 2})
+        params = {
+            "stages": pp.stack_stage_params(layers, stages),
+            "first": {
+                "w_in": jnp.asarray(rng.randn(dim, dim).astype(np.float32) * 0.3)
+            },
+            "last": {
+                "w_out": jnp.asarray(rng.randn(dim, 1).astype(np.float32) * 0.3)
+            },
+        }
+        stage_specs = {
+            "w1": P("pipe", None, None, "model"),  # column-parallel
+            "w2": P("pipe", None, "model", None),  # row-parallel
+            "b": P("pipe"),
+        }
+
+        def first_fn(p, batch):
+            return batch["x"] @ p["w_in"]
+
+        def last_fn(p, h, batch):
+            pred = (h @ p["w_out"])[:, 0]
+            loss = jnp.mean((pred - batch["y"]) ** 2)
+            return loss, {"mse": loss}
+
+        def ref_loss(params, batch):
+            h = batch["x"] @ params["first"]["w_in"]
+            p_, l_ = jax.tree.leaves(params["stages"])[0].shape[:2]
+            for i in range(p_):
+                for j in range(l_):
+                    h = ref_layer_fn(
+                        jax.tree.map(lambda x: x[i, j], params["stages"]), h
+                    )
+            pred = (h @ params["last"]["w_out"])[:, 0]
+            return jnp.mean((pred - batch["y"]) ** 2)
+
+        batch = {
+            "x": np.random.RandomState(12).randn(16, dim).astype(np.float32),
+            "y": np.random.RandomState(13).randn(16).astype(np.float32),
+        }
+        trainer = pp.PipelineTrainer(
+            tp_layer_fn, first_fn, last_fn, optax.sgd(1.0), mesh,
+            num_microbatches=4, schedule=schedule,
+            stage_specs=stage_specs,
+        )
+        state = trainer.create_state(jax.tree.map(jnp.asarray, params))
+        old_params = jax.tree.map(np.asarray, state.params)
+        new_state, metrics = trainer.step(state, batch)
+
+        ref_l, ref_g = jax.value_and_grad(ref_loss)(
+            params, jax.tree.map(jnp.asarray, batch)
+        )
+        np.testing.assert_allclose(
+            float(metrics["loss"]), float(ref_l), atol=1e-5, rtol=1e-5
+        )
+        got_g = jax.tree.map(
+            lambda old, new: old - np.asarray(new), old_params,
+            new_state.params,
+        )
+        for path, g in jax.tree_util.tree_flatten_with_path(got_g)[0]:
+            r = functools.reduce(
+                lambda t, k: t[k.key if hasattr(k, "key") else k.idx],
+                path,
+                ref_g,
+            )
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(r), atol=1e-4, rtol=1e-4,
+                err_msg=str(path),
+            )
+
     def test_requires_pipe_axis(self):
         mesh = build_mesh({"data": 8})
         with pytest.raises(ValueError, match="pipe"):
             pp.PipelineTrainer(
                 _layer_fn, lambda p, b: b["x"], lambda p, h, b: (0.0, {}),
                 optax.sgd(1.0), mesh, num_microbatches=2,
+            )
+
+    def test_stage_specs_must_lead_with_pipe(self):
+        # forgetting the leading pipe dim would run stage 0's weights on
+        # every stage with no shape error — must be rejected up front
+        mesh = build_mesh({"pipe": 2, "model": 2, "data": 2})
+        with pytest.raises(ValueError, match="leading"):
+            pp.PipelineTrainer(
+                _layer_fn, lambda p, b: b["x"], lambda p, h, b: (0.0, {}),
+                optax.sgd(1.0), mesh, num_microbatches=2,
+                stage_specs={"w": P(None, None, None, "model")},
             )
 
     def test_training_reduces_loss(self):
