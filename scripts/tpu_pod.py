@@ -41,6 +41,7 @@ import dataclasses
 import shlex
 import subprocess
 import sys
+from typing import Optional
 
 #: coordinator port for jax.distributed rendezvous (any free port; one
 #: constant so `run` and the in-framework bootstrap agree)
@@ -55,12 +56,12 @@ class PodConfig:
     zone: str
     accelerator: str = "v5litepod-16"
     version: str = "v2-alpha-tpuv5-lite"
-    project: str = None  # gcloud default when None
+    project: Optional[str] = None  # gcloud default when None
 
 
 def _gcloud_base(cfg):
-    cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
-    return cmd
+    del cfg  # project/zone ride in _common_flags
+    return ["gcloud", "compute", "tpus", "tpu-vm"]
 
 
 def _common_flags(cfg):

@@ -246,23 +246,44 @@ def decode_columnar_record(buf):
     if len(buf) < 12 or bytes(buf[:8]) != COLUMNAR_MAGIC:
         return None
     (hlen,) = struct.unpack("<I", buf[8:12])
-    meta = _json.loads(bytes(buf[12:12 + hlen]))
+    # a truncated or corrupt magic-prefixed record must take the pickle
+    # fallback like every other malformed input, not crash the feed:
+    # bound the declared header and every column against len(buf)
+    if 12 + hlen > len(buf):
+        return None
+    try:
+        meta = _json.loads(bytes(buf[12:12 + hlen]))
+        dtypes, shapes = meta["dtypes"], meta["shapes"]
+        kind, count = meta["kind"], meta["count"]
+        keys = meta.get("keys")
+    except (ValueError, KeyError, TypeError):
+        return None
+    if kind not in ("dict", "tuple", "list", "scalar"):
+        return None
+    if kind == "dict" and (
+        not isinstance(keys, list) or len(keys) != len(dtypes)
+    ):
+        return None
     off = 12 + hlen
     arrs = []
-    for dt, shape in zip(meta["dtypes"], meta["shapes"]):
-        dtype = np.dtype(dt)
-        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        a = np.frombuffer(buf, dtype=dtype, count=n, offset=off)
-        arrs.append(a.reshape(shape))
-        off += n * dtype.itemsize
-    kind = meta["kind"]
+    try:
+        for dt, shape in zip(dtypes, shapes):
+            dtype = np.dtype(dt)
+            n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+            if n < 0 or off + n * dtype.itemsize > len(buf):
+                return None
+            a = np.frombuffer(buf, dtype=dtype, count=n, offset=off)
+            arrs.append(a.reshape(shape))
+            off += n * dtype.itemsize
+    except (TypeError, ValueError):
+        return None
     if kind == "dict":
-        cols = dict(zip(meta["keys"], arrs))
+        cols = dict(zip(keys, arrs))
     else:
         cols = tuple(arrs)
     return ColumnarBlock(
         cols,
-        meta["count"],
+        count,
         _scalar=kind == "scalar",
         _list_rows=kind == "list",
     )

@@ -303,6 +303,35 @@ def _get_manager(cluster_info, executor_id):
     )
 
 
+def _manager_first_call(cluster_info, executor_id, call):
+    """First manager RPC with one evict+reconnect retry.
+
+    The cached-connection probe in :func:`_get_manager` is a bare TCP
+    connect, which a wedged manager process — or an unrelated server
+    that reused the port after a restart — passes; the first
+    registered-method call is the authoritative liveness/authkey check.
+    On its failure the cached entry is evicted and the connection
+    rebuilt once, so a stale cache costs one retry instead of failing
+    the feed task mid-partition."""
+    from multiprocessing import AuthenticationError
+
+    mgr = _get_manager(cluster_info, executor_id)
+    try:
+        return mgr, call(mgr)
+    except (OSError, EOFError, AuthenticationError) as e:
+        logger.warning(
+            "cached manager connection failed first RPC (%s); "
+            "reconnecting", e,
+        )
+        for node in cluster_info:
+            if node["executor_id"] == executor_id:
+                _MANAGER_CONNS.pop(
+                    (tuple(node["addr"]), node["authkey"]), None
+                )
+        mgr = _get_manager(cluster_info, executor_id)
+        return mgr, call(mgr)
+
+
 def _local_executor_workdir():
     from tensorflowonspark_tpu.engine import TFOS_EXECUTOR_WORKDIR
 
@@ -432,10 +461,12 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
         # SURVEY.md §7 'C++ ring buffer' staging path.  Created here so
         # it lives as long as the executor process; feeders and the
         # compute process attach by name via the manager kv.
+        # "force" additionally pins every block to the ring, bypassing
+        # the feeder's small-row queue policy (see train()._use_ring).
         if (
             not is_service_node
             and input_mode == InputMode.SPARK  # only the feed path uses it
-            and os.environ.get("TFOS_SHM_FEED") == "1"
+            and os.environ.get("TFOS_SHM_FEED") in ("1", "force")
         ):
             from tensorflowonspark_tpu.data import shm_ring
 
@@ -675,8 +706,11 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     (reference: TFSparkNode.py:436-503)."""
 
     def _train(iterator):
-        mgr = _get_manager(cluster_info, _local_executor_id())
-        state = str(mgr.get("state")._getvalue())
+        mgr, state = _manager_first_call(
+            cluster_info,
+            _local_executor_id(),
+            lambda m: str(m.get("state")._getvalue()),
+        )
         logger.info("connected to node manager, state=%s", state)
         terminating = state == "terminating"
         queue = mgr.get_queue(qname)
@@ -719,21 +753,66 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         # push error
         wire_cap = min(ring.capacity, (1 << 32) - 4) if ring else 0
 
+        def _row_vals(first):
+            return (
+                first.values() if isinstance(first, dict)
+                else first if isinstance(first, (tuple, list))
+                else (first,)
+            )
+
         def _row_is_large(first):
             """Cheap first-row probe: the per-row scatter-gather encode
             only pays off when a row carries a >=64KB array (images);
             kilobyte rows ship faster as one stacked-column copy, and
             this probe avoids running the O(rows) encode just to
             discard it."""
-            vals = (
-                first.values() if isinstance(first, dict)
-                else first if isinstance(first, (tuple, list))
-                else (first,)
-            )
             try:
-                return any(getattr(v, "nbytes", 0) >= 65536 for v in vals)
+                return any(
+                    getattr(v, "nbytes", 0) >= 65536 for v in _row_vals(first)
+                )
             except TypeError:
                 return False
+
+        def _row_bytes(first):
+            total = 0
+            try:
+                for v in _row_vals(first):
+                    n = getattr(v, "nbytes", None)
+                    if n is None:
+                        n = len(v) if isinstance(v, (bytes, str)) else 8
+                    total += n
+            except TypeError:
+                return 0
+            return total
+
+        # Ring-vs-queue policy (measured, BASELINE.md 'spark feed'):
+        # at image-scale rows the shm ring sustains ~3.9x the queue,
+        # but at kilobyte rows the e2e pipeline is consumer-bound and
+        # the ring's extra encode/decode buys nothing (~0.95x within
+        # jitter) — so blocks whose rows are below the threshold ship
+        # via the queue even when the ring is up.  TFOS_SHM_FEED=force
+        # pins the ring for every block (benchmarks; threshold tuning).
+        ring_min_row = int(
+            os.environ.get("TFOS_SHM_RING_MIN_ROW_BYTES", "4096")
+        )
+        ring_forced = os.environ.get("TFOS_SHM_FEED") == "force"
+        ring_choice = []  # decided at the first block, sticky per task
+
+        def _use_ring(rows):
+            if ring is None:
+                return False
+            if ring_forced:
+                return True
+            if not ring_choice:
+                use = _row_bytes(rows[0]) >= ring_min_row
+                ring_choice.append(use)
+                if not use:
+                    logger.info(
+                        "rows ~%dB < TFOS_SHM_RING_MIN_ROW_BYTES=%d: "
+                        "shipping via queue (ring idle for this task)",
+                        _row_bytes(rows[0]), ring_min_row,
+                    )
+            return ring_choice[0]
 
         def _push_record(header, bufs):
             """Push one wire-format record; False when it doesn't fit
@@ -749,14 +828,27 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             return True
 
         def _ship(rows):
-            if ring is not None:
+            if _use_ring(rows):
                 if columnar_ok and _row_is_large(rows[0]):
                     # zero-copy fast path: per-row buffers scatter-
                     # gather straight into the ring — the contiguous
                     # record write IS the column stack (no pack, no
                     # pickle)
                     enc = encode_rows_parts(rows)
-                    if enc is not None and _push_record(enc[0], enc[1]):
+                    if enc is not None:
+                        if _push_record(enc[0], enc[1]):
+                            return
+                        # known oversize from the exact wire total:
+                        # split now instead of materializing a full
+                        # stacked copy below just to re-measure it
+                        if len(rows) > 1:
+                            mid = len(rows) // 2
+                            _ship(rows[:mid])
+                            _ship(rows[mid:])
+                            return
+                        # single row bigger than a ring frame: the
+                        # queue path never had a size cap
+                        queue.put(Block(rows), block=True)
                         return
                 packed = _pack(rows)
                 if isinstance(packed, ColumnarBlock):
@@ -880,8 +972,11 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     exactly as many results (reference: TFSparkNode.py:506-565)."""
 
     def _inference(iterator):
-        mgr = _get_manager(cluster_info, _local_executor_id())
-        queue_in = mgr.get_queue(qname)
+        mgr, queue_in = _manager_first_call(
+            cluster_info,
+            _local_executor_id(),
+            lambda m: m.get_queue(qname),
+        )
         count = 0
         block = []
         for item in iterator:

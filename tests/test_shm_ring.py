@@ -95,7 +95,7 @@ def test_cluster_train_through_shm_ring():
     from tensorflowonspark_tpu.cluster.cluster import InputMode
     from tensorflowonspark_tpu.engine import LocalEngine
 
-    engine = LocalEngine(2, env={"TFOS_SHM_FEED": "1"})
+    engine = LocalEngine(2, env={"TFOS_SHM_FEED": "force"})
     try:
         cluster = tpu_cluster.run(
             engine,
@@ -152,6 +152,77 @@ def test_columnar_wire_roundtrip_matches_pack():
         np.testing.assert_array_equal(o.columns[0], packed.columns[0])
         np.testing.assert_array_equal(o.columns[1], packed.columns[1])
         assert o.rows()[3][1] == 3
+
+
+def test_decode_rejects_truncated_or_corrupt_records():
+    # a magic-prefixed record that is cut short (or lies about its
+    # header length) must return None for the pickle fallback, exactly
+    # like any other malformed input — never raise into the feed
+    import struct
+
+    from tensorflowonspark_tpu.cluster.marker import (
+        COLUMNAR_MAGIC,
+        decode_columnar_record,
+        encode_rows_parts,
+    )
+
+    rows = [
+        (np.arange(64, dtype=np.float32) + i, i) for i in range(4)
+    ]
+    hdr, parts, total = encode_rows_parts(rows)
+    rec = _wire(hdr, parts)
+    assert decode_columnar_record(rec) is not None
+    for cut in (10, 13, len(hdr) - 1, len(hdr) + 5, len(rec) - 1):
+        assert decode_columnar_record(rec[:cut]) is None, cut
+    # header length field pointing past the buffer
+    lying = COLUMNAR_MAGIC + struct.pack("<I", 1 << 30) + b"x" * 32
+    assert decode_columnar_record(lying) is None
+    # valid length, garbage json
+    garbage = COLUMNAR_MAGIC + struct.pack("<I", 8) + b"notjson!" + b"y" * 8
+    assert decode_columnar_record(garbage) is None
+    # parses, but dict kind without keys / with mismatched keys
+    import json as _json
+
+    for meta in (
+        {"dtypes": [], "shapes": [], "kind": "dict", "count": 0},
+        {"dtypes": ["<f4"], "shapes": [[1]], "kind": "dict",
+         "count": 1, "keys": []},
+        {"dtypes": [], "shapes": [], "kind": "mystery", "count": 0},
+    ):
+        hdr_j = _json.dumps(meta).encode()
+        rec_bad = (
+            COLUMNAR_MAGIC + struct.pack("<I", len(hdr_j)) + hdr_j
+            + b"\x00" * 64
+        )
+        assert decode_columnar_record(rec_bad) is None, meta
+
+
+def test_cluster_small_rows_use_queue_policy_transparently():
+    # TFOS_SHM_FEED=1 (the production setting) with kilobyte rows: the
+    # feeder's size policy ships via the queue while the ring sits
+    # idle — delivery must be complete and ordered regardless
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster import manager as mgr_mod
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+    from tensorflowonspark_tpu.engine import LocalEngine
+
+    engine = LocalEngine(1, env={"TFOS_SHM_FEED": "1"})
+    try:
+        cluster = tpu_cluster.run(
+            engine,
+            _count_consume_fn,
+            args={},
+            num_executors=1,
+            input_mode=InputMode.SPARK,
+        )
+        parts = [[(i, i * 2) for i in range(300)] for _ in range(2)]
+        cluster.train(parts, num_epochs=1)
+        cluster.shutdown(timeout=120)
+        n = cluster.cluster_info[0]
+        m = mgr_mod.connect(tuple(n["addr"]), bytes.fromhex(n["authkey"]))
+        assert int(m.get("consumed")._getvalue() or 0) == 2 * 300
+    finally:
+        engine.stop()
 
 
 def test_rows_parts_rejects_heterogeneous():
@@ -310,7 +381,7 @@ def test_cluster_ragged_rows_through_shm_ring():
     from tensorflowonspark_tpu.cluster.cluster import InputMode
     from tensorflowonspark_tpu.engine import LocalEngine
 
-    engine = LocalEngine(1, env={"TFOS_SHM_FEED": "1"})
+    engine = LocalEngine(1, env={"TFOS_SHM_FEED": "force"})
     try:
         cluster = tpu_cluster.run(
             engine,
