@@ -149,6 +149,32 @@ def test_async_workers_converge(shards):
     final.close()
 
 
+def test_pipelined_trainer_converges_and_drains(shards):
+    # pipeline=True overlaps the round trip with the next grad compute;
+    # staleness is bounded at one round trip, so convergence on a
+    # quadratic must survive, and drain() must land the last gradient
+    _, addrs = shards
+    target = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+
+    def loss_fn(params, batch):
+        import jax.numpy as jnp
+
+        del batch
+        return jnp.sum((params["w"] - target) ** 2)
+
+    tr = ps.AsyncTrainer(
+        loss_fn, addrs, optimizer=("sgd", {"learning_rate": 0.05}),
+        pipeline=True,
+    )
+    p = tr.init({"w": np.zeros(4, np.float32)})
+    for _ in range(150):
+        p = tr.step(p, None)
+    drained = tr.drain()
+    assert drained is not None
+    np.testing.assert_allclose(np.asarray(drained["w"]), target, atol=1e-2)
+    tr.stop()
+
+
 def test_stop_op_stops_shard():
     shard = ps.ParamServerShard()
     host, port = shard.start("127.0.0.1", 0)
