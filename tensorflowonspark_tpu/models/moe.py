@@ -32,6 +32,10 @@ class MoEMLP(nn.Module):
     k: int = 2
     capacity_factor: float = 1.25
     dtype: str = "bfloat16"
+    #: "gather" (index-based dispatch/combine — O(tokens·D) movement,
+    #: no permutation matmuls) or "einsum" (dense [G,E,C] one-hot
+    #: contractions; the numerics reference and GSPMD fallback)
+    dispatch: str = "gather"
 
     @nn.compact
     def __call__(self, x):
@@ -50,27 +54,39 @@ class MoEMLP(nn.Module):
         cap = moe_ops.expert_capacity(
             g, e, capacity_factor=self.capacity_factor, k=self.k
         )
-        dispatch, combine, aux = moe_ops.top_k_gating(
-            logits, e, cap, k=self.k
-        )
-        self.sow("losses", "moe_aux", aux)
 
         init = nn.initializers.variance_scaling(1.0, "fan_in", "normal")
         wi = self.param("wi", init, (e, d, m))
         wg = self.param("wg", init, (e, d, m))
         wo = self.param("wo", init, (e, m, d))
 
-        # dispatch: [G,E,C] x [G,D] -> expert batches [E,C,D]
-        xe = jnp.einsum(
-            "gec,gd->ecd", dispatch.astype(jdtype), xf.astype(jdtype)
-        )
+        if self.dispatch == "gather":
+            experts, slots, gates, aux = moe_ops.top_k_routing(
+                logits, e, cap, k=self.k
+            )
+            self.sow("losses", "moe_aux", aux)
+            xe = moe_ops.dispatch_gather(
+                xf.astype(jdtype), experts, slots, gates, e, cap
+            )  # [E, C, D], one row-gather
+        else:
+            dispatch, combine, aux = moe_ops.top_k_gating(
+                logits, e, cap, k=self.k
+            )
+            self.sow("losses", "moe_aux", aux)
+            # dispatch: [G,E,C] x [G,D] -> expert batches [E,C,D]
+            xe = jnp.einsum(
+                "gec,gd->ecd", dispatch.astype(jdtype), xf.astype(jdtype)
+            )
         h = jnp.einsum("ecd,edm->ecm", xe, wi.astype(jdtype))
         hg = jnp.einsum("ecd,edm->ecm", xe, wg.astype(jdtype))
         ye = jnp.einsum(
             "ecm,emd->ecd", nn.silu(hg) * h, wo.astype(jdtype)
         )
-        # combine: weighted return to token order [G,D]
-        y = jnp.einsum("gec,ecd->gd", combine.astype(jdtype), ye)
+        if self.dispatch == "gather":
+            y = moe_ops.combine_gather(ye, experts, slots, gates)
+        else:
+            # combine: weighted return to token order [G,D]
+            y = jnp.einsum("gec,ecd->gd", combine.astype(jdtype), ye)
         return y.reshape(b, s, d).astype(x.dtype)
 
 

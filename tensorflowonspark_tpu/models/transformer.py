@@ -62,6 +62,8 @@ class TransformerConfig:
     num_experts: int = 0
     expert_k: int = 2
     capacity_factor: float = 1.25
+    #: "gather" (index dispatch, no permutation matmuls) | "einsum"
+    expert_dispatch: str = "gather"
 
     @property
     def jdtype(self):
@@ -167,6 +169,7 @@ class Block(nn.Module):
                 k=cfg.expert_k,
                 capacity_factor=cfg.capacity_factor,
                 dtype=cfg.dtype,
+                dispatch=cfg.expert_dispatch,
                 name="moe",
             )(h)
         else:

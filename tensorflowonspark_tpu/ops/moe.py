@@ -89,6 +89,100 @@ def top_k_gating(router_logits, num_experts, capacity, k=2, rng=None,
     return dispatch, combine, aux_loss
 
 
+def top_k_routing(router_logits, num_experts, capacity, k=2, rng=None,
+                  jitter_eps=0.0):
+    """Index-based top-k routing: the same slot assignment as
+    :func:`top_k_gating` but returned as per-token indices instead of
+    ``[G, E, C]`` one-hot tensors.
+
+    The dense dispatch/combine einsums cost ``G*E*C*D`` MXU FLOPs each —
+    at bench shapes that approached the expert FFN compute itself for
+    what is semantically a permutation.  With indices, dispatch is ONE
+    row-gather (``[E*C, D]``) through an inverse slot→token map and
+    combine is a ``[G, k, D]`` gather times gate weights: O(tokens·D)
+    memory movement, zero matmul FLOPs.
+
+    Returns ``(experts [G,k] i32, slots [G,k] i32, gates [G,k] f32
+    (0 where dropped; renormalized over landed choices), aux_loss)``.
+    Slot assignments are identical to the dense path: within a choice
+    round tokens take their expert's slots in order, later rounds start
+    after earlier rounds' claims, overflow drops.
+    """
+    g, e = router_logits.shape
+    if rng is not None and jitter_eps > 0:
+        noise = jax.random.uniform(
+            rng, router_logits.shape, minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps,
+        )
+        router_logits = router_logits * noise
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(f * p)
+
+    remaining = probs
+    used = jnp.zeros((e,), jnp.int32)
+    experts, slots, gates = [], [], []
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)  # [G]
+        gate = jnp.take_along_axis(
+            remaining, choice[:, None], axis=-1
+        )[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        pos_within = jnp.cumsum(onehot, axis=0) - onehot
+        pos = jnp.sum(pos_within * onehot, axis=-1).astype(jnp.int32) + (
+            used[choice]
+        )
+        fits = pos < capacity
+        experts.append(choice.astype(jnp.int32))
+        slots.append(jnp.clip(pos, 0, capacity - 1))
+        gates.append(gate * fits.astype(jnp.float32))
+        used = used + jnp.sum(
+            onehot * fits[:, None].astype(jnp.float32), axis=0
+        ).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot)
+
+    experts = jnp.stack(experts, axis=1)
+    slots = jnp.stack(slots, axis=1)
+    gates = jnp.stack(gates, axis=1)
+    denom = jnp.sum(gates, axis=1, keepdims=True)
+    gates = gates / jnp.maximum(denom, 1e-9)
+    return experts, slots, gates, aux_loss
+
+
+def dispatch_gather(x, experts, slots, gates, num_experts, capacity):
+    """Build expert batches ``[E, C, D]`` from ``x [G, D]`` with one
+    row-gather through the inverse slot→token map (no ``[G,E,C]``
+    tensor, no matmul).  Dropped/unfilled slots read a zero row."""
+    g, d = x.shape
+    flat = (experts * capacity + slots).reshape(-1)  # [G*k]
+    valid = (gates > 0.0).reshape(-1)
+    # inverse map: slot -> source token (sentinel g = the zero row);
+    # valid (expert, slot) pairs are unique by construction, invalid
+    # entries park on a dummy slot that gets trimmed
+    flat = jnp.where(valid, flat, num_experts * capacity)
+    token_ids = jnp.repeat(
+        jnp.arange(g, dtype=jnp.int32), experts.shape[1]
+    )
+    slot_token = jnp.full(
+        (num_experts * capacity + 1,), g, jnp.int32
+    ).at[flat].set(token_ids)[:-1]
+    xpad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    return xpad[slot_token].reshape(num_experts, capacity, d)
+
+
+def combine_gather(ye, experts, slots, gates, out_dtype=None):
+    """Return expert outputs to token order: ``y[g] = sum_k gate *
+    ye[expert, slot]`` — a ``[G, k, D]`` gather and a weighted sum."""
+    e, c, d = ye.shape
+    flat = experts * c + slots  # [G, k]; dropped entries have gate 0
+    rows = ye.reshape(e * c, d)[flat]  # [G, k, D]
+    y = jnp.sum(rows * gates[..., None].astype(ye.dtype), axis=1)
+    return y if out_dtype is None else y.astype(out_dtype)
+
+
 def expert_capacity(num_tokens, num_experts, capacity_factor=1.25, k=2):
     """Standard capacity formula: ``ceil(k * G / E * factor)``, rounded
     up to a multiple of 8 (TPU sublane alignment)."""

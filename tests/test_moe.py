@@ -56,6 +56,73 @@ class TestGating:
         cap = moe_ops.expert_capacity(1024, 8, capacity_factor=1.0, k=2)
         assert cap % 8 == 0 and cap >= 256
 
+    def test_routing_indices_match_dense_gating(self):
+        # the index-based router must reproduce the dense one-hot
+        # path's slot assignment, gates, drops, and aux loss exactly
+        logits = self._logits(g=96, e=4, seed=3)
+        e, cap, k = 4, 16, 2  # tight capacity: forces drops
+        dispatch, combine, aux_d = moe_ops.top_k_gating(
+            logits, e, cap, k=k
+        )
+        experts, slots, gates, aux_i = moe_ops.top_k_routing(
+            logits, e, cap, k=k
+        )
+        np.testing.assert_allclose(float(aux_d), float(aux_i), atol=1e-6)
+        g = logits.shape[0]
+        dense_from_idx = np.zeros((g, e, cap), np.float32)
+        combine_from_idx = np.zeros((g, e, cap), np.float32)
+        ex, sl, gt = map(np.asarray, (experts, slots, gates))
+        for t in range(g):
+            for j in range(k):
+                if gt[t, j] > 0:
+                    dense_from_idx[t, ex[t, j], sl[t, j]] = 1.0
+                    combine_from_idx[t, ex[t, j], sl[t, j]] = gt[t, j]
+        np.testing.assert_allclose(dense_from_idx, dispatch, atol=1e-6)
+        np.testing.assert_allclose(combine_from_idx, combine, atol=1e-5)
+
+    def test_gather_dispatch_combine_match_einsum(self):
+        # dispatch_gather/combine_gather == the dense einsums on the
+        # same routing decisions (including dropped tokens)
+        logits = self._logits(g=96, e=4, seed=4)
+        e, cap, k = 4, 16, 2
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(96, 8).astype(np.float32))
+        dispatch, combine, _ = moe_ops.top_k_gating(logits, e, cap, k=k)
+        experts, slots, gates, _ = moe_ops.top_k_routing(
+            logits, e, cap, k=k
+        )
+        xe_dense = jnp.einsum("gec,gd->ecd", dispatch, x)
+        xe_idx = moe_ops.dispatch_gather(x, experts, slots, gates, e, cap)
+        np.testing.assert_allclose(xe_idx, xe_dense, atol=1e-5)
+        ye = jnp.asarray(rng.randn(e, cap, 8).astype(np.float32))
+        y_dense = jnp.einsum("gec,ecd->gd", combine, ye)
+        y_idx = moe_ops.combine_gather(ye, experts, slots, gates)
+        np.testing.assert_allclose(y_idx, y_dense, atol=1e-5)
+
+    def test_gather_dispatch_gradients_flow(self):
+        # d(loss)/dx must agree between the gather and einsum paths
+        logits = self._logits(g=32, e=4, seed=6)
+        e, cap, k = 4, 8, 2
+        x0 = jnp.asarray(
+            np.random.RandomState(7).randn(32, 8).astype(np.float32)
+        )
+
+        def loss_idx(x):
+            experts, slots, gates, _ = moe_ops.top_k_routing(
+                logits, e, cap, k=k
+            )
+            xe = moe_ops.dispatch_gather(x, experts, slots, gates, e, cap)
+            return jnp.sum(jnp.sin(xe))
+
+        def loss_dense(x):
+            dispatch, _, _ = moe_ops.top_k_gating(logits, e, cap, k=k)
+            return jnp.sum(jnp.sin(jnp.einsum("gec,gd->ecd", dispatch, x)))
+
+        np.testing.assert_allclose(
+            jax.grad(loss_idx)(x0), jax.grad(loss_dense)(x0),
+            atol=1e-5, rtol=1e-5,
+        )
+
 
 class TestMoEMLP:
     def test_single_expert_equals_dense_ffn(self):
