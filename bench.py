@@ -422,14 +422,16 @@ def transformer_bench():
 # ----------------------------------------------------------------------
 
 
-def serving_bench(rows_n=32768, batch_size=128):
+def serving_bench(rows_n=32768, batch_size=128, model="mnist"):
     """rows/s through the load_predictor -> predict_rows path (dict rows
     in, dict rows out, padded static-shape batches) — the measurement
     VERDICT r2 'Missing' #3 asked for before any re-architecting.  The
     reference's JVM path amortized per-row cost inside TFModel.scala
     (reference: src/main/scala/.../TFModel.scala:269-281); here the
     compute is one jitted call per batch and the marshalling is
-    numpy stacking/slicing."""
+    numpy stacking/slicing.  ``model="resnet50"`` serves the
+    ImageNet-scale predictor (224px rows) — the shape the reference's
+    TFModel.scala benchmark role actually carried."""
     import tempfile
 
     import numpy as np
@@ -439,26 +441,40 @@ def serving_bench(rows_n=32768, batch_size=128):
 
     from tensorflowonspark_tpu import serving
     from tensorflowonspark_tpu.checkpoint import save_for_serving
-    from tensorflowonspark_tpu.models.mlp import MNISTNet
 
-    model = MNISTNet()
-    params = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 28, 28))
-    )["params"]
+    if model == "resnet50":
+        from tensorflowonspark_tpu.models import resnet
+
+        net = resnet.ResNet50(num_classes=1000)
+        variables = net.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3))
+        )
+        export_tree = jax.tree.map(np.asarray, dict(variables))
+        meta = {
+            "model_ref": "tensorflowonspark_tpu.models.resnet:serving_builder",
+            "model_config": {"arch": "resnet50", "input_name": "image"},
+        }
+        row_shape, model_name = (224, 224, 3), "ResNet50 224px"
+    else:
+        from tensorflowonspark_tpu.models.mlp import MNISTNet
+
+        net = MNISTNet()
+        export_tree = jax.tree.map(
+            np.asarray,
+            net.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))["params"],
+        )
+        meta = {
+            "model_ref": "tensorflowonspark_tpu.models.mlp:serving_builder",
+            "model_config": {"input_name": "image"},
+        }
+        row_shape, model_name = (28, 28), "MNISTNet 28x28"
     with tempfile.TemporaryDirectory() as tmp:
         export = os.path.join(tmp, "export")
-        save_for_serving(
-            export,
-            jax.tree.map(np.asarray, params),
-            extra_metadata={
-                "model_ref": "tensorflowonspark_tpu.models.mlp:serving_builder",
-                "model_config": {"input_name": "image"},
-            },
-        )
+        save_for_serving(export, export_tree, extra_metadata=meta)
         predict = serving.load_predictor(export)
         rng = np.random.RandomState(0)
         rows = [
-            {"img": rng.randint(0, 255, size=(28, 28)).astype(np.float32)}
+            {"img": rng.randint(0, 255, size=row_shape).astype(np.float32)}
             for _ in range(rows_n)
         ]
         mapping = {"img": "image"}
@@ -477,12 +493,83 @@ def serving_bench(rows_n=32768, batch_size=128):
             n_out += 1
         dt = time.perf_counter() - t0
     assert n_out == rows_n
+    import jax as _jax
+
     return {
         "rows_per_sec": round(rows_n / dt, 1),
         "batch_size": batch_size,
-        "model": "MNISTNet 28x28",
+        "model": model_name,
+        "platform": _jax.devices()[0].platform,
         "wall_sec": round(dt, 3),
     }
+
+
+def serving_tpu_bench():
+    """Serving on the accelerator (VERDICT r3 'Next' #6): the same
+    predict_rows path with the jitted batch program on the chip.  Runs
+    in the chip-owning process; per-batch numbers include the tunneled
+    dispatch RTT, which dominates small models — reported as-is (the
+    marshalling-only ceiling is the serving_cpu row)."""
+    out = {}
+    out["mnist"] = with_retry(
+        lambda: serving_bench(rows_n=16384, batch_size=128)
+    )
+    out["resnet50"] = with_retry(
+        lambda: serving_bench(rows_n=2048, batch_size=64, model="resnet50")
+    )
+    return out
+
+
+def long_context_bench(seq_len=32768, iters=10):
+    """Single-chip long-context attention: flash kernel vs the ring
+    composition on a 1-device seq mesh (the ring's per-chunk pallas
+    inner step must add no overhead at p=1 — VERDICT r3 'Next' #1's
+    no-regression gate).  fwd+bwd per iteration, bf16, B1 H8 D128."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from tensorflowonspark_tpu.ops.flash_attention import flash_attention
+    from tensorflowonspark_tpu.ops.ring_attention import (
+        ring_attention_sharded,
+    )
+
+    b, h, d = 1, 8, 128
+    rng = np.random.RandomState(0)
+    q, k, v = (
+        jnp.asarray(rng.randn(b, seq_len, h, d), jnp.bfloat16)
+        for _ in range(3)
+    )
+    mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)
+        )
+
+    def loss_ring(q, k, v):
+        return jnp.sum(
+            ring_attention_sharded(
+                q, k, v, mesh, causal=True, impl="flash"
+            ).astype(jnp.float32)
+        )
+
+    out = {"seq_len": seq_len, "shape": "B%d H%d D%d bf16" % (b, h, d)}
+    for name, fn in (("flash", loss_flash), ("ring_p1", loss_ring)):
+        g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+        res = g(q, k, v)
+        float(jnp.ravel(res[0])[0])  # compile + definitive sync
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = g(q, k, v)
+        float(jnp.ravel(res[0])[0])
+        out["%s_ms" % name] = round(
+            (time.perf_counter() - t0) / iters * 1e3, 1
+        )
+    out["ring_vs_flash"] = round(out["ring_p1_ms"] / out["flash_ms"], 3)
+    return out
 
 
 # ----------------------------------------------------------------------
@@ -491,13 +578,29 @@ def serving_bench(rows_n=32768, batch_size=128):
 # ----------------------------------------------------------------------
 
 
+def _ps_shard_proc(port_q):
+    """One PS shard in its own process (as ps-role nodes run in the
+    cluster: the shard's numpy optimizer work and wire serialization
+    must NOT share the worker's GIL — in-process shards measured ~0
+    compute/communication overlap for exactly that reason)."""
+    from tensorflowonspark_tpu.parallel.ps import ParamServerShard
+
+    s = ParamServerShard()
+    _, port = s.start(host="127.0.0.1")
+    port_q.put(port)
+    s.join()
+
+
 def ps_bench(steps=300, batch=64, hidden=256):
-    """Async-PS steps/s vs sync single-worker steps/s at equal model
-    size, plus a staleness probe: with one deliberately slow co-worker,
-    the fast worker must keep stepping (no lockstep) — the async
-    contract the reference's between-graph PS mode provided.  Pure
-    CPU/TCP measurement (the PS shards are numpy + sockets); runs in a
-    subprocess so the accelerator-owning parent is untouched."""
+    """Async-PS vs sync at equal model size — the four-number straggler
+    study (VERDICT r3 'Next' #3): healthy sync, healthy async
+    (pipelined round trips), sync WITH a slow peer (synchronous
+    semantics wait out the straggler's injected delay at every
+    barrier), and async WITH the same slow peer (the fast worker keeps
+    stepping — the async contract the reference's between-graph PS mode
+    provided).  Pure CPU/TCP measurement; the shards run in child
+    processes (as ps-role nodes do) and the worker in this one."""
+    import multiprocessing as mp
     import threading
 
     import numpy as np
@@ -507,10 +610,7 @@ def ps_bench(steps=300, batch=64, hidden=256):
     import optax
 
     from tensorflowonspark_tpu.parallel import dp
-    from tensorflowonspark_tpu.parallel.ps import (
-        AsyncTrainer,
-        ParamServerShard,
-    )
+    from tensorflowonspark_tpu.parallel.ps import AsyncTrainer
 
     def loss_fn(params, batch):
         x, y = batch
@@ -532,13 +632,21 @@ def ps_bench(steps=300, batch=64, hidden=256):
     y = (rng.randint(0, 10, size=batch)).astype(np.int64)
     data = (jnp.asarray(x), jnp.asarray(y))
 
-    # two PS shards, as the reference's num_ps>=1 configs ran
-    shards = [ParamServerShard(), ParamServerShard()]
-    addrs = []
-    for s in shards:
-        host, port = s.start(host="127.0.0.1")
-        addrs.append("127.0.0.1:{0}".format(port))
+    # two PS shards in child processes, as the reference's num_ps>=1
+    # configs ran them on dedicated executors
+    ctx_mp = mp.get_context("spawn")
+    port_q = ctx_mp.Queue()
+    shard_procs = [
+        ctx_mp.Process(target=_ps_shard_proc, args=(port_q,), daemon=True)
+        for _ in range(2)
+    ]
+    for sp in shard_procs:
+        sp.start()
+    addrs = [
+        "127.0.0.1:{0}".format(port_q.get(timeout=60)) for _ in shard_procs
+    ]
 
+    slow_peer_delay = 0.05  # injected straggler latency per step
     out = {}
     try:
         worker = AsyncTrainer(
@@ -549,10 +657,61 @@ def ps_bench(steps=300, batch=64, hidden=256):
         t0 = time.perf_counter()
         for _ in range(steps):
             p = worker.step(p, data)
+        worker.drain()
         dt_async = time.perf_counter() - t0
         out["async_steps_per_sec"] = round(steps / dt_async, 1)
 
-        # staleness probe: a slow co-worker must not slow this one
+        # unpipelined control: what the pipelining of the PS round trip
+        # behind the next grad computation buys
+        blocking = AsyncTrainer(
+            loss_fn, addrs, optimizer=("sgd", {"learning_rate": 0.01}),
+            pipeline=False,
+        )
+        bp = blocking.init(params)
+        bp = blocking.step(bp, data)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            bp = blocking.step(bp, data)
+        dt_blocking = time.perf_counter() - t0
+        out["async_steps_per_sec_unpipelined"] = round(
+            steps / dt_blocking, 1
+        )
+
+        # overlap validation: the pipelined round trip must hide
+        # GIL-RELEASING compute almost entirely.  (The healthy-async
+        # number above cannot show this on a CPU-only bench host:
+        # jitted CPU-jax grads hold the GIL, so worker-thread wire work
+        # cannot progress under them.  On TPU the dispatch is async and
+        # the wire work overlaps device execution.)
+        work = 0.0006  # ~the grad_fn cost, as a GIL-releasing sleep
+        gnp = jax.tree.map(
+            lambda x: np.zeros(x.shape, np.float32), params
+        )
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            blocking.client.push_pull(gnp)
+        rt_alone = (time.perf_counter() - t0) / steps
+        h = blocking.client.push_pull_async(gnp)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            time.sleep(work)
+            nh = blocking.client.push_pull_async(gnp)
+            h.result()
+            h = nh
+        h.result()
+        piped = (time.perf_counter() - t0) / steps
+        exposed = max(0.0, piped - rt_alone)
+        out["pipeline_overlap"] = {
+            "injected_work_ms": work * 1e3,
+            "roundtrip_alone_ms": round(rt_alone * 1e3, 3),
+            "piped_step_ms": round(piped * 1e3, 3),
+            "work_hidden_frac": round(
+                min(1.0, max(0.0, 1.0 - exposed / work)), 2
+            ),
+        }
+        blocking.stop()
+
+        # straggler probe: a slow co-worker must not slow this one
         stop = threading.Event()
         slow_steps = [0]
 
@@ -564,7 +723,7 @@ def ps_bench(steps=300, batch=64, hidden=256):
             while not stop.is_set():
                 sp = w.step(sp, data)
                 slow_steps[0] += 1
-                time.sleep(0.05)
+                time.sleep(slow_peer_delay)
             w.stop()
 
         th = threading.Thread(target=slow_worker, daemon=True)
@@ -572,6 +731,7 @@ def ps_bench(steps=300, batch=64, hidden=256):
         t0 = time.perf_counter()
         for _ in range(steps):
             p = worker.step(p, data)
+        worker.drain()
         dt_contended = time.perf_counter() - t0
         stop.set()
         th.join(timeout=10)
@@ -581,8 +741,16 @@ def ps_bench(steps=300, batch=64, hidden=256):
         out["slow_peer_steps"] = slow_steps[0]
         worker.stop()
     finally:
-        for s in shards:
-            s.stop()
+        try:
+            from tensorflowonspark_tpu.parallel.ps import PSClient
+
+            PSClient(addrs, timeout=5).stop()
+        except Exception:  # noqa: BLE001 - teardown backstop below
+            pass
+        for sp in shard_procs:
+            sp.join(timeout=5)
+            if sp.is_alive():
+                sp.terminate()
 
     # sync single-worker baseline: same loss/model through SyncTrainer
     trainer = dp.SyncTrainer(
@@ -596,9 +764,32 @@ def ps_bench(steps=300, batch=64, hidden=256):
     float(m["loss"])
     dt_sync = time.perf_counter() - t0
     out["sync_steps_per_sec"] = round(steps / dt_sync, 1)
+
+    # sync WITH the same straggler: synchronous data parallelism waits
+    # for the slowest worker at every step's gradient barrier, so the
+    # injected per-step delay lands on the critical path in full (the
+    # all-reduce barrier is emulated by the wait itself: the fast
+    # worker cannot start its next step until the straggler's
+    # contribution arrives)
+    sync_slow_steps = max(20, steps // 5)
+    t0 = time.perf_counter()
+    for _ in range(sync_slow_steps):
+        state, m = trainer.step(state, data)
+        float(m["loss"])  # the barrier: this step is done everywhere
+        time.sleep(slow_peer_delay)
+    dt_sync_slow = time.perf_counter() - t0
+    out["sync_steps_per_sec_with_slow_peer"] = round(
+        sync_slow_steps / dt_sync_slow, 1
+    )
     out["async_vs_sync"] = round(
         out["async_steps_per_sec"] / out["sync_steps_per_sec"], 3
     )
+    out["straggler_advantage"] = round(
+        out["async_steps_per_sec_with_slow_peer"]
+        / out["sync_steps_per_sec_with_slow_peer"],
+        2,
+    )
+    out["slow_peer_delay_sec"] = slow_peer_delay
     out["model"] = "MLP 784-%d-10, batch %d, 2 PS shards" % (hidden, batch)
     return out
 
@@ -729,14 +920,16 @@ def _feed_main_fun(args, ctx):
     feed.terminate()
 
 
-def _run_feed_once(use_ring):
+def _run_feed_once(shm_mode):
+    """``shm_mode``: "0" queue, "force" ring for every block, "1" the
+    production auto policy (size-based ring/queue selection)."""
     from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
     from tensorflowonspark_tpu.cluster import manager as mgr_mod
     from tensorflowonspark_tpu.cluster.cluster import InputMode
     from tensorflowonspark_tpu.engine import LocalEngine
 
-    env = {"TFOS_SHM_FEED": "1" if use_ring else "0"}
-    os.environ["TFOS_SHM_FEED"] = env["TFOS_SHM_FEED"]
+    env = {"TFOS_SHM_FEED": shm_mode}
+    os.environ["TFOS_SHM_FEED"] = shm_mode
     engine = LocalEngine(1, env=env)
     try:
         cluster = tpu_cluster.run(
@@ -824,17 +1017,17 @@ def _img_feed_main_fun(args, ctx):
     feed.terminate()
 
 
-def _run_image_feed_once(use_ring):
+def _run_image_feed_once(shm_mode):
     from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
     from tensorflowonspark_tpu.cluster import manager as mgr_mod
     from tensorflowonspark_tpu.cluster.cluster import InputMode
     from tensorflowonspark_tpu.engine import LocalEngine
 
-    os.environ["TFOS_SHM_FEED"] = "1" if use_ring else "0"
+    os.environ["TFOS_SHM_FEED"] = shm_mode
     engine = LocalEngine(
         1,
         env={
-            "TFOS_SHM_FEED": os.environ["TFOS_SHM_FEED"],
+            "TFOS_SHM_FEED": shm_mode,
             # 64-row blocks: ~9.6MB records (128-row measured slightly
             # slower; the 256-row default would be ~38MB — more than
             # half the default ring); 256MB ring loosens backpressure
@@ -904,25 +1097,63 @@ def _run_image_feed_once(use_ring):
         engine.stop()
 
 
-def feed_worker():
-    """Subprocess entry: run the SPARK-mode feed bench (queue and ring,
-    mnist-scale and 224px-image-scale rows), print one JSON line on
-    stdout."""
-    out = {}
-    for name, fn, ring in (
-        ("queue", _run_feed_once, False),
-        ("ring", _run_feed_once, True),
-        ("image_queue", _run_image_feed_once, False),
-        ("image_ring", _run_image_feed_once, True),
-    ):
+def _median_of(fn, mode, repeats):
+    """Run a feed bench ``repeats`` times; report the median run plus
+    the raw rows/s of every run and the (max-min)/median spread — one
+    run cannot distinguish a regression from tunnel/host jitter
+    (VERDICT r3 'Weak' #1)."""
+    runs = []
+    for _ in range(repeats):
         try:
-            out[name] = fn(ring)
+            r = fn(mode)
         except Exception as e:  # noqa: BLE001 - report partial results
-            print("feed bench (%s) failed: %s" % (name, e), file=sys.stderr)
-            out[name] = None
+            print(
+                "feed bench (%s) run failed: %s" % (mode, e),
+                file=sys.stderr,
+            )
+            r = None
+        if r:
+            runs.append(r)
+    if not runs:
+        return None
+    ordered = sorted(runs, key=lambda r: r["rows_per_sec"])
+    med = dict(ordered[len(ordered) // 2])
+    rates = [r["rows_per_sec"] for r in runs]
+    med["rows_per_sec_runs"] = rates
+    med["spread_pct"] = round(
+        100.0 * (max(rates) - min(rates)) / med["rows_per_sec"], 1
+    )
+    return med
+
+
+def feed_worker():
+    """Subprocess entry: run the SPARK-mode feed bench, print one JSON
+    line on stdout.  mnist-scale rows: queue and forced-ring, 3 repeats
+    each (median + spread), plus one auto-policy run documenting the
+    small-row queue fallback; 224px-image rows: queue vs the auto
+    policy (which selects the ring at that row size)."""
+    out = {}
+    out["queue"] = _median_of(_run_feed_once, "0", 3)
+    out["ring"] = _median_of(_run_feed_once, "force", 3)
+    # production setting: TFOS_SHM_FEED=1 engages the size policy —
+    # kilobyte rows ship via the queue (documented fallback)
+    out["ring_auto"] = _median_of(_run_feed_once, "1", 1)
+    if out.get("ring_auto"):
+        out["ring_auto"]["policy"] = (
+            "rows < TFOS_SHM_RING_MIN_ROW_BYTES=4096: shipped via queue"
+        )
+    out["image_queue"] = _median_of(_run_image_feed_once, "0", 1)
+    # image rows are ~150KB: the auto policy selects the ring
+    out["image_ring"] = _median_of(_run_image_feed_once, "1", 1)
     if out.get("queue") and out.get("ring"):
         out["ring_vs_queue"] = round(
             out["ring"]["rows_per_sec"] / out["queue"]["rows_per_sec"], 2
+        )
+    if out.get("queue") and out.get("ring_auto"):
+        out["ring_auto_vs_queue"] = round(
+            out["ring_auto"]["rows_per_sec"]
+            / out["queue"]["rows_per_sec"],
+            2,
         )
     if out.get("image_queue") and out.get("image_ring"):
         out["image_ring_vs_queue"] = round(
@@ -941,7 +1172,7 @@ def run_feed_bench():
             [sys.executable, os.path.abspath(__file__), "--feed-worker"],
             stdout=subprocess.PIPE,
             stderr=sys.stderr,
-            timeout=900,
+            timeout=1800,
             text=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
@@ -966,6 +1197,14 @@ def main(model_name="resnet50", with_feed=True):
             out["transformer"] = with_retry(transformer_bench)
         except Exception as e:  # noqa: BLE001 - auxiliary to the headline
             print("transformer bench failed: %s" % e, file=sys.stderr)
+        for name, fn in (
+            ("long_context", long_context_bench),
+            ("serving_tpu", serving_tpu_bench),
+        ):
+            try:
+                out[name] = with_retry(fn)
+            except Exception as e:  # noqa: BLE001 - auxiliary rows
+                print("%s bench failed: %s" % (name, e), file=sys.stderr)
     if feed:
         out["spark_feed"] = feed
     if aux:
@@ -1001,8 +1240,12 @@ if __name__ == "__main__":
         feed_worker()
     elif "--aux-worker" in sys.argv:
         _aux_worker()
+    elif "serving_tpu" in sys.argv:
+        print(json.dumps(with_retry(serving_tpu_bench)))
     elif "serving" in sys.argv:
         print(json.dumps(with_retry(serving_bench)))
+    elif "long_context" in sys.argv:
+        print(json.dumps(with_retry(long_context_bench)))
     elif "ps" in sys.argv:
         import jax
 

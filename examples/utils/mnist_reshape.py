@@ -1,0 +1,57 @@
+"""Eyeball one mnist CSV row as a 28x28 image grid.
+
+Analogue of the reference's stdin helper
+(`/root/reference/examples/utils/mnist_reshape.py:1-9`): feed it a
+"label,pix0,...,pix783" CSV line (the format the mnist data-setup jobs
+write) and it prints the reshaped 28x28 array — handy for checking that
+a prepared dataset's pixel order survived the trip through Spark.
+
+Usage::
+
+    head -1 mnist_train.csv | python examples/utils/mnist_reshape.py
+    python examples/utils/mnist_reshape.py --ascii < row.csv
+"""
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def reshape_row(line):
+    """CSV "label,784 pixels" -> (label, [28, 28] uint8 array)."""
+    vals = [int(float(x)) for x in line.strip().split(",")]
+    if len(vals) != 785:
+        raise ValueError(
+            "expected 785 comma-separated values (label + 28*28 pixels), "
+            "got {0}".format(len(vals))
+        )
+    return vals[0], np.asarray(vals[1:], np.uint8).reshape(28, 28)
+
+
+def to_ascii(img, levels=" .:-=+*#%@"):
+    """Terminal-friendly rendering (one char per pixel by intensity)."""
+    idx = (img.astype(np.int32) * (len(levels) - 1)) // 255
+    return "\n".join("".join(levels[i] for i in row) for row in idx)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--ascii", action="store_true",
+        help="render as ascii art instead of the numeric array",
+    )
+    args = ap.parse_args(argv)
+    for line in sys.stdin:
+        if not line.strip():
+            continue
+        label, img = reshape_row(line)
+        print("label: {0}".format(label))
+        if args.ascii:
+            print(to_ascii(img))
+        else:
+            print(np.array2string(img, max_line_width=120))
+
+
+if __name__ == "__main__":
+    main()

@@ -124,6 +124,9 @@ HasNumPS = _mixin("num_ps", "number of parameter-server nodes", 0, cap="NumPS")
 HasOutputMapping = _mixin(
     "output_mapping", "mapping of predictor outputs to output columns"
 )
+# the reference's HasProtocol chose TF's RPC fabric ('grpc'|'rdma',
+# reference: pipeline.py:189-199) — N/A on TPU, where XLA owns the
+# collective transport; the param survives as an ICI/DCN placement hint
 HasProtocol = _mixin(
     "protocol", "collective transport hint: 'ici' | 'dcn'", "ici"
 )
