@@ -1,0 +1,108 @@
+"""Probe: phase-packed (space-to-depth) equivalent of a 3x3/s1 conv.
+
+Exactness: y[2i+a, 2j+b] = conv3x3(x)[...] must equal the packed conv's
+output phase (a,b).  Packed kernel Wp[di',dj', (a'b')C+c, (ab)F+f] =
+w[di,dj,c,f] with di = 2*di' + a' - a + 1 (zero where di outside [0,3)).
+Then time baseline vs packed per CIFAR stage shape on the TPU.
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def s2d(x):
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+
+def d2s(y):
+    b, h, w, c4 = y.shape
+    c = c4 // 4
+    y = y.reshape(b, h, w, 2, 2, c)
+    return y.transpose(0, 1, 3, 2, 4, 5).reshape(b, 2 * h, 2 * w, c)
+
+
+def pack_kernel(w):
+    kh, kw, cin, cout = w.shape
+    assert kh == 3 and kw == 3
+    wp = np.zeros((3, 3, 4 * cin, 4 * cout), w.dtype)
+    for a in range(2):
+        for b in range(2):
+            for di in range(3):
+                for dj in range(3):
+                    # absolute offset rel. packed grid
+                    ia, ja = a + di - 1, b + dj - 1
+                    dip, ap = divmod(ia, 2)
+                    djp, bp = divmod(ja, 2)
+                    if not (-1 <= dip <= 1 and -1 <= djp <= 1):
+                        continue
+                    wp[
+                        dip + 1, djp + 1,
+                        (ap * 2 + bp) * cin:(ap * 2 + bp + 1) * cin,
+                        (a * 2 + b) * cout:(a * 2 + b + 1) * cout,
+                    ] = w[di, dj]
+    return wp
+
+
+def conv(x, w):
+    return lax.conv_general_dilated(
+        x, w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def phase_to_channel_output(yp, cout):
+    # packed conv output [B,H/2,W/2,4F] -> unpacked [B,H,W,F]
+    return d2s(
+        yp.reshape(yp.shape[:3] + (4, cout)).reshape(
+            yp.shape[:3] + (4 * cout,)
+        )
+    )
+
+
+if __name__ == "__main__":
+    rng = np.random.RandomState(0)
+    # exactness check (f32, CPU-precision tolerances on TPU)
+    x = rng.randn(2, 32, 32, 16).astype(np.float32)
+    w = (rng.randn(3, 3, 16, 16) * 0.1).astype(np.float32)
+    y = np.asarray(conv(jnp.asarray(x), jnp.asarray(w)))
+    xp = np.asarray(s2d(jnp.asarray(x)))
+    wp = pack_kernel(w)
+    yp = np.asarray(conv(jnp.asarray(xp), jnp.asarray(wp)))
+    y2 = np.asarray(phase_to_channel_output(jnp.asarray(yp), 16))
+    print("exact:", np.allclose(y, y2, atol=1e-3, rtol=1e-3),
+          float(np.max(np.abs(y - y2))))
+
+    # timing per stage shape, bench batch
+    B = 128
+    for (hw, c) in ((32, 16), (16, 32), (8, 64)):
+        xb = jnp.asarray(
+            rng.randn(B, hw, hw, c).astype(np.float32), jnp.bfloat16
+        )
+        wb = jnp.asarray(
+            (rng.randn(3, 3, c, c) * 0.1).astype(np.float32), jnp.bfloat16
+        )
+        xpb = s2d(xb)
+        wpb = jnp.asarray(pack_kernel(np.asarray(wb, np.float32)),
+                          jnp.bfloat16)
+
+        def many(f, x_, w_, n=20):
+            def body(carry, _):
+                return f(carry, w_).astype(x_.dtype), None
+            return lax.scan(body, x_, None, length=n)[0]
+
+        for name, xx, ww in (("base", xb, wb), ("packed", xpb, wpb)):
+            g = jax.jit(lambda x_, w_, f=conv: many(f, x_, w_))
+            r = g(xx, ww); float(jnp.sum(r.astype(jnp.float32)))
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = g(xx, ww)
+            float(jnp.sum(r.astype(jnp.float32)))
+            dt = (time.perf_counter() - t0) / 5 / 20
+            print("HW%d C%d %s: %.3f ms/conv" % (hw, c, name, dt * 1e3))
