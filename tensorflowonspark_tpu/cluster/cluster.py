@@ -171,6 +171,15 @@ class TPUCluster(object):
         ``ssc.start()`` afterwards; stop feeding with
         ``reservation.Client(addr).request_stop()`` (reference:
         examples/utils/stop_streaming.py) or by stopping the context.
+
+        Test-coverage note: upstream pyspark 4 removed DStreams
+        entirely, so REAL-DStream coverage only executes on pyspark<4
+        (tests/test_spark_real.py gates on it); the ``foreachRDD``
+        contract itself is covered everywhere via duck-typed streams
+        and DataFrame micro-batches.  On pyspark>=4 prefer
+        :meth:`train_stream` (an iterator of micro-batches) or
+        Structured Streaming's ``foreachBatch`` pointed at
+        ``train_stream``'s feed path.
         """
         assert self.input_mode == InputMode.SPARK, (
             "train_dstream() requires InputMode.SPARK"
