@@ -107,9 +107,11 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
 
         if mesh is not None:
             return ulysses_attention_sharded(
-                q, k, v, mesh, causal=causal, scale=scale, axis_name=seq_axis
+                q, k, v, mesh, causal=causal, scale=scale,
+                axis_name=seq_axis, block_q=block_q, block_k=block_k,
             )
         return ulysses_attention(
-            q, k, v, causal=causal, scale=scale, axis_name=seq_axis
+            q, k, v, causal=causal, scale=scale, axis_name=seq_axis,
+            block_q=block_q, block_k=block_k,
         )
     return dot_attention(q, k, v, causal=causal, scale=scale)

@@ -241,6 +241,20 @@ def _fit_block(requested, seq_len):
     return None
 
 
+def flash_supported(scale, seq_len, block_q, block_k):
+    """Whether the pallas kernels can run this shape/config: seq_len
+    must tile by a lane-aligned block under both requested sizes, and
+    scale must be concrete (custom_vjp nondiff args).  Head dim needs
+    no gate — Mosaic compiles arbitrary D via relayout (verified on
+    v5e down to D=20).  Shared by the ring and Ulysses fallbacks so
+    the \"can flash run\" predicate lives in one place."""
+    return (
+        _fit_block(block_q, seq_len) is not None
+        and _fit_block(block_k, seq_len) is not None
+        and not isinstance(scale, jax.core.Tracer)
+    )
+
+
 def _block_sizes(seq_len, block_q, block_k):
     bq = _fit_block(block_q, seq_len)
     bk = _fit_block(block_k, seq_len)

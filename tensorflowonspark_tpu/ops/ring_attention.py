@@ -45,8 +45,8 @@ from jax import lax
 
 from tensorflowonspark_tpu.ops.flash_attention import (
     _bwd_core,
-    _fit_block,
     _fwd_core,
+    flash_supported,
 )
 
 NEG_INF = -1e30
@@ -65,18 +65,11 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
     if impl == "flash":
-        # custom_vjp nondiff args must be concrete, and the kernels need
-        # a lane-aligned block dividing S_local; fall back to the dense
-        # inner step when either doesn't hold so the pre-flash contract
-        # (traced scale, arbitrary shard lengths) keeps working.  Head
-        # dim needs no gate: Mosaic compiles arbitrary D via relayout
-        # (fwd+bwd verified on TPU v5e down to D=20 non-aligned).
+        # fall back to the dense inner step when the kernels can't run
+        # (traced scale / untileable shard length) so the pre-flash
+        # contract keeps working
         s_val = scale if scale is not None else q.shape[-1] ** -0.5
-        tileable = (
-            _fit_block(block_q, q.shape[1]) is not None
-            and _fit_block(block_k, q.shape[1]) is not None
-        )
-        if tileable and not isinstance(s_val, jax.core.Tracer):
+        if flash_supported(s_val, q.shape[1], block_q, block_k):
             return _ring_flash(
                 q, k, v, float(s_val), bool(causal), int(block_q),
                 int(block_k), axis_name,

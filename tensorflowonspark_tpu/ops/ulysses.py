@@ -18,20 +18,27 @@ Requires ``num_heads % axis_size == 0``; otherwise use
 :mod:`.ring_attention` (which has no head-count constraint).
 """
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from tensorflowonspark_tpu.ops.attention import dot_attention
+from tensorflowonspark_tpu.ops.flash_attention import flash_supported
 
 
 def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
-                      local_impl="dot", block_q=1024, block_k=1024):
+                      local_impl="flash", block_q=1024, block_k=1024):
     """Attention over sequence shards; call under ``shard_map``.
 
     Args:
       q, k, v: local shards ``[B, S_local, H, D]``.
       local_impl: attention used on the re-sharded full sequence:
-        ``"dot"`` (XLA) or ``"flash"`` (pallas kernel).
+        ``"flash"`` (pallas kernel — the default: after the all-to-all
+        each device attends over the FULL sequence length, exactly
+        where O(block) memory matters) or ``"dot"`` (XLA einsums; the
+        numerics reference).  Falls back to ``dot`` for traced scale
+        values or sequence lengths the kernels cannot tile (same
+        contract as ring attention's fallback).
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
     p = lax.axis_size(axis_name)
@@ -41,6 +48,10 @@ def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
             "ulysses needs heads ({0}) divisible by the seq axis size "
             "({1}); use ring attention instead".format(h, p)
         )
+    if local_impl == "flash":
+        s_val = scale if scale is not None else q.shape[-1] ** -0.5
+        if not flash_supported(s_val, q.shape[1] * p, block_q, block_k):
+            local_impl = "dot"
 
     def seq_to_heads(x):
         # [B, S/P, H, D] -> [B, S, H/P, D]
@@ -67,10 +78,10 @@ def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
 
 
 def ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=None,
-                              axis_name="seq", local_impl="dot"):
+                              axis_name="seq", local_impl="flash",
+                              block_q=1024, block_k=1024):
     """Global-array entry point: shard_map wrapper usable inside jit
     (sequence dim sharded on ``axis_name``, batch on the data axes)."""
-    import jax
     from jax.sharding import PartitionSpec as P
 
     batch_axes = tuple(
@@ -81,7 +92,7 @@ def ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=None,
     def _local(ql, kl, vl):
         return ulysses_attention(
             ql, kl, vl, causal=causal, scale=scale, axis_name=axis_name,
-            local_impl=local_impl,
+            local_impl=local_impl, block_q=block_q, block_k=block_k,
         )
 
     return jax.shard_map(

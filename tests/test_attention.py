@@ -175,15 +175,19 @@ class TestRingAttention:
 
 
 class TestUlyssesAttention:
+    @pytest.mark.parametrize("local_impl", ["flash", "dot"])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_dot(self, causal):
+    def test_matches_dot(self, causal, local_impl):
         mesh = build_mesh({"data": 2, "seq": 4})
         q, k, v = _qkv(b=2, s=64, h=4, d=16)
         ref = dot_attention(q, k, v, causal=causal)
-        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
-        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+        out = ulysses_attention_sharded(
+            q, k, v, mesh, causal=causal, local_impl=local_impl
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
 
-    def test_gradients_match_dot(self):
+    @pytest.mark.parametrize("local_impl", ["flash", "dot"])
+    def test_gradients_match_dot(self, local_impl):
         mesh = build_mesh({"data": 2, "seq": 4})
         q, k, v = _qkv(b=2, s=32, h=4, d=16)
         ref = _grads(
@@ -191,12 +195,16 @@ class TestUlyssesAttention:
         )
         got = _grads(
             lambda q, k, v: ulysses_attention_sharded(
-                q, k, v, mesh, causal=True
+                q, k, v, mesh, causal=True, local_impl=local_impl
             ),
             q, k, v,
         )
+        # flash runs the pallas backward kernels: f32 accumulation
+        # order differs from the dot reference (same bound as the ring
+        # grads test)
+        tol = 1e-4 if local_impl == "flash" else 1e-5
         for g, r in zip(got, ref):
-            np.testing.assert_allclose(g, r, atol=1e-5, rtol=1e-5)
+            np.testing.assert_allclose(g, r, atol=tol, rtol=tol)
 
     def test_head_divisibility_enforced(self):
         mesh = build_mesh({"data": 2, "seq": 4})
