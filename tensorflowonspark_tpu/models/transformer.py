@@ -101,7 +101,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode=False):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.head_dim
         dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
@@ -116,17 +116,59 @@ class Attention(nn.Module):
             v = dense("v", (h, d))(x)
         q = rope(q, positions)
         k = rope(k, positions)
-        out = attention(
-            q,
-            k,
-            v,
-            impl=cfg.attention_impl,
-            causal=True,
-            mesh=cfg.mesh,
-            seq_axis=cfg.seq_axis,
-            block_q=cfg.block_q,
-            block_k=cfg.block_k,
-        )
+        if decode:
+            # KV-cache autoregressive path: keys/values append at the
+            # write pointer (cache stores POST-rope keys — RoPE is
+            # absolute, so cached rotations stay valid); the query
+            # attends over the whole cache under an additive mask.
+            # Always dot attention: at s=1..P query rows the O(S²)
+            # logits the flash kernel avoids don't exist, and decode is
+            # HBM-bandwidth-bound on the cache read either way.
+            # The write index IS positions[0, 0] (rows are identical by
+            # construction) — no per-layer counter to keep in sync with
+            # the model-level position variable.  Cache capacity comes
+            # from the provided cache arrays' actual shape, so
+            # init_cache can size it to the generation length instead
+            # of cfg.max_seq_len and the per-step cache read shrinks
+            # proportionally.
+            b = x.shape[0]
+            ck = self.variable(
+                "cache", "cached_key", jnp.zeros,
+                (b, cfg.max_seq_len, h, d), cfg.jdtype,
+            )
+            cv = self.variable(
+                "cache", "cached_value", jnp.zeros,
+                (b, cfg.max_seq_len, h, d), cfg.jdtype,
+            )
+            i = positions[0, 0]
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(ck.value.dtype), (0, i, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cv.value.dtype), (0, i, 0, 0)
+            )
+            kpos = jnp.arange(ck.value.shape[1])
+            qpos = positions[0]
+            from tensorflowonspark_tpu.ops.attention import dot_attention
+
+            mask = jnp.where(
+                kpos[None, :] <= qpos[:, None], 0.0, -jnp.inf
+            )[None, None]  # [1,1,s,cache_len]
+            out = dot_attention(
+                q, ck.value, cv.value, causal=False, mask=mask
+            )
+        else:
+            out = attention(
+                q,
+                k,
+                v,
+                impl=cfg.attention_impl,
+                causal=True,
+                mesh=cfg.mesh,
+                seq_axis=cfg.seq_axis,
+                block_q=cfg.block_q,
+                block_k=cfg.block_k,
+            )
         return nn.DenseGeneral(
             cfg.embed_dim,
             axis=(-2, -1),
@@ -153,10 +195,10 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode=False):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(name="ln1")(x), positions
+            RMSNorm(name="ln1")(x), positions, decode=decode
         )
         h = RMSNorm(name="ln2")(x)
         if cfg.num_experts > 0:
@@ -183,7 +225,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, decode=False):
         cfg = self.cfg
         emb = self.param(
             "embedding",
@@ -191,24 +233,42 @@ class Transformer(nn.Module):
             (cfg.vocab_size, cfg.embed_dim),
         )
         x = emb[tokens].astype(cfg.jdtype)
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1]), tokens.shape
-        )
-        block = Block
-        if cfg.remat:
-            policy = None
-            if cfg.remat_policy == "dots":
-                policy = (
-                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                )
-            elif cfg.remat_policy != "block":
-                raise ValueError(
-                    "remat_policy must be 'block' or 'dots', got %r"
-                    % (cfg.remat_policy,)
-                )
+        if decode:
+            # absolute positions continue from the cache write pointer
+            # (one shared counter; the per-layer Attention counters
+            # advance in lockstep with it)
+            pos_var = self.variable(
+                "cache", "position", lambda: jnp.zeros((), jnp.int32)
+            )
+            start = pos_var.value
+            positions = jnp.broadcast_to(
+                start + jnp.arange(tokens.shape[1]), tokens.shape
+            )
+            pos_var.value = start + tokens.shape[1]
+        else:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1]), tokens.shape
+            )
+        if cfg.remat and cfg.remat_policy not in ("block", "dots"):
+            raise ValueError(
+                "remat_policy must be 'block' or 'dots', got %r"
+                % (cfg.remat_policy,)
+            )
+        if cfg.remat and not decode:
+            # remat is a training trade (recompute in backward); decode
+            # has no backward, and the wrapped call must not see the
+            # python-bool flag (jax.checkpoint would try to trace it)
+            policy = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                if cfg.remat_policy == "dots"
+                else None
+            )
             block = nn.remat(Block, static_argnums=(), policy=policy)
-        for i in range(cfg.num_layers):
-            x = block(cfg, name="block_%d" % i)(x, positions)
+            for i in range(cfg.num_layers):
+                x = block(cfg, name="block_%d" % i)(x, positions)
+        else:
+            for i in range(cfg.num_layers):
+                x = Block(cfg, name="block_%d" % i)(x, positions, decode)
         x = RMSNorm(name="ln_f")(x)
         # tied output head would shard awkwardly under TP; a separate
         # vocab projection keeps the ``vocab`` logical axis clean
@@ -252,6 +312,100 @@ def loss_fn(model):
         return jnp.mean(nll)
 
     return _loss
+
+
+def init_cache(model, batch_size, cache_len=None):
+    """A zeroed KV cache for ``batch_size`` sequences.
+
+    ``cache_len`` (default ``cfg.max_seq_len``) sizes the per-layer
+    key/value capacity; decode reads and masks the WHOLE cache every
+    step (bandwidth-bound), so size it to the actual generation length.
+    Shapes come from ``jax.eval_shape`` — no parameters are
+    materialized and no forward runs."""
+    length = cache_len if cache_len is not None else model.cfg.max_seq_len
+    stub = jnp.zeros((batch_size, 1), jnp.int32)
+    # decode must stay a python bool (it selects trace-time structure),
+    # so close over it instead of passing it through eval_shape's args
+    shapes = jax.eval_shape(
+        lambda k, s: model.init(k, s, decode=True),
+        jax.random.PRNGKey(0), stub,
+    )
+
+    def _zero(x):
+        if x.ndim == 4:  # [B, max_seq, H, D] key/value banks
+            b, _, h, d = x.shape
+            return jnp.zeros((b, length, h, d), x.dtype)
+        return jnp.zeros(x.shape, x.dtype)
+
+    return jax.tree.map(_zero, shapes["cache"])
+
+
+def generate(model, params, prompt, max_new_tokens, temperature=0.0,
+             rng=None):
+    """Autoregressive sampling with a KV cache.
+
+    New TPU-first capability (the reference has no text generation of
+    any kind).  Phase 1 prefills the cache with the whole prompt in one
+    forward (MXU-efficient: one [B,P] pass, not P decode steps); phase
+    2 is a ``lax.scan`` of single-token decode steps — static shapes,
+    one compiled program for the entire loop, cache updated in place
+    via ``dynamic_update_slice``.
+
+    Args:
+      model: a :class:`Transformer` (any attention_impl; decode always
+        runs dot-on-cache).
+      prompt: ``[B, P]`` int32; ``P + max_new_tokens`` must fit
+        ``cfg.max_seq_len``.
+      temperature: 0 = greedy argmax; otherwise categorical sampling
+        (requires ``rng``).
+    Returns ``[B, max_new_tokens]`` sampled tokens.
+    """
+    b, p = prompt.shape
+    total = p + max_new_tokens
+    if total > model.cfg.max_seq_len:
+        raise ValueError(
+            "prompt ({0}) + max_new_tokens ({1}) exceeds the cache "
+            "capacity max_seq_len={2}".format(
+                p, max_new_tokens, model.cfg.max_seq_len
+            )
+        )
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), jnp.int32)
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+    def sample(logits, key):
+        if temperature <= 0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # cache sized to the live positions, not cfg.max_seq_len: every
+    # decode step reads+masks the whole bank
+    cache = init_cache(model, b, cache_len=total)
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, decode=True,
+        mutable=["cache"],
+    )
+    rng, key = jax.random.split(rng)
+    first = sample(logits[:, -1], key)
+
+    def step(carry, key):
+        cache, tok = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            decode=True, mutable=["cache"],
+        )
+        nxt = sample(logits[:, 0], key)
+        return (mut["cache"], nxt), nxt
+
+    keys = jax.random.split(rng, max(0, max_new_tokens - 1))
+    (_, _), rest = jax.lax.scan(step, (mut["cache"], first), keys)
+    return jnp.concatenate(
+        [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
+    ) if max_new_tokens > 1 else first[:, None]
 
 
 def serving_builder(params, config):
