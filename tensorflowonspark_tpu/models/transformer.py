@@ -423,6 +423,27 @@ def serving_builder(params, config):
         **{k: v for k, v in overrides.items() if k in cfg_fields}
     )
     model = Transformer(cfg)
+    if config.get("mode") == "generate":
+        # generation serving: prompt batch in -> sampled continuations
+        # out (KV-cache decode; see generate()).  config keys:
+        # max_new_tokens (required), temperature, seed.
+        max_new = int(config["max_new_tokens"])
+        temperature = float(config.get("temperature", 0.0))
+        rng = jax.random.PRNGKey(int(config.get("seed", 0)))
+        variables = base.as_variables(params)
+
+        def _gen(v, tokens):
+            return generate(
+                model, v["params"], jnp.asarray(tokens, jnp.int32),
+                max_new, temperature=temperature, rng=rng,
+            )
+
+        return base.make_serving_predict(
+            variables,
+            _gen,
+            config.get("input_name", "tokens"),
+            lambda toks: {"generated": np.asarray(toks, np.int32)},
+        )
     return base.make_serving_predict(
         base.as_variables(params),
         lambda v, tokens: model.apply(v, jnp.asarray(tokens, jnp.int32)),

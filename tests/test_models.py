@@ -305,6 +305,22 @@ def test_serving_builders_roundtrip(tmp_path):
     assert out["logits"].shape == (2, 8, 64)
     assert out["next_token"].shape == (2,)
 
+    # transformer generation mode: prompt batch in -> greedy
+    # continuations out, equal to calling generate() directly
+    import jax.numpy as jnp
+
+    gen_predict = transformer.serving_builder(
+        jax.tree.map(np.asarray, tp),
+        dict(cfg, mode="generate", max_new_tokens=5),
+    )
+    prompt = np.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], np.int64)
+    gout = gen_predict({"tokens": prompt})
+    assert gout["generated"].shape == (2, 5)
+    direct = transformer.generate(
+        tm, tp, jnp.asarray(prompt, jnp.int32), 5
+    )
+    np.testing.assert_array_equal(gout["generated"], np.asarray(direct))
+
 
 def test_transformer_ring_matches_dot_logits():
     # model-level SP correctness: ring-attention transformer == dense
