@@ -299,6 +299,7 @@ def transformer_bench():
     # key; E>0 swaps the dense FFN for an E-expert top-k MoE
     c.setdefault("E", 0)
     c.setdefault("topk", 2)
+    c.setdefault("KV", 0)  # grouped-query kv heads (0 = MHA)
     c.update(json.loads(os.environ.get("TFOS_LM_CONFIG", "{}")))
     L, H, Dh, Dm, Dff, V, S, B = (
         c["L"], c["H"], c["Dh"], c["Dm"], c["Dff"], c["V"], c["S"], c["B"]
@@ -313,6 +314,7 @@ def transformer_bench():
         remat_policy=c["remat_policy"], fused_qkv=c["fused_qkv"],
         block_q=c["block_q"], block_k=c["block_k"],
         num_experts=c["E"], expert_k=c["topk"],
+        num_kv_heads=c["KV"],
     )
     model = tr.Transformer(cfg)
     tokens0 = jnp.zeros((1, S), jnp.int32)
@@ -520,7 +522,8 @@ def serving_tpu_bench():
     return out
 
 
-def decode_bench(batch=8, prompt_len=128, new_tokens=256):
+def decode_bench(batch=8, prompt_len=128, new_tokens=256,
+                 num_kv_heads=0):
     """Autoregressive generation throughput on the flagship model: the
     KV-cache decode path (prefill + one compiled lax.scan of
     single-token steps — the tunnel RTT amortizes over the whole
@@ -536,7 +539,7 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256):
     cfg = tr.TransformerConfig(
         vocab_size=32000, num_layers=16, num_heads=8, head_dim=128,
         embed_dim=1024, mlp_dim=4096, max_seq_len=2048,
-        dtype="bfloat16",
+        dtype="bfloat16", num_kv_heads=num_kv_heads,
     )
     model = tr.Transformer(cfg)
     prompt = jnp.asarray(

@@ -33,6 +33,10 @@ class TransformerConfig:
     vocab_size: int = 32000
     num_layers: int = 4
     num_heads: int = 8
+    #: grouped-query attention: kv heads (0 = num_heads = MHA).  Must
+    #: divide num_heads.  Shrinks kv projections, the decode cache, and
+    #: ring attention's rotating kv shards by num_heads/num_kv_heads.
+    num_kv_heads: int = 0
     head_dim: int = 64
     embed_dim: int = 512
     mlp_dim: int = 2048
@@ -104,16 +108,28 @@ class Attention(nn.Module):
     def __call__(self, x, positions, decode=False):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.head_dim
+        hkv = cfg.num_kv_heads or h
+        if h % hkv != 0:
+            raise ValueError(
+                "num_kv_heads ({0}) must divide num_heads ({1})".format(
+                    hkv, h
+                )
+            )
         dense = lambda name, feats: nn.DenseGeneral(  # noqa: E731
             feats, axis=-1, use_bias=False, dtype=cfg.jdtype, name=name
         )
         if cfg.fused_qkv:
+            if hkv != h:
+                raise ValueError(
+                    "fused_qkv requires equal q/kv head counts; use "
+                    "separate projections with num_kv_heads"
+                )
             qkv = dense("qkv", (3, h, d))(x)  # [B,S,3,H,D]
             q, k, v = (qkv[..., i, :, :] for i in range(3))
         else:
             q = dense("q", (h, d))(x)
-            k = dense("k", (h, d))(x)
-            v = dense("v", (h, d))(x)
+            k = dense("k", (hkv, d))(x)
+            v = dense("v", (hkv, d))(x)
         q = rope(q, positions)
         k = rope(k, positions)
         if decode:
@@ -134,11 +150,11 @@ class Attention(nn.Module):
             b = x.shape[0]
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (b, cfg.max_seq_len, h, d), cfg.jdtype,
+                (b, cfg.max_seq_len, hkv, d), cfg.jdtype,
             )
             cv = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (b, cfg.max_seq_len, h, d), cfg.jdtype,
+                (b, cfg.max_seq_len, hkv, d), cfg.jdtype,
             )
             i = positions[0, 0]
             ck.value = jax.lax.dynamic_update_slice(
@@ -340,8 +356,50 @@ def init_cache(model, batch_size, cache_len=None):
     return jax.tree.map(_zero, shapes["cache"])
 
 
+def sample_logits(logits, key, temperature=0.0, top_k=0, top_p=0.0):
+    """One sampling step on ``[B, V]`` logits.
+
+    ``temperature=0`` is greedy argmax; otherwise categorical after the
+    optional filters: ``top_k`` keeps the k highest logits, ``top_p``
+    keeps the smallest prefix of the probability-sorted vocabulary
+    whose mass reaches p (nucleus sampling; the top token always
+    survives).  Filters compose (top-k first, as usual).  All static
+    shapes — sort/threshold, no dynamic vocab slicing."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    neg = jnp.float32(-1e30)
+    use_k = bool(top_k) and 0 < top_k < logits.shape[-1]
+    use_p = bool(top_p) and 0.0 < top_p < 1.0
+    if use_k or use_p:
+        # one descending sort serves both filters (the sort dominates
+        # per-token sampling cost inside the decode scan)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    if use_k:
+        kth = sorted_logits[:, top_k - 1][:, None]
+        logits = jnp.where(logits >= kth, logits, neg)
+        sorted_logits = jnp.where(
+            jnp.arange(sorted_logits.shape[-1])[None, :] < top_k,
+            sorted_logits, neg,
+        )
+    if use_p:
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep ranks whose PRECEDING mass is < p (top rank always kept)
+        keep = jnp.concatenate(
+            [jnp.ones_like(cum[:, :1], bool), cum[:, :-1] < top_p],
+            axis=-1,
+        )
+        # threshold logit: the smallest kept value per row
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1
+        )[:, None]
+        logits = jnp.where(logits >= cutoff, logits, neg)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None):
+             rng=None, top_k=0, top_p=0.0):
     """Autoregressive sampling with a KV cache.
 
     New TPU-first capability (the reference has no text generation of
@@ -357,7 +415,8 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
       prompt: ``[B, P]`` int32; ``P + max_new_tokens`` must fit
         ``cfg.max_seq_len``.
       temperature: 0 = greedy argmax; otherwise categorical sampling
-        (requires ``rng``).
+        (requires ``rng``), filtered by ``top_k``/``top_p`` (see
+        :func:`sample_logits`).
     Returns ``[B, max_new_tokens]`` sampled tokens.
     """
     b, p = prompt.shape
@@ -376,11 +435,9 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
     def sample(logits, key):
-        if temperature <= 0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        return sample_logits(
+            logits, key, temperature=temperature, top_k=top_k, top_p=top_p
+        )
 
     # cache sized to the live positions, not cfg.max_seq_len: every
     # decode step reads+masks the whole bank
@@ -426,9 +483,11 @@ def serving_builder(params, config):
     if config.get("mode") == "generate":
         # generation serving: prompt batch in -> sampled continuations
         # out (KV-cache decode; see generate()).  config keys:
-        # max_new_tokens (required), temperature, seed.
+        # max_new_tokens (required), temperature, top_k, top_p, seed.
         max_new = int(config["max_new_tokens"])
         temperature = float(config.get("temperature", 0.0))
+        top_k = int(config.get("top_k", 0))
+        top_p = float(config.get("top_p", 0.0))
         rng = jax.random.PRNGKey(int(config.get("seed", 0)))
         variables = base.as_variables(params)
 
@@ -436,6 +495,7 @@ def serving_builder(params, config):
             return generate(
                 model, v["params"], jnp.asarray(tokens, jnp.int32),
                 max_new, temperature=temperature, rng=rng,
+                top_k=top_k, top_p=top_p,
             )
 
         return base.make_serving_predict(

@@ -27,18 +27,35 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
     """Plain softmax attention via XLA einsums.
 
     Args:
-      q: ``[B, Sq, H, D]``; k, v: ``[B, Sk, H, D]``.
+      q: ``[B, Sq, H, D]``; k, v: ``[B, Sk, Hkv, D]`` where ``Hkv``
+        divides ``H`` (grouped-query attention: each kv head serves
+        ``H/Hkv`` query heads; ``Hkv == H`` is ordinary MHA).  The
+        grouped einsums never materialize repeated k/v.
       causal: apply a causal mask (positions aligned at the end).
       mask: optional additive mask broadcastable to ``[B, H, Sq, Sk]``.
     Returns ``[B, Sq, H, D]`` in ``q.dtype``.
     """
     orig_dtype = q.dtype
     scale = scale if scale is not None else q.shape[-1] ** -0.5
+    h, hkv = q.shape[2], k.shape[2]
+    if h % hkv != 0:
+        raise ValueError(
+            "query heads ({0}) must be a multiple of kv heads "
+            "({1})".format(h, hkv)
+        )
+    g = h // hkv
     # accumulate logits/softmax in f32 for stability (bf16 inputs stay
     # bf16 through the matmuls — MXU native — but the reduction is f32)
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    )
+    if g == 1:
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        )
+    else:
+        qg = q.reshape(q.shape[0], q.shape[1], hkv, g, q.shape[3])
+        logits = jnp.einsum(
+            "bqhgd,bkhd->bhgqk", qg, k,
+            preferred_element_type=jnp.float32,
+        ).reshape(q.shape[0], h, q.shape[1], k.shape[1])
     logits = logits * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
@@ -51,10 +68,19 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
     if mask is not None:
         logits = logits + mask
     weights = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum(
-        "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )
+    if g == 1:
+        out = jnp.einsum(
+            "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        wg = weights.reshape(
+            q.shape[0], hkv, g, q.shape[1], k.shape[1]
+        )
+        out = jnp.einsum(
+            "bhgqk,bkhd->bqhgd", wg.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        ).reshape(q.shape[0], q.shape[1], h, q.shape[3])
     return out.astype(orig_dtype)
 
 

@@ -64,6 +64,11 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
         per hop; numerics reference).
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            "query heads ({0}) must be a multiple of kv heads "
+            "({1})".format(q.shape[2], k.shape[2])
+        )
     if impl == "flash":
         # fall back to the dense inner step when the kernels can't run
         # (traced scale / untileable shard length) so the pre-flash
@@ -191,9 +196,10 @@ def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, res, dout):
         dot_.astype(f32) * ot.astype(f32), axis=-1
     )[..., None]  # [B,H,S,1]
 
+    kv_shape = (k.shape[0], k.shape[2], k.shape[1], k.shape[3])
     dq0 = jnp.zeros(qt.shape, f32)
-    dk0 = jnp.zeros(qt.shape, f32)
-    dv0 = jnp.zeros(qt.shape, f32)
+    dk0 = jnp.zeros(kv_shape, f32)  # kv head count (GQA-aware)
+    dv0 = jnp.zeros(kv_shape, f32)
 
     def _chunk_grads(kt_cur, vt_cur, chunk_causal):
         dq_c, dk_c, dv_c = _bwd_core(
@@ -268,6 +274,11 @@ def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq"):
     p = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
+    if k.shape[2] != h:
+        # grouped kv: the dense einsums want matching head counts; the
+        # reference path trades the memory win for simplicity
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
 
     qf = q.astype(jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]

@@ -42,11 +42,12 @@ def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
     p = lax.axis_size(axis_name)
-    h = q.shape[2]
-    if h % p != 0:
+    h, hkv = q.shape[2], k.shape[2]
+    if h % p != 0 or hkv % p != 0:
         raise ValueError(
-            "ulysses needs heads ({0}) divisible by the seq axis size "
-            "({1}); use ring attention instead".format(h, p)
+            "ulysses needs query heads ({0}) and kv heads ({1}) "
+            "divisible by the seq axis size ({2}); use ring attention "
+            "instead".format(h, hkv, p)
         )
     if local_impl == "flash":
         s_val = scale if scale is not None else q.shape[-1] ** -0.5

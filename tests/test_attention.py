@@ -213,6 +213,98 @@ class TestUlyssesAttention:
             ulysses_attention_sharded(q, k, v, mesh)
 
 
+class TestGroupedQueryAttention:
+    """GQA: kv heads Hkv < H; numerics must equal repeating kv."""
+
+    def _gqa(self, b=2, s=64, h=4, hkv=2, d=16, seed=0):
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32) * 0.5)
+        k = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32) * 0.5)
+        v = jnp.asarray(rng.randn(b, s, hkv, d).astype(np.float32) * 0.5)
+        return q, k, v
+
+    @staticmethod
+    def _repeat_ref(q, k, v, causal):
+        g = q.shape[2] // k.shape[2]
+        return dot_attention(
+            q, jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2),
+            causal=causal,
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_dot_grouped_matches_repeated(self, causal):
+        q, k, v = self._gqa()
+        np.testing.assert_allclose(
+            dot_attention(q, k, v, causal=causal),
+            self._repeat_ref(q, k, v, causal),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_matches_repeated(self, causal):
+        q, k, v = self._gqa(s=128)
+        out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+        np.testing.assert_allclose(
+            out, self._repeat_ref(q, k, v, causal),
+            atol=2e-3, rtol=2e-3,
+        )
+
+    def test_flash_gradients_match_repeated(self):
+        q, k, v = self._gqa(s=64)
+        g = q.shape[2] // k.shape[2]
+
+        def ref_loss(q, k, v):
+            return jnp.sum(jnp.sin(self._repeat_ref(q, k, v, True)))
+
+        def got_loss(q, k, v):
+            return jnp.sum(jnp.sin(flash_attention(
+                q, k, v, causal=True, block_q=32, block_k=32
+            )))
+
+        ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        got = jax.grad(got_loss, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a, b, atol=5e-3, rtol=5e-3)
+
+    @pytest.mark.parametrize("impl", ["flash", "dense"])
+    def test_ring_matches_repeated(self, impl):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = self._gqa(s=64)
+        out = ring_attention_sharded(q, k, v, mesh, causal=True, impl=impl)
+        np.testing.assert_allclose(
+            out, self._repeat_ref(q, k, v, True), atol=2e-4, rtol=2e-4
+        )
+
+    def test_ring_flash_gradients_match_repeated(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = self._gqa(s=32)
+        ref = _grads(
+            lambda q, k, v: self._repeat_ref(q, k, v, True), q, k, v
+        )
+        got = _grads(
+            lambda q, k, v: ring_attention_sharded(
+                q, k, v, mesh, causal=True, impl="flash"
+            ),
+            q, k, v,
+        )
+        for a, b in zip(got, ref):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_ulysses_matches_repeated(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = self._gqa(s=64, h=8, hkv=4)
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(
+            out, self._repeat_ref(q, k, v, True), atol=1e-4, rtol=1e-4
+        )
+
+    def test_ulysses_rejects_unsplittable_kv_heads(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = self._gqa(s=32, h=8, hkv=2)  # hkv=2 not divisible by 4
+        with pytest.raises(Exception, match="kv heads"):
+            ulysses_attention_sharded(q, k, v, mesh)
+
+
 class TestDispatcher:
     def test_dispatch_dot(self):
         q, k, v = _qkv(s=16)

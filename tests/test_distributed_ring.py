@@ -30,7 +30,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tensorflowonspark_tpu.ops.ring_attention import ring_attention_sharded
 from tensorflowonspark_tpu.ops.ulysses import ulysses_attention_sharded
 
-B, S, H, D = 2, 32, 4, 8
+B = 2
+S = int(os.environ.get("TFOS_RING_S", "32"))
+H, D = 4, 8
 rng = np.random.RandomState(0)
 q = rng.randn(B, S, H, D).astype(np.float32)
 k = rng.randn(B, S, H, D).astype(np.float32)
@@ -55,16 +57,17 @@ for name, fn in (
 """
 
 
-def test_ring_attention_across_two_processes(tmp_path):
+def _run_and_check(tmp_path, seq_len):
     out_base = str(tmp_path / "ring_out")
     outputs = launch_two_workers(
-        _WORKER, tmp_path, extra_env={"TFOS_OUT": out_base}
+        _WORKER, tmp_path,
+        extra_env={"TFOS_OUT": out_base, "TFOS_RING_S": str(seq_len)},
     )
 
     # reference: dense attention, single process
     from tensorflowonspark_tpu.ops.attention import dot_attention
 
-    B, S, H, D = 2, 32, 4, 8
+    B, S, H, D = 2, seq_len, 4, 8
     rng = np.random.RandomState(0)
     q = rng.randn(B, S, H, D).astype(np.float32)
     k = rng.randn(B, S, H, D).astype(np.float32)
@@ -81,3 +84,15 @@ def test_ring_attention_across_two_processes(tmp_path):
             np.testing.assert_allclose(
                 got, ref, atol=1e-5, rtol=1e-5, err_msg=name
             )
+
+
+def test_ring_attention_across_two_processes(tmp_path):
+    _run_and_check(tmp_path, 32)
+
+
+def test_ring_attention_across_processes_multiblock(tmp_path):
+    # S=512 over 4 devices: each visiting chunk is S_local=128, so the
+    # flash inner step really tiles per hop while the kv rotation
+    # crosses the PROCESS boundary over Gloo — the composed long-context
+    # path end to end, not the degenerate one-block case
+    _run_and_check(tmp_path, 512)

@@ -176,6 +176,98 @@ class TestTransformer:
         np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
         assert int(jnp.max(out)) < 64 and out.shape == (1, 4)
 
+    def test_gqa_matches_repeated_kv_weights(self):
+        # a GQA model with kv weights TILED to full heads must equal
+        # the MHA model: grouped attention == repeat-kv attention
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        gqa, _ = self._tiny(num_kv_heads=1)
+        mha, _ = self._tiny()
+        tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 12), 0, 64)
+        p_gqa = gqa.init(jax.random.PRNGKey(0), tokens)["params"]
+        p_mha = jax.tree.map(lambda x: x, p_gqa)  # copy structure
+        for i in range(2):
+            blk = p_mha["block_%d" % i]["attn"]
+            blk["k"] = {"kernel": jnp.tile(
+                p_gqa["block_%d" % i]["attn"]["k"]["kernel"], (1, 2, 1)
+            )}
+            blk["v"] = {"kernel": jnp.tile(
+                p_gqa["block_%d" % i]["attn"]["v"]["kernel"], (1, 2, 1)
+            )}
+        np.testing.assert_allclose(
+            np.asarray(gqa.apply({"params": p_gqa}, tokens)),
+            np.asarray(mha.apply({"params": p_mha}, tokens)),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    def test_gqa_decode_matches_full_forward(self):
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(num_kv_heads=1, max_seq_len=32)
+        tokens = jax.random.randint(jax.random.PRNGKey(5), (2, 10), 0, 64)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        full = model.apply({"params": params}, tokens)
+        cache = tr.init_cache(model, 2)
+        # cache banks carry the REDUCED kv head count
+        banks = [
+            x for x in jax.tree.leaves(cache) if getattr(x, "ndim", 0) == 4
+        ]
+        assert all(b.shape[2] == 1 for b in banks)
+        pre, _ = model.apply(
+            {"params": params, "cache": cache}, tokens, decode=True,
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(pre), np.asarray(full), atol=1e-5, rtol=1e-5
+        )
+
+    def test_gqa_rejects_bad_head_counts(self):
+        import pytest as _pytest
+
+        model, _ = self._tiny(num_kv_heads=3)  # 2 heads % 3 != 0
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        with _pytest.raises(ValueError, match="divide"):
+            model.init(jax.random.PRNGKey(0), tokens)
+        fused, _ = self._tiny(num_kv_heads=1, fused_qkv=True)
+        with _pytest.raises(ValueError, match="fused_qkv"):
+            fused.init(jax.random.PRNGKey(0), tokens)
+
+    def test_sample_logits_filters(self):
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        logits = jnp.asarray(
+            [[4.0, 3.0, 2.0, 1.0, 0.0], [0.0, 1.0, 2.0, 3.0, 4.0]]
+        )
+        key = jax.random.PRNGKey(0)
+        # temperature 0 = greedy
+        np.testing.assert_array_equal(
+            np.asarray(tr.sample_logits(logits, key)), [0, 4]
+        )
+        # top_k=1 collapses sampling to greedy at any temperature
+        np.testing.assert_array_equal(
+            np.asarray(
+                tr.sample_logits(logits, key, temperature=5.0, top_k=1)
+            ),
+            [0, 4],
+        )
+        # tiny top_p keeps only the top token
+        np.testing.assert_array_equal(
+            np.asarray(
+                tr.sample_logits(logits, key, temperature=5.0, top_p=1e-6)
+            ),
+            [0, 4],
+        )
+        # top_k=2: every sample must come from the two highest logits
+        keys = jax.random.split(jax.random.PRNGKey(1), 64)
+        draws = np.stack([
+            np.asarray(
+                tr.sample_logits(logits, k, temperature=2.0, top_k=2)
+            )
+            for k in keys
+        ])
+        assert set(draws[:, 0]) <= {0, 1}
+        assert set(draws[:, 1]) <= {3, 4}
+
     def test_loss_decreases(self):
         import optax
 
