@@ -61,6 +61,10 @@ class TransformerConfig:
     #: pallas flash-attention block shape (attention_impl="flash")
     block_q: int = 1024
     block_k: int = 1024
+    #: sliding-window (local) attention: each position sees the last
+    #: ``attention_window`` tokens (0 = full causal).  dot/flash impls
+    #: only; flash skips blocks behind the horizon (O(S·W) compute).
+    attention_window: int = 0
     # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
     # MoE FFN (models/moe.py) in every block
     num_experts: int = 0
@@ -167,9 +171,13 @@ class Attention(nn.Module):
             qpos = positions[0]
             from tensorflowonspark_tpu.ops.attention import dot_attention
 
-            mask = jnp.where(
-                kpos[None, :] <= qpos[:, None], 0.0, -jnp.inf
-            )[None, None]  # [1,1,s,cache_len]
+            visible = kpos[None, :] <= qpos[:, None]
+            if cfg.attention_window:
+                visible = jnp.logical_and(
+                    visible,
+                    kpos[None, :] > qpos[:, None] - cfg.attention_window,
+                )
+            mask = jnp.where(visible, 0.0, -jnp.inf)[None, None]
             out = dot_attention(
                 q, ck.value, cv.value, causal=False, mask=mask
             )
@@ -184,6 +192,7 @@ class Attention(nn.Module):
                 seq_axis=cfg.seq_axis,
                 block_q=cfg.block_q,
                 block_k=cfg.block_k,
+                window=cfg.attention_window,
             )
         return nn.DenseGeneral(
             cfg.embed_dim,

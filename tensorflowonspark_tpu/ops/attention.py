@@ -23,7 +23,7 @@ import jax.numpy as jnp
 _IMPLS = ("dot", "flash", "ring", "ulysses")
 
 
-def dot_attention(q, k, v, causal=True, scale=None, mask=None):
+def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
     """Plain softmax attention via XLA einsums.
 
     Args:
@@ -33,8 +33,17 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
         grouped einsums never materialize repeated k/v.
       causal: apply a causal mask (positions aligned at the end).
       mask: optional additive mask broadcastable to ``[B, H, Sq, Sk]``.
+      window: ``> 0`` restricts each query to the last ``window``
+        positions (sliding-window attention; requires ``causal``).
     Returns ``[B, Sq, H, D]`` in ``q.dtype``.
     """
+    if window:
+        if window < 0:
+            raise ValueError(
+                "window must be positive, got {0}".format(window)
+            )
+        if not causal:
+            raise ValueError("window attention requires causal=True")
     orig_dtype = q.dtype
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     h, hkv = q.shape[2], k.shape[2]
@@ -64,7 +73,10 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
         # and decode steps (sq == 1)
         qpos = jnp.arange(sq)[:, None] + (sk - sq)
         kpos = jnp.arange(sk)[None, :]
-        logits = jnp.where(qpos >= kpos, logits, -jnp.inf)
+        visible = qpos >= kpos
+        if window:
+            visible = jnp.logical_and(visible, kpos > qpos - window)
+        logits = jnp.where(visible, logits, -jnp.inf)
     if mask is not None:
         logits = logits + mask
     weights = jax.nn.softmax(logits, axis=-1)
@@ -86,7 +98,7 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None):
 
 def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
               seq_axis="seq", block_q=1024, block_k=1024,
-              ring_impl="flash"):
+              ring_impl="flash", window=0):
     """Dispatch to an attention implementation (see module docstring).
 
     ``ring``/``ulysses`` dispatch on ``mesh``: with ``mesh=None`` the
@@ -103,11 +115,17 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
     """
     if impl not in _IMPLS:
         raise ValueError("unknown attention impl {0!r}; one of {1}".format(impl, _IMPLS))
+    if window and impl not in ("dot", "flash"):
+        raise ValueError(
+            "sliding-window attention is supported by the dot and flash "
+            "impls; got impl={0!r}".format(impl)
+        )
     if impl == "flash":
         from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, scale=scale, block_q=block_q, block_k=block_k
+            q, k, v, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, window=window,
         )
     if impl == "ring":
         from tensorflowonspark_tpu.ops.ring_attention import (
@@ -140,4 +158,4 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
             q, k, v, causal=causal, scale=scale, axis_name=seq_axis,
             block_q=block_q, block_k=block_k,
         )
-    return dot_attention(q, k, v, causal=causal, scale=scale)
+    return dot_attention(q, k, v, causal=causal, scale=scale, window=window)

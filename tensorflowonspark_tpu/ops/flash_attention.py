@@ -67,31 +67,82 @@ def _compiler_params():
     )
 
 
-def _causal_mask(qi, kj, block_q, block_k):
+def _causal_mask(qi, kj, block_q, block_k, window=0):
+    """Causal mask for block (qi, kj); ``window > 0`` additionally
+    drops keys more than ``window - 1`` positions behind the query
+    (sliding-window / local attention)."""
     qpos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     kpos = kj * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
-    return qpos >= kpos
+    mask = qpos >= kpos
+    if window:
+        mask = jnp.logical_and(mask, kpos > qpos - window)
+    return mask
+
+
+def _block_relevant(qi, kj, block_q, block_k, causal, window):
+    """Whether block (qi, kj) contributes anything: causal skips blocks
+    strictly above the diagonal; a window additionally skips blocks
+    entirely behind the horizon — the compute saving that makes local
+    attention O(S*W) instead of O(S^2/2)."""
+    relevant = True
+    if causal:
+        relevant = kj * block_k < (qi + 1) * block_q
+    if window:
+        relevant = jnp.logical_and(
+            relevant, (kj + 1) * block_k > qi * block_q - window + 1
+        )
+    return relevant
+
+
+def _diag_block(qi, jj, block_q, block_k):
+    """Banded kv walk: j-th step visits kv block (diagonal - j).  The
+    ONE definition both the kernels and the BlockSpec index maps use —
+    fetch and compute must address the same block."""
+    return ((qi + 1) * block_q - 1) // block_k - jj
+
+
+def _q_band_block(kj, jj, block_q, block_k):
+    """Banded q walk for dk/dv: j-th step visits q block
+    (first-on-or-after-diagonal + j)."""
+    return kj * block_k // block_q + jj
+
+
+def _band_steps(window, block_a, block_b, total_b):
+    """Grid size of the trailing (streamed) dim when windowed: how many
+    ``block_b``-wide blocks a ``block_a``-wide resident block can touch
+    under a ``window`` horizon (plus the diagonal spill).  Falling back
+    to the full count means banding is off (window >= seq)."""
+    band = (block_a + window - 2) // block_b + 2
+    return min(total_b, band)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, num_k_blocks):
+                *, scale, causal, block_q, block_k, grid_steps,
+                window=0, banded=False):
     qi = pl.program_id(2)
-    kj = pl.program_id(3)
+    jj = pl.program_id(3)
+    if banded:
+        # only blocks inside the window band are ever fetched
+        # (O(S*W) DMA, not O(S^2))
+        kj = _diag_block(qi, jj, block_q, block_k)
+        in_range = kj >= 0
+    else:
+        kj = jj
+        in_range = True
 
-    @pl.when(kj == 0)
+    @pl.when(jj == 0)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: skip blocks strictly above the diagonal
-    relevant = True
-    if causal:
-        relevant = kj * block_k < (qi + 1) * block_q
+    relevant = jnp.logical_and(
+        in_range, _block_relevant(qi, kj, block_q, block_k, causal, window)
+    )
 
     @pl.when(relevant)
     def _compute():
@@ -109,7 +160,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32,
         ) * scale  # [block_q, block_k] f32
         if causal:
-            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
+            s = jnp.where(
+                _causal_mask(qi, kj, block_q, block_k, window), s, NEG_INF
+            )
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1))
@@ -122,7 +175,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(kj == num_k_blocks - 1)
+    @pl.when(jj == grid_steps - 1)
     def _finalize():
         l_safe = jnp.maximum(l_scr[:, 0], 1e-30)
         o_ref[0, 0] = (acc_scr[...] / l_safe[:, None]).astype(o_ref.dtype)
@@ -130,17 +183,24 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, causal, block_q, block_k, num_k_blocks):
+               dq_scr, *, scale, causal, block_q, block_k, grid_steps,
+               window=0, banded=False):
     qi = pl.program_id(2)
-    kj = pl.program_id(3)
+    jj = pl.program_id(3)
+    if banded:
+        kj = _diag_block(qi, jj, block_q, block_k)
+        in_range = kj >= 0
+    else:
+        kj = jj
+        in_range = True
 
-    @pl.when(kj == 0)
+    @pl.when(jj == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    relevant = True
-    if causal:
-        relevant = kj * block_k < (qi + 1) * block_q
+    relevant = jnp.logical_and(
+        in_range, _block_relevant(qi, kj, block_q, block_k, causal, window)
+    )
 
     @pl.when(relevant)
     def _compute():
@@ -157,7 +217,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
+            s = jnp.where(
+                _causal_mask(qi, kj, block_q, block_k, window), s, NEG_INF
+            )
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -169,26 +231,32 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(kj == num_k_blocks - 1)
+    @pl.when(jj == grid_steps - 1)
     def _finalize():
         dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *,
-                scale, causal, block_q, block_k, num_q_blocks):
+                scale, causal, block_q, block_k, num_q_blocks,
+                grid_steps, window=0, banded=False):
     kj = pl.program_id(2)
-    qi = pl.program_id(3)
+    jj = pl.program_id(3)
+    if banded:
+        qi = _q_band_block(kj, jj, block_q, block_k)
+        in_range = qi < num_q_blocks
+    else:
+        qi = jj
+        in_range = True
 
-    @pl.when(qi == 0)
+    @pl.when(jj == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    relevant = True
-    if causal:
-        # q blocks strictly above the diagonal contribute nothing
-        relevant = (qi + 1) * block_q > kj * block_k
+    relevant = jnp.logical_and(
+        in_range, _block_relevant(qi, kj, block_q, block_k, causal, window)
+    )
 
     @pl.when(relevant)
     def _compute():
@@ -204,7 +272,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            s = jnp.where(_causal_mask(qi, kj, block_q, block_k), s, NEG_INF)
+            s = jnp.where(
+                _causal_mask(qi, kj, block_q, block_k, window), s, NEG_INF
+            )
         p = jnp.exp(s - lse[:, None])  # [block_q, block_k] f32
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -220,7 +290,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == num_q_blocks - 1)
+    @pl.when(jj == grid_steps - 1)
     def _finalize():
         dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
@@ -267,15 +337,18 @@ def _block_sizes(seq_len, block_q, block_k):
     return bq, bk
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, scale, causal, block_q, block_k, window=0):
     # [B,S,H,D] -> [B,H,S,D]: heads become a grid dim, seq stays blocked
     qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
-    out_t, lse = _fwd_core(qt, kt, vt, scale, causal, block_q, block_k)
+    out_t, lse = _fwd_core(
+        qt, kt, vt, scale, causal, block_q, block_k, window=window
+    )
     out = jnp.swapaxes(out_t, 1, 2)
     return out, (q, k, v, out, lse)
 
 
-def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None):
+def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None,
+              window=0):
     """Forward on ``[B,H,S,D]`` (transposed) tensors; returns
     ``(out_t [B,H,S,D], lse [B,H,S,1])``.  Split out so callers that
     loop over kv chunks (ring attention) can keep everything in the
@@ -290,24 +363,33 @@ def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None):
     b, h, s, d = qt.shape
     g = h // kt.shape[1]
     bq, bk = _block_sizes(s, block_q, block_k)
-    grid = (b, h, s // bq, s // bk)
+    # windowed: stream only the band of kv blocks the horizon can
+    # touch, descending from the diagonal — blocks outside the window
+    # are never DMA'd (banding off when the band wouldn't shrink)
+    steps = _band_steps(window, bq, bk, s // bk) if (
+        causal and window
+    ) else s // bk
+    banded = steps < s // bk
+    grid = (b, h, s // bq, steps)
+
+    def _kv_idx(bi, hi, qi, jj, g=g):
+        if banded:
+            kj = _diag_block(qi, jj, bq, bk)
+            return (bi, hi // g, jnp.maximum(kj, 0), 0)
+        return (bi, hi // g, jj, 0)
+
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, num_k_blocks=s // bk,
+        block_q=bq, block_k=bk, grid_steps=steps, window=window,
+        banded=banded,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, d),
-                lambda bi, hi, qi, kj, g=g: (bi, hi // g, kj, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, d),
-                lambda bi, hi, qi, kj, g=g: (bi, hi // g, kj, 0),
-            ),
+            pl.BlockSpec((1, 1, bk, d), _kv_idx),
+            pl.BlockSpec((1, 1, bk, d), _kv_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
@@ -328,7 +410,7 @@ def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None):
     return out, lse
 
 
-def _bwd(scale, causal, block_q, block_k, residuals, dout):
+def _bwd(scale, causal, block_q, block_k, window, residuals, dout):
     q, k, v, out, lse = residuals
     qt, kt, vt, ot, dot_ = (
         jnp.swapaxes(x, 1, 2) for x in (q, k, v, out, dout)
@@ -338,7 +420,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
         dot_.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
     )[..., None]  # [B,H,S,1] (lane axis; see lse layout note)
     dqt, dkt, dvt = _bwd_core(
-        scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta
+        scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta,
+        window=window,
     )
     return (
         jnp.swapaxes(dqt, 1, 2),
@@ -347,7 +430,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, dout):
     )
 
 
-def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta):
+def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse,
+              delta, window=0):
     """Backward on ``[B,H,S,D]`` (transposed) tensors with the
     loop-invariant ``delta`` precomputed by the caller; returns
     ``(dqt, dkt, dvt)`` in the same layout (``dkt``/``dvt`` carry the
@@ -362,24 +446,35 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta):
     hkv = kt.shape[1]
     g = h // hkv
     bq, bk = _block_sizes(s, block_q, block_k)
+    # banded grids mirror the forward (see _fwd_core): dq streams kv
+    # blocks down from the diagonal, dk/dv stream q blocks up from it
+    kv_steps = _band_steps(window, bq, bk, s // bk) if (
+        causal and window
+    ) else s // bk
+    kv_banded = kv_steps < s // bk
+    q_steps = _band_steps(window, bk, bq, s // bq) if (
+        causal and window
+    ) else s // bq
+    q_banded = q_steps < s // bq
+
+    def _kv_idx(bi, hi, qi, jj, g=g):
+        if kv_banded:
+            kj = _diag_block(qi, jj, bq, bk)
+            return (bi, hi // g, jnp.maximum(kj, 0), 0)
+        return (bi, hi // g, jj, 0)
 
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
-        block_q=bq, block_k=bk, num_k_blocks=s // bk,
+        block_q=bq, block_k=bk, grid_steps=kv_steps, window=window,
+        banded=kv_banded,
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b, h, s // bq, s // bk),
+        grid=(b, h, s // bq, kv_steps),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
-            pl.BlockSpec(
-                (1, 1, bk, d),
-                lambda bi, hi, qi, kj, g=g: (bi, hi // g, kj, 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, bk, d),
-                lambda bi, hi, qi, kj, g=g: (bi, hi // g, kj, 0),
-            ),
+            pl.BlockSpec((1, 1, bk, d), _kv_idx),
+            pl.BlockSpec((1, 1, bk, d), _kv_idx),
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
@@ -393,15 +488,22 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta):
         compiler_params=_compiler_params(),
     )(qt, kt, vt, dot_, lse, delta)
 
+    def _q_idx(bi, hi, kj, jj):
+        if q_banded:
+            qi = _q_band_block(kj, jj, bq, bk)
+            return (bi, hi, jnp.minimum(qi, s // bq - 1), 0)
+        return (bi, hi, jj, 0)
+
     dkv_kernel = functools.partial(
         _dkv_kernel, scale=scale, causal=causal,
         block_q=bq, block_k=bk, num_q_blocks=s // bq,
+        grid_steps=q_steps, window=window, banded=q_banded,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b, h, s // bk, s // bq),
+        grid=(b, h, s // bk, q_steps),
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), _q_idx),
             pl.BlockSpec(
                 (1, 1, bk, d),
                 lambda bi, hi, kj, qi, g=g: (bi, hi // g, kj, 0),
@@ -410,9 +512,9 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta):
                 (1, 1, bk, d),
                 lambda bi, hi, kj, qi, g=g: (bi, hi // g, kj, 0),
             ),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, kj, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, d), _q_idx),
+            pl.BlockSpec((1, 1, bq, 1), _q_idx),
+            pl.BlockSpec((1, 1, bq, 1), _q_idx),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj, qi: (bi, hi, kj, 0)),
@@ -444,21 +546,21 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse, delta):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, window):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k, window)
     return out
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k):
-    return _fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, window):
+    return _fwd(q, k, v, scale, causal, block_q, block_k, window)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block_q=1024,
-                    block_k=1024):
+                    block_k=1024, window=0):
     """Flash attention on ``[B, S, H, D]`` tensors (self-attention:
     q/k/v share the sequence length).
 
@@ -466,6 +568,11 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=1024,
     ``H % Hkv == 0`` (each kv head serves ``H/Hkv`` query heads) — the
     kernels stream each kv head's blocks to its whole query group, no
     repeated-kv materialization.
+
+    ``window > 0`` is sliding-window (local) attention: position ``i``
+    attends to ``[i-window+1, i]``; requires ``causal``.  Blocks
+    entirely behind the horizon are skipped, so compute is O(S·window)
+    instead of O(S²/2).
 
     Differentiable via custom pallas backward kernels.  ``seq_len`` must
     divide by the (clamped) block sizes — pad upstream if not.  The
@@ -484,5 +591,14 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=1024,
             "q [B,S,H,D] vs k/v [B,S,Hkv,D], H % Hkv == 0; got q={0} "
             "k={1}".format(q.shape, k.shape)
         )
+    if window:
+        if window < 0:
+            raise ValueError(
+                "window must be positive, got {0}".format(window)
+            )
+        if not causal:
+            raise ValueError("window attention requires causal=True")
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash(q, k, v, float(scale), bool(causal), block_q, block_k)
+    return _flash(
+        q, k, v, float(scale), bool(causal), block_q, block_k, int(window)
+    )

@@ -305,6 +305,73 @@ class TestGroupedQueryAttention:
             ulysses_attention_sharded(q, k, v, mesh)
 
 
+class TestSlidingWindow:
+    """window attention: position i sees [i-W+1, i]."""
+
+    @staticmethod
+    def _mask_ref(q, k, v, window):
+        s = q.shape[1]
+        qpos = jnp.arange(s)[:, None]
+        kpos = jnp.arange(s)[None, :]
+        visible = (qpos >= kpos) & (kpos > qpos - window)
+        mask = jnp.where(visible, 0.0, -jnp.inf)[None, None]
+        return dot_attention(q, k, v, causal=False, mask=mask)
+
+    def test_dot_window_matches_mask(self):
+        q, k, v = _qkv(s=48)
+        np.testing.assert_allclose(
+            dot_attention(q, k, v, causal=True, window=16),
+            self._mask_ref(q, k, v, 16),
+            atol=1e-5, rtol=1e-5,
+        )
+
+    @pytest.mark.parametrize("window", [16, 100, 7])
+    def test_flash_window_matches_dot(self, window):
+        q, k, v = _qkv(s=128)
+        out = flash_attention(
+            q, k, v, causal=True, window=window, block_q=32, block_k=32
+        )
+        ref = dot_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+    def test_flash_window_gradients_match_dot(self):
+        q, k, v = _qkv(s=64)
+        ref = _grads(
+            lambda q, k, v: dot_attention(q, k, v, causal=True, window=24),
+            q, k, v,
+        )
+        got = _grads(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, window=24, block_q=32, block_k=32
+            ),
+            q, k, v,
+        )
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r, atol=5e-3, rtol=5e-3)
+
+    def test_window_wider_than_seq_equals_full_causal(self):
+        q, k, v = _qkv(s=64)
+        np.testing.assert_allclose(
+            flash_attention(
+                q, k, v, causal=True, window=1000, block_q=32, block_k=32
+            ),
+            dot_attention(q, k, v, causal=True),
+            atol=2e-3, rtol=2e-3,
+        )
+
+    def test_window_requires_causal(self):
+        q, k, v = _qkv(s=32)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8)
+        with pytest.raises(ValueError, match="causal"):
+            dot_attention(q, k, v, causal=False, window=8)
+
+    def test_dispatcher_rejects_window_on_ring(self):
+        q, k, v = _qkv(s=32)
+        with pytest.raises(ValueError, match="window"):
+            attention(q, k, v, impl="ring", window=8)
+
+
 class TestDispatcher:
     def test_dispatch_dot(self):
         q, k, v = _qkv(s=16)

@@ -221,6 +221,24 @@ class TestTransformer:
             np.asarray(pre), np.asarray(full), atol=1e-5, rtol=1e-5
         )
 
+    def test_windowed_decode_matches_full_forward(self):
+        # sliding-window model: the decode-cache mask must apply the
+        # same horizon as the training-time mask
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(attention_window=5, max_seq_len=32)
+        tokens = jax.random.randint(jax.random.PRNGKey(6), (2, 14), 0, 64)
+        params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+        full = model.apply({"params": params}, tokens)
+        cache = tr.init_cache(model, 2)
+        pre, _ = model.apply(
+            {"params": params, "cache": cache}, tokens, decode=True,
+            mutable=["cache"],
+        )
+        np.testing.assert_allclose(
+            np.asarray(pre), np.asarray(full), atol=1e-5, rtol=1e-5
+        )
+
     def test_gqa_rejects_bad_head_counts(self):
         import pytest as _pytest
 
