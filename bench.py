@@ -300,6 +300,7 @@ def transformer_bench():
     c.setdefault("E", 0)
     c.setdefault("topk", 2)
     c.setdefault("KV", 0)  # grouped-query kv heads (0 = MHA)
+    c.setdefault("CF", 1.25)  # MoE capacity factor
     c.update(json.loads(os.environ.get("TFOS_LM_CONFIG", "{}")))
     L, H, Dh, Dm, Dff, V, S, B = (
         c["L"], c["H"], c["Dh"], c["Dm"], c["Dff"], c["V"], c["S"], c["B"]
@@ -314,7 +315,7 @@ def transformer_bench():
         remat_policy=c["remat_policy"], fused_qkv=c["fused_qkv"],
         block_q=c["block_q"], block_k=c["block_k"],
         num_experts=c["E"], expert_k=c["topk"],
-        num_kv_heads=c["KV"],
+        num_kv_heads=c["KV"], capacity_factor=c["CF"],
     )
     model = tr.Transformer(cfg)
     tokens0 = jnp.zeros((1, S), jnp.int32)

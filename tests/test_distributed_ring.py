@@ -33,10 +33,11 @@ from tensorflowonspark_tpu.ops.ulysses import ulysses_attention_sharded
 B = 2
 S = int(os.environ.get("TFOS_RING_S", "32"))
 H, D = 4, 8
+HKV = int(os.environ.get("TFOS_RING_HKV", str(H)))
 rng = np.random.RandomState(0)
 q = rng.randn(B, S, H, D).astype(np.float32)
-k = rng.randn(B, S, H, D).astype(np.float32)
-v = rng.randn(B, S, H, D).astype(np.float32)
+k = rng.randn(B, S, HKV, D).astype(np.float32)
+v = rng.randn(B, S, HKV, D).astype(np.float32)
 
 mesh = Mesh(np.array(jax.devices()).reshape(4), ("seq",))
 spec = NamedSharding(mesh, P(None, "seq"))
@@ -46,10 +47,10 @@ def place(x):
     return jax.make_array_from_process_local_data(spec, x[:, local_slice])
 
 from jax.experimental import multihost_utils
-for name, fn in (
-    ("ring", ring_attention_sharded),      # ppermute hops over Gloo
-    ("ulysses", ulysses_attention_sharded),  # all-to-all over Gloo
-):
+impls = [("ring", ring_attention_sharded)]      # ppermute hops over Gloo
+if HKV % 4 == 0:  # ulysses needs kv heads divisible by the seq axis
+    impls.append(("ulysses", ulysses_attention_sharded))  # all-to-all
+for name, fn in impls:
     out = fn(place(q), place(k), place(v), mesh, causal=True, axis_name="seq")
     full = multihost_utils.process_allgather(out, tiled=True)
     np.save(os.environ["TFOS_OUT"] + ".%s.%d.npy" % (name, rank), np.asarray(full))
@@ -57,11 +58,15 @@ for name, fn in (
 """
 
 
-def _run_and_check(tmp_path, seq_len):
+def _run_and_check(tmp_path, seq_len, hkv=4):
     out_base = str(tmp_path / "ring_out")
     outputs = launch_two_workers(
         _WORKER, tmp_path,
-        extra_env={"TFOS_OUT": out_base, "TFOS_RING_S": str(seq_len)},
+        extra_env={
+            "TFOS_OUT": out_base,
+            "TFOS_RING_S": str(seq_len),
+            "TFOS_RING_HKV": str(hkv),
+        },
     )
 
     # reference: dense attention, single process
@@ -70,11 +75,12 @@ def _run_and_check(tmp_path, seq_len):
     B, S, H, D = 2, seq_len, 4, 8
     rng = np.random.RandomState(0)
     q = rng.randn(B, S, H, D).astype(np.float32)
-    k = rng.randn(B, S, H, D).astype(np.float32)
-    v = rng.randn(B, S, H, D).astype(np.float32)
+    k = rng.randn(B, S, hkv, D).astype(np.float32)
+    v = rng.randn(B, S, hkv, D).astype(np.float32)
     ref = np.asarray(dot_attention(q, k, v, causal=True))
 
-    for name in ("ring", "ulysses"):
+    names = ("ring", "ulysses") if hkv % 4 == 0 else ("ring",)
+    for name in names:
         for r in (0, 1):
             got = np.load("{0}.{1}.{2}.npy".format(out_base, name, r))
             # allgather tiles along the sharded (seq) axis
@@ -96,3 +102,9 @@ def test_ring_attention_across_processes_multiblock(tmp_path):
     # crosses the PROCESS boundary over Gloo — the composed long-context
     # path end to end, not the degenerate one-block case
     _run_and_check(tmp_path, 512)
+
+
+def test_ring_attention_across_processes_gqa(tmp_path):
+    # grouped kv: the rotating shards carry 2 kv heads against 4 query
+    # heads (half the cross-process ppermute volume)
+    _run_and_check(tmp_path, 64, hkv=2)

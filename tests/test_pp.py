@@ -163,26 +163,26 @@ class TestPipelineTrainer:
                 err_msg=str(path),
             )
 
-    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
-    def test_pp_x_tp_loss_and_grads_match_reference(self, schedule):
-        # 3-axis composition: pipeline stages whose inner matmuls are
-        # tensor-parallel on the ``model`` axis (Megatron column/row
-        # pair with tp_copy/tp_reduce), under data parallelism —
-        # mesh {data:2, pipe:2, model:2}.  Numerics must equal the
-        # sequential single-device reference exactly.
+    def _run_pp_tp_case(self, schedule, interleave=1, num_layers=4,
+                        seed=11):
+        """Shared 3-axis harness: pipeline stages whose inner matmuls
+        are tensor-parallel on ``model`` (Megatron column/row pair with
+        tp_copy/tp_reduce), under data parallelism — mesh
+        {data:2, pipe:2, model:2}.  Loss and every gradient must equal
+        the sequential single-device reference (SGD lr=1 turns the
+        param delta into the negated gradient)."""
         from tensorflowonspark_tpu.parallel.tp import tp_copy, tp_reduce
 
-        dim, hid, num_layers, stages = 8, 16, 4, 2
-        rng = np.random.RandomState(11)
-
-        def mk_layer():
-            return {
+        dim, hid, stages = 8, 16, 2
+        rng = np.random.RandomState(seed)
+        layers = [
+            {
                 "w1": jnp.asarray(rng.randn(dim, hid).astype(np.float32) * 0.3),
                 "w2": jnp.asarray(rng.randn(hid, dim).astype(np.float32) * 0.3),
                 "b": jnp.asarray(rng.randn(dim).astype(np.float32) * 0.1),
             }
-
-        layers = [mk_layer() for _ in range(num_layers)]
+            for _ in range(num_layers)
+        ]
 
         def tp_layer_fn(lp, h):
             z = jnp.tanh(tp_copy(h, "model") @ lp["w1"])
@@ -193,7 +193,9 @@ class TestPipelineTrainer:
 
         mesh = build_mesh({"data": 2, "pipe": 2, "model": 2})
         params = {
-            "stages": pp.stack_stage_params(layers, stages),
+            "stages": pp.stack_stage_params(
+                layers, stages, interleave=interleave
+            ),
             "first": {
                 "w_in": jnp.asarray(rng.randn(dim, dim).astype(np.float32) * 0.3)
             },
@@ -201,9 +203,12 @@ class TestPipelineTrainer:
                 "w_out": jnp.asarray(rng.randn(dim, 1).astype(np.float32) * 0.3)
             },
         }
+        # interleaved stage stacks are [P, v, L/(P*v), ...]: the TP
+        # specs grow a chunk dim but still lead with pipe
+        chunk = (None,) if interleave > 1 else ()
         stage_specs = {
-            "w1": P("pipe", None, None, "model"),  # column-parallel
-            "w2": P("pipe", None, "model", None),  # row-parallel
+            "w1": P("pipe", *chunk, None, None, "model"),  # column-par.
+            "w2": P("pipe", *chunk, None, "model", None),  # row-par.
             "b": P("pipe"),
         }
 
@@ -213,27 +218,43 @@ class TestPipelineTrainer:
         def last_fn(p, h, batch):
             pred = (h @ p["w_out"])[:, 0]
             loss = jnp.mean((pred - batch["y"]) ** 2)
-            return loss, {"mse": loss}
+            return loss, {}
+
+        def iter_layers(st):
+            if interleave > 1:
+                # absolute chunk a lives at [a % P, a // P]
+                p_, v_, l_ = jax.tree.leaves(st)[0].shape[:3]
+                return (
+                    jax.tree.map(lambda x: x[a % p_, a // p_, j], st)
+                    for a in range(p_ * v_)
+                    for j in range(l_)
+                )
+            p_, l_ = jax.tree.leaves(st)[0].shape[:2]
+            return (
+                jax.tree.map(lambda x: x[i, j], st)
+                for i in range(p_)
+                for j in range(l_)
+            )
 
         def ref_loss(params, batch):
             h = batch["x"] @ params["first"]["w_in"]
-            p_, l_ = jax.tree.leaves(params["stages"])[0].shape[:2]
-            for i in range(p_):
-                for j in range(l_):
-                    h = ref_layer_fn(
-                        jax.tree.map(lambda x: x[i, j], params["stages"]), h
-                    )
+            for lp in iter_layers(params["stages"]):
+                h = ref_layer_fn(lp, h)
             pred = (h @ params["last"]["w_out"])[:, 0]
             return jnp.mean((pred - batch["y"]) ** 2)
 
         batch = {
-            "x": np.random.RandomState(12).randn(16, dim).astype(np.float32),
-            "y": np.random.RandomState(13).randn(16).astype(np.float32),
+            "x": np.random.RandomState(seed + 1).randn(16, dim).astype(
+                np.float32
+            ),
+            "y": np.random.RandomState(seed + 2).randn(16).astype(
+                np.float32
+            ),
         }
         trainer = pp.PipelineTrainer(
             tp_layer_fn, first_fn, last_fn, optax.sgd(1.0), mesh,
             num_microbatches=4, schedule=schedule,
-            stage_specs=stage_specs,
+            interleave=interleave, stage_specs=stage_specs,
         )
         state = trainer.create_state(jax.tree.map(jnp.asarray, params))
         old_params = jax.tree.map(np.asarray, state.params)
@@ -259,6 +280,15 @@ class TestPipelineTrainer:
                 np.asarray(g), np.asarray(r), atol=1e-4, rtol=1e-4,
                 err_msg=str(path),
             )
+
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_pp_x_tp_loss_and_grads_match_reference(self, schedule):
+        self._run_pp_tp_case(schedule)
+
+    def test_pp_x_tp_interleaved_matches_reference(self):
+        self._run_pp_tp_case(
+            "interleaved", interleave=2, num_layers=8, seed=21
+        )
 
     def test_requires_pipe_axis(self):
         mesh = build_mesh({"data": 8})
