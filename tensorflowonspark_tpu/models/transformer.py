@@ -62,8 +62,11 @@ class TransformerConfig:
     block_q: int = 1024
     block_k: int = 1024
     #: sliding-window (local) attention: each position sees the last
-    #: ``attention_window`` tokens (0 = full causal).  dot/flash impls
-    #: only; flash skips blocks behind the horizon (O(S·W) compute).
+    #: ``attention_window`` tokens (0 = full causal).  Works with every
+    #: attention impl: flash skips blocks behind the horizon (O(S·W)
+    #: compute and DMA via banded grids); ring skips whole HOPS beyond
+    #: the horizon (each ring distance gets a statically-specialized
+    #: offset kernel); ulysses windows the full-sequence local kernel.
     attention_window: int = 0
     # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
     # MoE FFN (models/moe.py) in every block

@@ -115,11 +115,6 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
     """
     if impl not in _IMPLS:
         raise ValueError("unknown attention impl {0!r}; one of {1}".format(impl, _IMPLS))
-    if window and impl not in ("dot", "flash"):
-        raise ValueError(
-            "sliding-window attention is supported by the dot and flash "
-            "impls; got impl={0!r}".format(impl)
-        )
     if impl == "flash":
         from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 
@@ -137,11 +132,12 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
             return ring_attention_sharded(
                 q, k, v, mesh, causal=causal, scale=scale,
                 axis_name=seq_axis, impl=ring_impl,
-                block_q=block_q, block_k=block_k,
+                block_q=block_q, block_k=block_k, window=window,
             )
         return ring_attention(
             q, k, v, causal=causal, scale=scale, axis_name=seq_axis,
             impl=ring_impl, block_q=block_q, block_k=block_k,
+            window=window,
         )
     if impl == "ulysses":
         from tensorflowonspark_tpu.ops.ulysses import (
@@ -153,9 +149,10 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
             return ulysses_attention_sharded(
                 q, k, v, mesh, causal=causal, scale=scale,
                 axis_name=seq_axis, block_q=block_q, block_k=block_k,
+                window=window,
             )
         return ulysses_attention(
             q, k, v, causal=causal, scale=scale, axis_name=seq_axis,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         )
     return dot_attention(q, k, v, causal=causal, scale=scale, window=window)

@@ -67,11 +67,15 @@ def _compiler_params():
     )
 
 
-def _causal_mask(qi, kj, block_q, block_k, window=0):
+def _causal_mask(qi, kj, block_q, block_k, window=0, q_offset=0):
     """Causal mask for block (qi, kj); ``window > 0`` additionally
     drops keys more than ``window - 1`` positions behind the query
-    (sliding-window / local attention)."""
-    qpos = qi * block_q + jax.lax.broadcasted_iota(
+    (sliding-window / local attention).  ``q_offset`` shifts the query
+    positions — ring attention uses it for visiting kv chunks from
+    ``q_offset`` positions earlier in the global sequence (the offset
+    is static per ring distance, so each distance gets its own
+    specialized kernel)."""
+    qpos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
     kpos = kj * block_k + jax.lax.broadcasted_iota(
@@ -83,17 +87,18 @@ def _causal_mask(qi, kj, block_q, block_k, window=0):
     return mask
 
 
-def _block_relevant(qi, kj, block_q, block_k, causal, window):
+def _block_relevant(qi, kj, block_q, block_k, causal, window, q_offset=0):
     """Whether block (qi, kj) contributes anything: causal skips blocks
     strictly above the diagonal; a window additionally skips blocks
     entirely behind the horizon — the compute saving that makes local
     attention O(S*W) instead of O(S^2/2)."""
     relevant = True
     if causal:
-        relevant = kj * block_k < (qi + 1) * block_q
+        relevant = kj * block_k < (qi + 1) * block_q + q_offset
     if window:
         relevant = jnp.logical_and(
-            relevant, (kj + 1) * block_k > qi * block_q - window + 1
+            relevant,
+            (kj + 1) * block_k > qi * block_q + q_offset - window + 1,
         )
     return relevant
 
@@ -122,7 +127,7 @@ def _band_steps(window, block_a, block_b, total_b):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, block_q, block_k, grid_steps,
-                window=0, banded=False):
+                window=0, banded=False, q_offset=0):
     qi = pl.program_id(2)
     jj = pl.program_id(3)
     if banded:
@@ -141,7 +146,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     relevant = jnp.logical_and(
-        in_range, _block_relevant(qi, kj, block_q, block_k, causal, window)
+        in_range,
+        _block_relevant(
+            qi, kj, block_q, block_k, causal, window, q_offset
+        ),
     )
 
     @pl.when(relevant)
@@ -161,7 +169,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         ) * scale  # [block_q, block_k] f32
         if causal:
             s = jnp.where(
-                _causal_mask(qi, kj, block_q, block_k, window), s, NEG_INF
+                _causal_mask(qi, kj, block_q, block_k, window, q_offset),
+                s, NEG_INF,
             )
         m_prev = m_scr[:, 0]
         l_prev = l_scr[:, 0]
@@ -184,7 +193,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_scr, *, scale, causal, block_q, block_k, grid_steps,
-               window=0, banded=False):
+               window=0, banded=False, q_offset=0):
     qi = pl.program_id(2)
     jj = pl.program_id(3)
     if banded:
@@ -199,7 +208,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     relevant = jnp.logical_and(
-        in_range, _block_relevant(qi, kj, block_q, block_k, causal, window)
+        in_range,
+        _block_relevant(
+            qi, kj, block_q, block_k, causal, window, q_offset
+        ),
     )
 
     @pl.when(relevant)
@@ -218,7 +230,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         ) * scale
         if causal:
             s = jnp.where(
-                _causal_mask(qi, kj, block_q, block_k, window), s, NEG_INF
+                _causal_mask(qi, kj, block_q, block_k, window, q_offset),
+                s, NEG_INF,
             )
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
@@ -239,7 +252,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *,
                 scale, causal, block_q, block_k, num_q_blocks,
-                grid_steps, window=0, banded=False):
+                grid_steps, window=0, banded=False, q_offset=0):
     kj = pl.program_id(2)
     jj = pl.program_id(3)
     if banded:
@@ -255,7 +268,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
     relevant = jnp.logical_and(
-        in_range, _block_relevant(qi, kj, block_q, block_k, causal, window)
+        in_range,
+        _block_relevant(
+            qi, kj, block_q, block_k, causal, window, q_offset
+        ),
     )
 
     @pl.when(relevant)
@@ -273,7 +289,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ) * scale
         if causal:
             s = jnp.where(
-                _causal_mask(qi, kj, block_q, block_k, window), s, NEG_INF
+                _causal_mask(qi, kj, block_q, block_k, window, q_offset),
+                s, NEG_INF,
             )
         p = jnp.exp(s - lse[:, None])  # [block_q, block_k] f32
         dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
@@ -348,7 +365,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, window=0):
 
 
 def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None,
-              window=0):
+              window=0, q_offset=0):
     """Forward on ``[B,H,S,D]`` (transposed) tensors; returns
     ``(out_t [B,H,S,D], lse [B,H,S,1])``.  Split out so callers that
     loop over kv chunks (ring attention) can keep everything in the
@@ -366,8 +383,10 @@ def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None,
     # windowed: stream only the band of kv blocks the horizon can
     # touch, descending from the diagonal — blocks outside the window
     # are never DMA'd (banding off when the band wouldn't shrink)
+    # banding assumes the zero-offset diagonal walk; offset chunks
+    # (ring hops) use the full grid with pl.when skipping
     steps = _band_steps(window, bq, bk, s // bk) if (
-        causal and window
+        causal and window and q_offset == 0
     ) else s // bk
     banded = steps < s // bk
     grid = (b, h, s // bq, steps)
@@ -381,7 +400,7 @@ def _fwd_core(qt, kt, vt, scale, causal, block_q, block_k, out_dtype=None,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=bq, block_k=bk, grid_steps=steps, window=window,
-        banded=banded,
+        banded=banded, q_offset=q_offset,
     )
     out, lse = pl.pallas_call(
         kernel,
@@ -431,7 +450,7 @@ def _bwd(scale, causal, block_q, block_k, window, residuals, dout):
 
 
 def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse,
-              delta, window=0):
+              delta, window=0, q_offset=0):
     """Backward on ``[B,H,S,D]`` (transposed) tensors with the
     loop-invariant ``delta`` precomputed by the caller; returns
     ``(dqt, dkt, dvt)`` in the same layout (``dkt``/``dvt`` carry the
@@ -448,13 +467,10 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse,
     bq, bk = _block_sizes(s, block_q, block_k)
     # banded grids mirror the forward (see _fwd_core): dq streams kv
     # blocks down from the diagonal, dk/dv stream q blocks up from it
-    kv_steps = _band_steps(window, bq, bk, s // bk) if (
-        causal and window
-    ) else s // bk
+    band_ok = causal and window and q_offset == 0
+    kv_steps = _band_steps(window, bq, bk, s // bk) if band_ok else s // bk
     kv_banded = kv_steps < s // bk
-    q_steps = _band_steps(window, bk, bq, s // bq) if (
-        causal and window
-    ) else s // bq
+    q_steps = _band_steps(window, bk, bq, s // bq) if band_ok else s // bq
     q_banded = q_steps < s // bq
 
     def _kv_idx(bi, hi, qi, jj, g=g):
@@ -466,7 +482,7 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse,
     dq_kernel = functools.partial(
         _dq_kernel, scale=scale, causal=causal,
         block_q=bq, block_k=bk, grid_steps=kv_steps, window=window,
-        banded=kv_banded,
+        banded=kv_banded, q_offset=q_offset,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -498,6 +514,7 @@ def _bwd_core(scale, causal, block_q, block_k, qt, kt, vt, dot_, lse,
         _dkv_kernel, scale=scale, causal=causal,
         block_q=bq, block_k=bk, num_q_blocks=s // bq,
         grid_steps=q_steps, window=window, banded=q_banded,
+        q_offset=q_offset,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,

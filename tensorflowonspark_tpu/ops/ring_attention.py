@@ -53,7 +53,7 @@ NEG_INF = -1e30
 
 
 def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
-                   impl="flash", block_q=1024, block_k=1024):
+                   impl="flash", block_q=1024, block_k=1024, window=0):
     """Attention over sequence shards; call under ``shard_map``.
 
     Args:
@@ -62,6 +62,12 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
       impl: ``"flash"`` (pallas blockwise inner step, O(block) memory
         per hop) or ``"dense"`` (einsum inner step, O(S_local²) logits
         per hop; numerics reference).
+      window: sliding-window horizon (requires ``causal``).  A chunk
+        at ring distance ``m`` sits at the STATIC global offset
+        ``m * S_local``, so each distance gets its own specialized
+        kernel branch — and hops entirely behind the horizon are
+        skipped (no MXU work; at ``window <= S_local`` only the
+        resident and previous chunks ever compute).
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
     if q.shape[2] % k.shape[2] != 0:
@@ -69,6 +75,13 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
             "query heads ({0}) must be a multiple of kv heads "
             "({1})".format(q.shape[2], k.shape[2])
         )
+    if window:
+        if window < 0:
+            raise ValueError(
+                "window must be positive, got {0}".format(window)
+            )
+        if not causal:
+            raise ValueError("window attention requires causal=True")
     if impl == "flash":
         # fall back to the dense inner step when the kernels can't run
         # (traced scale / untileable shard length) so the pre-flash
@@ -77,12 +90,12 @@ def ring_attention(q, k, v, causal=True, scale=None, axis_name="seq",
         if flash_supported(s_val, q.shape[1], block_q, block_k):
             return _ring_flash(
                 q, k, v, float(s_val), bool(causal), int(block_q),
-                int(block_k), axis_name,
+                int(block_k), axis_name, int(window),
             )
         impl = "dense"
     if impl == "dense":
         return _ring_dense(q, k, v, causal=causal, scale=scale,
-                           axis_name=axis_name)
+                           axis_name=axis_name, window=window)
     raise ValueError(
         "unknown ring attention impl {0!r}; options: flash, dense".format(
             impl
@@ -109,10 +122,11 @@ def _merge_partial(o, lse, o_c, lse_c):
     return o * (w / tot) + o_c * (w_c / tot), lse_new
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_flash(q, k, v, scale, causal, block_q, block_k, axis_name):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, scale, causal, block_q, block_k, axis_name,
+                window):
     out, _ = _ring_flash_fwd(
-        q, k, v, scale, causal, block_q, block_k, axis_name
+        q, k, v, scale, causal, block_q, block_k, axis_name, window
     )
     return out
 
@@ -125,7 +139,23 @@ def _causal_branch(my_idx, t, p):
     return jnp.where(src > my_idx, 0, jnp.where(src == my_idx, 1, 2))
 
 
-def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name):
+def _window_reach(window, s_local, p):
+    """Largest ring distance with any visibility under the horizon:
+    chunk at distance m spans offsets [m*S_l - S_l + 1, m*S_l + S_l - 1]
+    behind the query; entirely out once m*S_l >= window + S_l - 1."""
+    return min(p - 1, (window + s_local - 2) // s_local)
+
+
+def _window_branch(my_idx, t, p, max_dist):
+    """0 = skip (future chunk, or entirely behind the horizon);
+    1 + m = chunk at ring distance m (m = t for past chunks)."""
+    src = (my_idx - t) % p
+    skip = jnp.logical_or(src > my_idx, t > max_dist)
+    return jnp.where(skip, 0, 1 + t)
+
+
+def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name,
+                    window=0):
     p = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]
@@ -135,13 +165,15 @@ def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name):
     o0 = jnp.zeros((b, h, s_local, d), jnp.float32)
     lse0 = jnp.full((b, h, s_local, 1), NEG_INF, jnp.float32)
 
-    def _chunk(o, lse, kt_cur, vt_cur, chunk_causal):
+    eff_window = window if causal else 0
+
+    def _chunk(o, lse, kt_cur, vt_cur, chunk_causal, q_offset=0):
         # f32 partials straight from the kernel accumulator: the output
         # rounds to q.dtype exactly once (after the scan), matching the
         # single-chip kernel's precision
         o_c, lse_c = _fwd_core(
             qt, kt_cur, vt_cur, scale, chunk_causal, block_q, block_k,
-            out_dtype=jnp.float32,
+            out_dtype=jnp.float32, window=eff_window, q_offset=q_offset,
         )
         return _merge_partial(o, lse, o_c, lse_c)
 
@@ -157,9 +189,31 @@ def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name):
         o, lse, kt_cur, vt_cur = args
         return _chunk(o, lse, kt_cur, vt_cur, False)
 
+    def _offset_branch(m):
+        # chunk at ring distance m: queries sit m*S_local ahead of the
+        # visiting keys — a STATIC offset, so the kernel specializes
+        def _br(args):
+            o, lse, kt_cur, vt_cur = args
+            return _chunk(
+                o, lse, kt_cur, vt_cur, True, q_offset=m * s_local
+            )
+        return _br
+
+    if causal and window:
+        reach = _window_reach(window, s_local, p)
+        branches = (_skip,) + tuple(
+            _offset_branch(m) for m in range(reach + 1)
+        )
+
     def step(carry, t):
         o, lse, kt_cur, vt_cur = carry
-        if causal:
+        if causal and window:
+            o, lse = lax.switch(
+                _window_branch(my_idx, t, p, reach),
+                branches,
+                (o, lse, kt_cur, vt_cur),
+            )
+        elif causal:
             o, lse = lax.switch(
                 _causal_branch(my_idx, t, p),
                 (_skip, _diag, _full),
@@ -178,7 +232,8 @@ def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name):
     return out, (q, k, v, out, lse)
 
 
-def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, res, dout):
+def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, window,
+                    res, dout):
     """Second ring pass: dk/dv accumulators rotate with their kv chunks
     (home again after P hops); per-chunk gradients come from the flash
     backward kernels driven by the ring-global (out, lse)."""
@@ -201,10 +256,14 @@ def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, res, dout):
     dk0 = jnp.zeros(kv_shape, f32)  # kv head count (GQA-aware)
     dv0 = jnp.zeros(kv_shape, f32)
 
-    def _chunk_grads(kt_cur, vt_cur, chunk_causal):
+    s_local = q.shape[1]
+    eff_window = window if causal else 0
+
+    def _chunk_grads(kt_cur, vt_cur, chunk_causal, q_offset=0):
         dq_c, dk_c, dv_c = _bwd_core(
             scale, chunk_causal, block_q, block_k,
-            qt, kt_cur, vt_cur, dot_, lse, delta,
+            qt, kt_cur, vt_cur, dot_, lse, delta, window=eff_window,
+            q_offset=q_offset,
         )
         return dq_c.astype(f32), dk_c.astype(f32), dv_c.astype(f32)
 
@@ -222,9 +281,29 @@ def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, res, dout):
     def _full(args):
         return _chunk_grads(*args, False)
 
+    def _offset_branch(m):
+        def _br(args):
+            kt_cur, vt_cur = args
+            return _chunk_grads(
+                kt_cur, vt_cur, True, q_offset=m * s_local
+            )
+        return _br
+
+    if causal and window:
+        reach = _window_reach(window, s_local, p)
+        branches = (_skip,) + tuple(
+            _offset_branch(m) for m in range(reach + 1)
+        )
+
     def step(carry, t):
         dq, kt_cur, vt_cur, dk_cur, dv_cur = carry
-        if causal:
+        if causal and window:
+            dq_c, dk_c, dv_c = lax.switch(
+                _window_branch(my_idx, t, p, reach),
+                branches,
+                (kt_cur, vt_cur),
+            )
+        elif causal:
             dq_c, dk_c, dv_c = lax.switch(
                 _causal_branch(my_idx, t, p),
                 (_skip, _diag, _full),
@@ -260,7 +339,8 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 # dense inner step (numerics reference)
 # --------------------------------------------------------------------------
 
-def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq"):
+def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq",
+                window=0):
     """Original online-softmax einsum inner step — materializes the
     ``[B, S_local, H, S_local]`` logits per visiting chunk.  Kept as the
     numerics reference for the flash inner step.
@@ -301,6 +381,10 @@ def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq"):
         ) * scale  # [B, Sq, H, Sk]
         if causal:
             mask = qpos[:, None] >= kpos[None, :]  # [Sq, Sk]
+            if window:
+                mask = jnp.logical_and(
+                    mask, kpos[None, :] > qpos[:, None] - window
+                )
             s_logits = jnp.where(
                 mask[None, :, None, :], s_logits, NEG_INF
             )
@@ -326,7 +410,7 @@ def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq"):
 
 def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None,
                            axis_name="seq", impl="flash",
-                           block_q=1024, block_k=1024):
+                           block_q=1024, block_k=1024, window=0):
     """Global-array entry point: wraps :func:`ring_attention` in a
     ``shard_map`` over ``mesh``'s ``axis_name`` (sequence dim sharded,
     batch optionally on the data axes).  Usable directly inside jit."""
@@ -340,7 +424,7 @@ def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None,
     def _local(ql, kl, vl):
         return ring_attention(
             ql, kl, vl, causal=causal, scale=scale, axis_name=axis_name,
-            impl=impl, block_q=block_q, block_k=block_k,
+            impl=impl, block_q=block_q, block_k=block_k, window=window,
         )
 
     return jax.shard_map(

@@ -27,7 +27,8 @@ from tensorflowonspark_tpu.ops.flash_attention import flash_supported
 
 
 def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
-                      local_impl="flash", block_q=1024, block_k=1024):
+                      local_impl="flash", block_q=1024, block_k=1024,
+                      window=0):
     """Attention over sequence shards; call under ``shard_map``.
 
     Args:
@@ -66,21 +67,25 @@ def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
         )
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # after the all-to-all the local sequence is GLOBAL, so the
+    # window mask applies directly
     if local_impl == "flash":
         from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(
             qh, kh, vh, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         )
     else:
-        out = dot_attention(qh, kh, vh, causal=causal, scale=scale)
+        out = dot_attention(
+            qh, kh, vh, causal=causal, scale=scale, window=window
+        )
     return heads_to_seq(out)
 
 
 def ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=None,
                               axis_name="seq", local_impl="flash",
-                              block_q=1024, block_k=1024):
+                              block_q=1024, block_k=1024, window=0):
     """Global-array entry point: shard_map wrapper usable inside jit
     (sequence dim sharded on ``axis_name``, batch on the data axes)."""
     from jax.sharding import PartitionSpec as P
@@ -94,6 +99,7 @@ def ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=None,
         return ulysses_attention(
             ql, kl, vl, causal=causal, scale=scale, axis_name=axis_name,
             local_impl=local_impl, block_q=block_q, block_k=block_k,
+            window=window,
         )
 
     return jax.shard_map(

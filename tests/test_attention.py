@@ -366,10 +366,50 @@ class TestSlidingWindow:
         with pytest.raises(ValueError, match="causal"):
             dot_attention(q, k, v, causal=False, window=8)
 
-    def test_dispatcher_rejects_window_on_ring(self):
-        q, k, v = _qkv(s=32)
-        with pytest.raises(ValueError, match="window"):
-            attention(q, k, v, impl="ring", window=8)
+    @pytest.mark.parametrize("window", [8, 24, 40])
+    @pytest.mark.parametrize("impl", ["flash", "dense"])
+    def test_ring_window_matches_dot(self, impl, window):
+        # S=64 over seq=4 -> S_local=16: a query's horizon can always
+        # cross into the previous chunk, so W=8 reaches 1 chunk back
+        # (_window_reach=1), W=24 reaches 2, and W=40 reaches all 3
+        # past chunks (no hop skip fires) — the static-offset kernel
+        # branches and the hop skip must agree with the single-device
+        # window mask at every reach
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=64, h=2, d=16)
+        out = ring_attention_sharded(
+            q, k, v, mesh, causal=True, impl=impl, window=window
+        )
+        ref = dot_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("window", [8, 24])
+    def test_ring_window_gradients_match_dot(self, window):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=64, h=2, d=16)
+        ref = _grads(
+            lambda q, k, v: dot_attention(
+                q, k, v, causal=True, window=window
+            ),
+            q, k, v,
+        )
+        got = _grads(
+            lambda q, k, v: ring_attention_sharded(
+                q, k, v, mesh, causal=True, impl="flash", window=window
+            ),
+            q, k, v,
+        )
+        for g, r in zip(got, ref):
+            np.testing.assert_allclose(g, r, atol=1e-4, rtol=1e-4)
+
+    def test_ulysses_window_matches_dot(self):
+        mesh = build_mesh({"data": 2, "seq": 4})
+        q, k, v = _qkv(b=2, s=64, h=4, d=16)
+        out = ulysses_attention_sharded(
+            q, k, v, mesh, causal=True, window=24
+        )
+        ref = dot_attention(q, k, v, causal=True, window=24)
+        np.testing.assert_allclose(out, ref, atol=1e-4, rtol=1e-4)
 
 
 class TestDispatcher:
