@@ -301,6 +301,7 @@ def transformer_bench():
     c.setdefault("topk", 2)
     c.setdefault("KV", 0)  # grouped-query kv heads (0 = MHA)
     c.setdefault("CF", 1.25)  # MoE capacity factor
+    c.setdefault("DISPATCH", "gather")  # gather | einsum | dropless
     c.update(json.loads(os.environ.get("TFOS_LM_CONFIG", "{}")))
     L, H, Dh, Dm, Dff, V, S, B = (
         c["L"], c["H"], c["Dh"], c["Dm"], c["Dff"], c["V"], c["S"], c["B"]
@@ -316,6 +317,7 @@ def transformer_bench():
         block_q=c["block_q"], block_k=c["block_k"],
         num_experts=c["E"], expert_k=c["topk"],
         num_kv_heads=c["KV"], capacity_factor=c["CF"],
+        expert_dispatch=c["DISPATCH"],
     )
     model = tr.Transformer(cfg)
     tokens0 = jnp.zeros((1, S), jnp.int32)
@@ -551,27 +553,42 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256,
     n_params = sum(
         int(np.prod(x.shape)) for x in jax.tree.leaves(params)
     )
-    def timed(n):
+    def timed(n, p):
         gen = jax.jit(
             lambda p, t: tr.generate(model, p, t, max_new_tokens=n)
         )
-        out = gen(params, prompt)
+        out = gen(p, prompt)
         int(out[0, 0])  # compile + definitive sync
         t0 = time.perf_counter()
-        out = gen(params, prompt)
+        out = gen(p, prompt)
         int(out[0, 0])
         return time.perf_counter() - t0
 
     # pure decode cost from the slope: (N steps) - (1 step) isolates
     # the scan from the prompt prefill both runs share
-    dt1 = timed(1)
-    dtn = timed(new_tokens)
+    dt1 = timed(1, params)
+    dtn = timed(new_tokens, params)
     step_ms = (dtn - dt1) / (new_tokens - 1) * 1e3
+
+    # weight-only int8 (quantize.py): same generate path, QTensor
+    # params — the decode step dequantizes under a barrier so weights
+    # cross HBM as int8 (decode is bound by the params+cache read)
+    from tensorflowonspark_tpu import quantize as qz
+
+    qparams = qz.quantize_tree(params)
+    dt1_q = timed(1, qparams)
+    dtn_q = timed(new_tokens, qparams)
+    step_ms_q = (dtn_q - dt1_q) / (new_tokens - 1) * 1e3
     return {
         "tokens_per_sec_e2e": round(batch * new_tokens / dtn, 1),
         "decode_ms_per_step": round(step_ms, 2),
         "decode_tokens_per_sec": round(batch / (step_ms / 1e3), 1),
         "prefill_plus_first_token_ms": round(dt1 * 1e3, 1),
+        "decode_ms_per_step_int8": round(step_ms_q, 2),
+        "decode_tokens_per_sec_int8": round(
+            batch / (step_ms_q / 1e3), 1
+        ),
+        "int8_speedup": round(step_ms / step_ms_q, 3),
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,

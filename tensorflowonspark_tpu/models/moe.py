@@ -33,12 +33,24 @@ class MoEMLP(nn.Module):
     capacity_factor: float = 1.25
     dtype: str = "bfloat16"
     #: "gather" (index-based dispatch/combine — O(tokens·D) movement,
-    #: no permutation matmuls) or "einsum" (dense [G,E,C] one-hot
-    #: contractions; the numerics reference and GSPMD fallback)
+    #: no permutation matmuls), "einsum" (dense [G,E,C] one-hot
+    #: contractions; the numerics reference and GSPMD fallback), or
+    #: "dropless" (NO capacity: tokens sorted by expert into a
+    #: tile-aligned layout and multiplied by the pallas grouped-matmul
+    #: kernel — zero drops, padding only rounds each expert's run up to
+    #: one ``gmm_block_rows`` tile instead of the CF× slack)
     dispatch: str = "gather"
+    #: gmm row-tile size for dispatch="dropless" (per-expert padding
+    #: quantum; must be a multiple of the MXU's 8-row sublane)
+    gmm_block_rows: int = 256
 
     @nn.compact
     def __call__(self, x):
+        if self.dispatch not in ("gather", "einsum", "dropless"):
+            raise ValueError(
+                "dispatch must be 'gather', 'einsum', or 'dropless', "
+                "got %r" % (self.dispatch,)
+            )
         e, m, d = self.num_experts, self.mlp_dim, self.embed_dim
         jdtype = jnp.dtype(self.dtype)
         b, s, _ = x.shape
@@ -60,6 +72,38 @@ class MoEMLP(nn.Module):
         wg = self.param("wg", init, (e, d, m))
         wo = self.param("wo", init, (e, m, d))
 
+        if self.dispatch == "dropless":
+            # no capacity at all: sort tokens by expert into a
+            # tile-aligned layout and run the pallas grouped matmul —
+            # zero drops; per-expert padding is one row tile, not CF×.
+            # (Single-mesh path: the gmm kernel is opaque to GSPMD, so
+            # the expert-axis EP sharding keeps using "gather".)
+            from tensorflowonspark_tpu.ops import gmm
+
+            # wi/wg stay separate params (a fused [E, D, 2M] would
+            # halve token-tile reads but costs a per-step weight
+            # concat — weights change every step — and breaks param
+            # compatibility with the other dispatch modes)
+            bm = self.gmm_block_rows
+            experts, gates, aux = moe_ops.dropless_topk(
+                logits, k=self.k
+            )
+            self.sow("losses", "moe_aux", aux)
+            layout = moe_ops.dropless_layout(experts, e, bm=bm)
+            xs = moe_ops.dispatch_sorted(xf.astype(jdtype), layout)
+            h = gmm.grouped_matmul(
+                xs, wi.astype(jdtype), layout.tile_expert, bm
+            )
+            hg = gmm.grouped_matmul(
+                xs, wg.astype(jdtype), layout.tile_expert, bm
+            )
+            ys = gmm.grouped_matmul(
+                nn.silu(hg) * h, wo.astype(jdtype), layout.tile_expert,
+                bm,
+            )
+            y = moe_ops.combine_sorted(ys, layout, gates)
+            return y.reshape(b, s, d).astype(x.dtype)
+
         if self.dispatch == "gather":
             experts, slots, gates, aux = moe_ops.top_k_routing(
                 logits, e, cap, k=self.k
@@ -68,7 +112,7 @@ class MoEMLP(nn.Module):
             xe = moe_ops.dispatch_gather(
                 xf.astype(jdtype), experts, slots, gates, e, cap
             )  # [E, C, D], one row-gather
-        else:
+        elif self.dispatch == "einsum":
             dispatch, combine, aux = moe_ops.top_k_gating(
                 logits, e, cap, k=self.k
             )

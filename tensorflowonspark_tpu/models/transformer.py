@@ -232,6 +232,19 @@ class Block(nn.Module):
         if cfg.num_experts > 0:
             from tensorflowonspark_tpu.models.moe import MoEMLP
 
+            axes = set(getattr(cfg.mesh, "axis_names", ()) or ())
+            if cfg.expert_dispatch == "dropless" and axes & {
+                "expert", "model"
+            }:
+                # the gmm pallas call is opaque to GSPMD: sharding the
+                # expert weights on ANY axis the MoE rules map (expert
+                # -> 'expert', expert_mlp -> 'model') would silently
+                # all-gather the full [E, D, M] tensors onto every
+                # device — exactly what EP/TP shard away
+                raise ValueError(
+                    "expert_dispatch='dropless' does not compose with "
+                    "an expert- or model-sharded mesh; use 'gather'"
+                )
             ff = MoEMLP(
                 num_experts=cfg.num_experts,
                 mlp_dim=cfg.mlp_dim,
@@ -446,6 +459,18 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         raise ValueError("temperature sampling needs an rng key")
     rng = rng if rng is not None else jax.random.PRNGKey(0)
 
+    from tensorflowonspark_tpu import quantize as qz
+
+    qparams = params
+    quantized = qz.is_quantized(params)
+    if quantized:
+        # prefill dequantizes once (it is compute-bound); each decode
+        # step re-dequantizes under an optimization barrier so the
+        # weights cross HBM as int8 every step (see quantize.py)
+        params = qz.dequantize_tree(
+            qparams, model.cfg.jdtype, barrier=False
+        )
+
     def sample(logits, key):
         return sample_logits(
             logits, key, temperature=temperature, top_k=top_k, top_p=top_p
@@ -463,8 +488,12 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
 
     def step(carry, key):
         cache, tok = carry
+        p = (
+            qz.dequantize_tree(qparams, model.cfg.jdtype, barrier=True)
+            if quantized else params
+        )
         logits, mut = model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
+            {"params": p, "cache": cache}, tok[:, None],
             decode=True, mutable=["cache"],
         )
         nxt = sample(logits[:, 0], key)
@@ -492,6 +521,17 @@ def serving_builder(params, config):
         **{k: v for k, v in overrides.items() if k in cfg_fields}
     )
     model = Transformer(cfg)
+    if config.get("quantize") == "int8":
+        # weight-only int8 (quantize.py): halves the weight HBM read —
+        # generate() dequantizes per decode step; the logits path
+        # dequantizes once up front (batch logits are compute-bound)
+        from tensorflowonspark_tpu import quantize as qz
+
+        params = qz.quantize_tree(params)
+        if config.get("mode") != "generate":
+            params = qz.dequantize_tree(
+                params, cfg.jdtype, barrier=False
+            )
     if config.get("mode") == "generate":
         # generation serving: prompt batch in -> sampled continuations
         # out (KV-cache decode; see generate()).  config keys:

@@ -7,7 +7,9 @@ new TPU-first capability:
 - :mod:`.attention` — dispatcher over attention implementations;
 - :mod:`.flash_attention` — blockwise pallas TPU kernel;
 - :mod:`.ring_attention` — sequence-parallel ring attention (ppermute);
-- :mod:`.ulysses` — all-to-all head/sequence re-sharding attention.
+- :mod:`.ulysses` — all-to-all head/sequence re-sharding attention;
+- :mod:`.moe` — top-k expert routing (capacity and dropless);
+- :mod:`.gmm` — grouped-matmul pallas kernels (dropless MoE engine).
 """
 
 from tensorflowonspark_tpu.ops.attention import attention, dot_attention  # noqa: F401

@@ -1,0 +1,266 @@
+"""Grouped (ragged) matmul pallas kernels — the dropless-MoE engine.
+
+New TPU-first capability with no reference analogue (the reference has
+no expert parallelism at all; SURVEY.md §2.3).  Capacity-factor routing
+(`ops/moe.top_k_routing`) pays for static shapes twice: ``CF``× padded
+tokens through every expert matmul AND dropped tokens when a group
+overflows.  The standard fix (Megablox / MaxText's grouped matmul) is a
+kernel that multiplies a *sorted, group-contiguous* token matrix
+``[N, D]`` against per-expert weights ``[E, D, F]`` where each row tile
+reads exactly its own expert's weights — zero drops, and the only
+padding is rounding each group up to one row tile.
+
+Layout contract (produced by ``ops.moe.dropless_layout``): tokens are
+sorted by expert; each expert's run starts at a multiple of the row
+tile ``bm`` so no tile straddles two experts; ``tile_expert[t]`` names
+the owning expert of row tile ``t``.  Pad rows are zero and their
+outputs are never gathered back.
+
+Kernel shapes (grid ``(F//bf, T)`` — row tiles innermost so that
+consecutive tiles of the same expert reuse the resident weight block;
+the full weight matrix is DMA'd exactly once per ``bf`` stripe):
+
+- forward  ``y[t] = x[t] @ w[tile_expert[t]]``
+- dx       the same kernel against ``w`` transposed ``[E, F, D]``
+- dw       ``dw[e] = sum_{t: te[t]=e} x[t].T @ dy[t]`` — an output
+  block revisited across the contiguous run of ``t`` for each expert,
+  zeroed at the first visit (f32 accumulation in VMEM).
+
+Off-TPU the kernels run under ``interpret=True`` (CPU tests), same
+posture as ``ops/flash_attention.py``.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params(ndim=2):
+    from jax.experimental.pallas import tpu as pltpu
+
+    # weight-dim stripes are independent; the row-tile dim must run in
+    # order so (a) weight blocks stay resident across a group's tiles
+    # and (b) the dw output block accumulates across its visits.
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",) * (ndim - 1) + ("arbitrary",)
+    )
+
+
+def _grid_spec(num_scalar_prefetch, grid, in_specs, out_specs,
+               scratch_shapes=()):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=num_scalar_prefetch,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=list(scratch_shapes),
+    )
+
+
+def _gmm_kernel(te_ref, x_ref, w_ref, y_ref):
+    del te_ref  # consumed by the index maps
+    y_ref[...] = jnp.dot(
+        x_ref[...], w_ref[0], preferred_element_type=jnp.float32
+    ).astype(y_ref.dtype)
+
+
+def _pick_bf(bm, d, f, bf=None):
+    """Pick a legal f-stripe width.
+
+    Mosaic requires the LAST block dim to be a multiple of 128 or the
+    full array dim, and wider stripes amortize per-step overhead — so:
+    the largest 128·2^k divisor of ``f`` whose double-buffered bf16
+    working set fits the 16MB scoped-VMEM budget, capped at ``bf``
+    when the caller pins one (else 2048), falling back to the full
+    width when ``f`` has no such divisor (odd widths like 576) or is
+    ≤128 (legality trumps the cap there).
+    """
+    cap = 2048 if bf is None else max(128, bf)
+    budget = 14 * 1024 * 1024
+
+    def working(c):
+        return 2 * 2 * (bm * d + d * c + bm * c)  # bf16 bytes
+
+    best = 0
+    c = 128
+    while c <= min(f // 2, cap):
+        if f % c == 0 and working(c) <= budget:
+            best = c
+        c *= 2
+    return best if best else f
+
+
+def gmm_call(x, w, tile_expert, *, bm=256, bf=None, interpret=None):
+    """Raw forward: ``y[N, F]`` for sorted ``x[N, D]``, ``w[E, D, F]``.
+
+    ``N`` must be ``T*bm`` with ``tile_expert`` of shape ``[T]`` int32;
+    differentiate through :func:`grouped_matmul` instead (this primal
+    has no registered gradient).
+    """
+    if interpret is None:
+        interpret = _interpret()
+    n, d = x.shape
+    e, dw_, f = w.shape
+    assert d == dw_, (x.shape, w.shape)
+    assert n % bm == 0, (n, bm)
+    t = n // bm
+    assert tile_expert.shape == (t,), (tile_expert.shape, t)
+    bf = _pick_bf(bm, d, f, bf)
+    assert f % bf == 0, (f, bf)
+    grid_spec = _grid_spec(
+        1,
+        (f // bf, t),
+        [
+            pl.BlockSpec((bm, d), lambda fi, ti, te: (ti, 0)),
+            pl.BlockSpec((1, d, bf), lambda fi, ti, te: (te[ti], 0, fi)),
+        ],
+        pl.BlockSpec((bm, bf), lambda fi, ti, te: (ti, fi)),
+    )
+    return pl.pallas_call(
+        _gmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, f), x.dtype),
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(tile_expert, x, w)
+
+
+def _tgmm_kernel(te_ref, x_ref, dy_ref, dw_ref, acc_ref):
+    ti = pl.program_id(2)
+    nt = pl.num_programs(2)
+    prev = jnp.maximum(ti - 1, 0)
+    first = jnp.logical_or(ti == 0, te_ref[ti] != te_ref[prev])
+
+    @pl.when(first)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(
+        x_ref[...].T, dy_ref[...], preferred_element_type=jnp.float32
+    )
+    nxt = jnp.minimum(ti + 1, nt - 1)
+    last = jnp.logical_or(ti == nt - 1, te_ref[nxt] != te_ref[ti])
+
+    @pl.when(last)
+    def _flush():
+        dw_ref[...] = acc_ref[...][None].astype(dw_ref.dtype)
+
+
+def tgmm_call(x, dy, tile_expert, num_experts, *, bm=256, bd=None,
+              bf=None, interpret=None):
+    """``dw[E, D, F] = segment-sum over row tiles of x[t].T @ dy[t]``.
+
+    The per-expert sum accumulates in an f32 VMEM scratch and flushes
+    to the output (in ``x.dtype``) once per expert block — writing an
+    f32 ``[E, D, F]`` then casting cost two extra full passes of HBM
+    traffic per weight.  Both weight dims are blocked (``bd`` × ``bf``):
+    a full-``D`` f32 accumulator at MoE widths exceeds the 16MB
+    scoped-VMEM budget.  An expert that owns no row tile this batch
+    never has its output block visited (uninitialized memory), so
+    absent experts are zeroed explicitly after the kernel.
+    """
+    if interpret is None:
+        interpret = _interpret()
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, d = x.shape
+    n2, f = dy.shape
+    assert n == n2 and n % bm == 0
+    t = n // bm
+    # both weight dims appear as a LAST block dim here (x's bd, dy's
+    # bf, and dw's bf) — legalize each with the same 128-rule picker,
+    # then shrink until the (bd, bf) f32 accumulator scratch ALSO fits
+    # (the picker budgets the double-buffered blocks only)
+    bd = _pick_bf(bm, min(bf or 512, f), d, bd)
+    bf = _pick_bf(bm, bd, f, bf)
+    while (
+        2 * 2 * (bm * bd + bm * bf + bd * bf) + 4 * bd * bf
+        > 14 * 1024 * 1024
+    ):
+        side = "bd" if bd >= bf else "bf"
+        cur = bd if side == "bd" else bf
+        # halving a 128·2^k divisor stays legal; full-width (odd) or
+        # minimum-width blocks can't shrink further
+        if cur < 256 or cur % 256 != 0:
+            break
+        if side == "bd":
+            bd //= 2
+        else:
+            bf //= 2
+    assert d % bd == 0, (d, bd)
+    assert f % bf == 0, (f, bf)
+    grid_spec = _grid_spec(
+        1,
+        (d // bd, f // bf, t),
+        [
+            pl.BlockSpec((bm, bd), lambda di, fi, ti, te: (ti, di)),
+            pl.BlockSpec((bm, bf), lambda di, fi, ti, te: (ti, fi)),
+        ],
+        pl.BlockSpec(
+            (1, bd, bf), lambda di, fi, ti, te: (te[ti], di, fi)
+        ),
+        scratch_shapes=[pltpu.VMEM((bd, bf), jnp.float32)],
+    )
+    dw = pl.pallas_call(
+        _tgmm_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_experts, d, f), x.dtype),
+        compiler_params=_compiler_params(ndim=3),
+        interpret=interpret,
+    )(tile_expert, x, dy)
+    # zero the rows of experts that own no tile this batch (their output
+    # block was never visited and holds uninitialized memory)
+    present = (
+        jnp.zeros((num_experts,), jnp.bool_).at[tile_expert].set(True)
+    )
+    return jnp.where(present[:, None, None], dw, 0.0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def grouped_matmul(x, w, tile_expert, bm=256, bf=None):
+    """Differentiable grouped matmul on a group-aligned sorted layout.
+
+    ``x [N, D]`` (N = T*bm, tokens sorted+padded by expert),
+    ``w [E, D, F]``, ``tile_expert [T]`` → ``y [N, F]``.
+    """
+    return gmm_call(x, w, tile_expert, bm=bm, bf=bf)
+
+
+def _grouped_matmul_fwd(x, w, tile_expert, bm, bf):
+    return gmm_call(x, w, tile_expert, bm=bm, bf=bf), (x, w, tile_expert)
+
+
+def _grouped_matmul_bwd(bm, bf, res, dy):
+    x, w, tile_expert = res
+    wt = jnp.swapaxes(w, 1, 2)  # [E, F, D]
+    dx = gmm_call(dy, wt, tile_expert, bm=bm, bf=bf)
+    dw = tgmm_call(
+        x, dy, tile_expert, w.shape[0], bm=bm, bf=bf
+    ).astype(w.dtype)
+    return dx, dw, None
+
+
+grouped_matmul.defvjp(_grouped_matmul_fwd, _grouped_matmul_bwd)
+
+
+def gmm_reference(x, w, tile_expert, bm=256):
+    """Pure-jnp numerics reference: per-tile dense dot against the
+    owning expert's weights (tests compare the kernels to this)."""
+    n, d = x.shape
+    t = n // bm
+    xt = x.reshape(t, bm, d)
+    wt = w[tile_expert]  # [T, D, F]
+    y = jnp.einsum(
+        "tbd,tdf->tbf",
+        xt.astype(jnp.float32),
+        wt.astype(jnp.float32),
+    )
+    return y.reshape(n, -1).astype(x.dtype)

