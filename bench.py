@@ -525,6 +525,31 @@ def serving_tpu_bench():
     return out
 
 
+def _decode_step_ms(model, params, prompt, new_tokens):
+    """Shared decode-timing harness: jit-compiled generate with
+    scalar-pull sync; pure per-step cost by the slope method — an
+    N-token and a 1-token run share the prefill, so the difference
+    isolates the scan.  Returns ``(dt1, dtn, step_ms)``."""
+    import jax
+
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    def timed(n):
+        gen = jax.jit(
+            lambda p, t: tr.generate(model, p, t, max_new_tokens=n)
+        )
+        out = gen(params, prompt)
+        int(out[0, 0])  # compile + definitive sync
+        t0 = time.perf_counter()
+        out = gen(params, prompt)
+        int(out[0, 0])
+        return time.perf_counter() - t0
+
+    dt1 = timed(1)
+    dtn = timed(new_tokens)
+    return dt1, dtn, (dtn - dt1) / (new_tokens - 1) * 1e3
+
+
 def decode_bench(batch=8, prompt_len=128, new_tokens=256,
                  num_kv_heads=0):
     """Autoregressive generation throughput on the flagship model: the
@@ -553,22 +578,7 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256,
     n_params = sum(
         int(np.prod(x.shape)) for x in jax.tree.leaves(params)
     )
-    def timed(n, p):
-        gen = jax.jit(
-            lambda p, t: tr.generate(model, p, t, max_new_tokens=n)
-        )
-        out = gen(p, prompt)
-        int(out[0, 0])  # compile + definitive sync
-        t0 = time.perf_counter()
-        out = gen(p, prompt)
-        int(out[0, 0])
-        return time.perf_counter() - t0
-
-    # pure decode cost from the slope: (N steps) - (1 step) isolates
-    # the scan from the prompt prefill both runs share
-    dt1 = timed(1, params)
-    dtn = timed(new_tokens, params)
-    step_ms = (dtn - dt1) / (new_tokens - 1) * 1e3
+    dt1, dtn, step_ms = _decode_step_ms(model, params, prompt, new_tokens)
 
     # weight-only int8 (quantize.py): same generate path, QTensor
     # params — the decode step dequantizes under a barrier so weights
@@ -576,9 +586,7 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256,
     from tensorflowonspark_tpu import quantize as qz
 
     qparams = qz.quantize_tree(params)
-    dt1_q = timed(1, qparams)
-    dtn_q = timed(new_tokens, qparams)
-    step_ms_q = (dtn_q - dt1_q) / (new_tokens - 1) * 1e3
+    _, _, step_ms_q = _decode_step_ms(model, qparams, prompt, new_tokens)
     return {
         "tokens_per_sec_e2e": round(batch * new_tokens / dtn, 1),
         "decode_ms_per_step": round(step_ms, 2),
@@ -595,6 +603,53 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256,
         "model": "L16 H8 Dh128 Dm1024 (%.0fM params, bf16)" % (
             n_params / 1e6
         ),
+    }
+
+
+def decode_long_bench(batch=8, prompt_len=128, new_tokens=1896):
+    """Long-generation decode: at ~2k live cache positions the KV-cache
+    read rivals the weight read, so this measures the bf16 baseline
+    against weight-only int8 and int8 weights + int8 KV cache
+    (cache_dtype="int8" — per-position/per-head scales, dequant fused
+    into the attention einsum).  Slope method as in decode_bench."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import quantize as qz
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    def mk(cache_dtype):
+        return tr.Transformer(tr.TransformerConfig(
+            vocab_size=32000, num_layers=16, num_heads=8, head_dim=128,
+            embed_dim=1024, mlp_dim=4096, max_seq_len=2048,
+            dtype="bfloat16", cache_dtype=cache_dtype,
+        ))
+
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, 32000, (batch, prompt_len)),
+        jnp.int32,
+    )
+    model = mk("bfloat16")
+    params = model.init(jax.random.PRNGKey(0), prompt[:1])["params"]
+    qparams = qz.quantize_tree(params)
+
+    bf16 = _decode_step_ms(model, params, prompt, new_tokens)[2]
+    w8 = _decode_step_ms(model, qparams, prompt, new_tokens)[2]
+    w8kv8 = _decode_step_ms(mk("int8"), qparams, prompt, new_tokens)[2]
+    return {
+        "metric": "decode_long_ms_per_step",
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "bf16_ms_per_step": round(bf16, 3),
+        "int8_weights_ms_per_step": round(w8, 3),
+        "int8_weights_kv_ms_per_step": round(w8kv8, 3),
+        "int8_speedup": round(bf16 / w8, 3),
+        "int8_kv_speedup": round(bf16 / w8kv8, 3),
+        "tokens_per_sec_int8_kv": round(batch / (w8kv8 / 1e3), 1),
+        "model": "L16 H8 Dh128 Dm1024 (334M params)",
     }
 
 
@@ -1325,6 +1380,8 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(serving_bench)))
     elif "long_context" in sys.argv:
         print(json.dumps(with_retry(long_context_bench)))
+    elif "decode_long" in sys.argv:
+        print(json.dumps(with_retry(decode_long_bench)))
     elif "decode" in sys.argv:
         print(json.dumps(with_retry(decode_bench)))
     elif "ps" in sys.argv:

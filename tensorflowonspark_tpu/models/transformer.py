@@ -68,6 +68,12 @@ class TransformerConfig:
     #: the horizon (each ring distance gets a statically-specialized
     #: offset kernel); ulysses windows the full-sequence local kernel.
     attention_window: int = 0
+    #: KV-cache storage dtype for decode: "bfloat16" (exact) or
+    #: "int8" (symmetric per-position/per-head scales over head_dim —
+    #: halves the cache HBM read that dominates long-generation decode;
+    #: the dequant fuses into the attention einsum's operand read, same
+    #: trick as quantize.py's weights)
+    cache_dtype: str = "bfloat16"
     # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
     # MoE FFN (models/moe.py) in every block
     num_experts: int = 0
@@ -155,21 +161,50 @@ class Attention(nn.Module):
             # of cfg.max_seq_len and the per-step cache read shrinks
             # proportionally.
             b = x.shape[0]
+            int8_cache = cfg.cache_dtype == "int8"
+            bank_dtype = jnp.int8 if int8_cache else cfg.jdtype
             ck = self.variable(
                 "cache", "cached_key", jnp.zeros,
-                (b, cfg.max_seq_len, hkv, d), cfg.jdtype,
+                (b, cfg.max_seq_len, hkv, d), bank_dtype,
             )
             cv = self.variable(
                 "cache", "cached_value", jnp.zeros,
-                (b, cfg.max_seq_len, hkv, d), cfg.jdtype,
+                (b, cfg.max_seq_len, hkv, d), bank_dtype,
             )
             i = positions[0, 0]
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(ck.value.dtype), (0, i, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cv.value.dtype), (0, i, 0, 0)
-            )
+            if int8_cache:
+                cks = self.variable(
+                    "cache", "cached_key_scale", jnp.zeros,
+                    (b, cfg.max_seq_len, hkv, 1), jnp.float32,
+                )
+                cvs = self.variable(
+                    "cache", "cached_value_scale", jnp.zeros,
+                    (b, cfg.max_seq_len, hkv, 1), jnp.float32,
+                )
+
+                from tensorflowonspark_tpu import quantize as qz
+
+                kq, ks = qz.quantize_leaf(k, reduce_axes=(3,))
+                vq, vs = qz.quantize_leaf(v, reduce_axes=(3,))
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, kq, (0, i, 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, vq, (0, i, 0, 0)
+                )
+                cks.value = jax.lax.dynamic_update_slice(
+                    cks.value, ks, (0, i, 0, 0)
+                )
+                cvs.value = jax.lax.dynamic_update_slice(
+                    cvs.value, vs, (0, i, 0, 0)
+                )
+            else:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(ck.value.dtype), (0, i, 0, 0)
+                )
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(cv.value.dtype), (0, i, 0, 0)
+                )
             kpos = jnp.arange(ck.value.shape[1])
             qpos = positions[0]
             from tensorflowonspark_tpu.ops.attention import dot_attention
@@ -182,7 +217,9 @@ class Attention(nn.Module):
                 )
             mask = jnp.where(visible, 0.0, -jnp.inf)[None, None]
             out = dot_attention(
-                q, ck.value, cv.value, causal=False, mask=mask
+                q, ck.value, cv.value, causal=False, mask=mask,
+                k_scale=cks.value if int8_cache else None,
+                v_scale=cvs.value if int8_cache else None,
             )
         else:
             out = attention(

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 _IMPLS = ("dot", "flash", "ring", "ulysses")
 
 
-def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
+def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0,
+                  k_scale=None, v_scale=None):
     """Plain softmax attention via XLA einsums.
 
     Args:
@@ -35,6 +36,14 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
       mask: optional additive mask broadcastable to ``[B, H, Sq, Sk]``.
       window: ``> 0`` restricts each query to the last ``window``
         positions (sliding-window attention; requires ``causal``).
+      k_scale, v_scale: optional per-position/per-head dequant scales
+        ``[B, Sk, Hkv, 1]`` for int8 ``k``/``v`` banks (the quantized
+        KV cache).  Instead of dequantizing the banks (which would
+        materialize a full-width copy), the factored identities are
+        used: ``q·(k*ks) == (q·k)*ks`` scales the LOGITS, and
+        ``Σ p·(v*vs) == Σ (p*vs)·v`` folds into the probabilities —
+        the int8 banks reach the einsums as pure converts, which XLA
+        fuses into the operand read.
     Returns ``[B, Sq, H, D]`` in ``q.dtype``.
     """
     if window:
@@ -45,6 +54,12 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
         if not causal:
             raise ValueError("window attention requires causal=True")
     orig_dtype = q.dtype
+    # int8 (quantized-cache) banks convert up WITHOUT their scales —
+    # a bare convert fuses into the dot; convert-multiply does not
+    if k.dtype != orig_dtype:
+        k = k.astype(orig_dtype)
+    if v.dtype != orig_dtype:
+        v = v.astype(orig_dtype)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     h, hkv = q.shape[2], k.shape[2]
     if h % hkv != 0:
@@ -53,18 +68,34 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
             "({1})".format(h, hkv)
         )
     g = h // hkv
+    # [B, Sk, Hkv, 1] -> [B, Hkv, 1, Sk] (broadcast over queries)
+    ks_t = (
+        jnp.transpose(k_scale, (0, 2, 3, 1))
+        if k_scale is not None else None
+    )
+    vs_t = (
+        jnp.transpose(v_scale, (0, 2, 3, 1))
+        if v_scale is not None else None
+    )
     # accumulate logits/softmax in f32 for stability (bf16 inputs stay
     # bf16 through the matmuls — MXU native — but the reduction is f32)
     if g == 1:
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
         )
+        if ks_t is not None:
+            logits = logits * ks_t
     else:
         qg = q.reshape(q.shape[0], q.shape[1], hkv, g, q.shape[3])
         logits = jnp.einsum(
             "bqhgd,bkhd->bhgqk", qg, k,
             preferred_element_type=jnp.float32,
-        ).reshape(q.shape[0], h, q.shape[1], k.shape[1])
+        )
+        if ks_t is not None:
+            logits = logits * ks_t[:, :, None]
+        logits = logits.reshape(
+            q.shape[0], h, q.shape[1], k.shape[1]
+        )
     logits = logits * scale
     if causal:
         sq, sk = q.shape[1], k.shape[1]
@@ -81,6 +112,8 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
         logits = logits + mask
     weights = jax.nn.softmax(logits, axis=-1)
     if g == 1:
+        if vs_t is not None:
+            weights = weights * vs_t
         out = jnp.einsum(
             "bhqk,bkhd->bqhd", weights.astype(v.dtype), v,
             preferred_element_type=jnp.float32,
@@ -89,6 +122,8 @@ def dot_attention(q, k, v, causal=True, scale=None, mask=None, window=0):
         wg = weights.reshape(
             q.shape[0], hkv, g, q.shape[1], k.shape[1]
         )
+        if vs_t is not None:
+            wg = wg * vs_t[:, :, None]
         out = jnp.einsum(
             "bhgqk,bkhd->bqhgd", wg.astype(v.dtype), v,
             preferred_element_type=jnp.float32,

@@ -83,6 +83,11 @@ def _pick_bf(bm, d, f, bf=None):
     width when ``f`` has no such divisor (odd widths like 576) or is
     ≤128 (legality trumps the cap there).
     """
+    if bf is not None and f % bf == 0:
+        # caller pinned a legal divisor — honor it exactly (tests pin
+        # sub-128 stripes to exercise the multi-stripe index maps in
+        # interpret mode; hardware callers own their legality)
+        return min(bf, f)
     cap = 2048 if bf is None else max(128, bf)
     budget = 14 * 1024 * 1024
 

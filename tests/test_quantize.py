@@ -154,6 +154,70 @@ class TestDecodeParity:
             np.asarray(ref)[:, 0], np.asarray(got)[:, 0]
         )
 
+    def test_int8_cache_decode_tracks_full_forward(self):
+        cfg = tr.TransformerConfig(
+            vocab_size=64, num_layers=2, num_heads=2, head_dim=16,
+            embed_dim=32, mlp_dim=64, max_seq_len=64, dtype="float32",
+            cache_dtype="int8",
+        )
+        model = tr.Transformer(cfg)
+        tokens = jnp.asarray(
+            np.random.RandomState(7).randint(0, 64, (2, 12)), jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(0), tokens[:, :1])[
+            "params"
+        ]
+        full = model.apply({"params": params}, tokens)
+        cache = tr.init_cache(model, 2, cache_len=12)
+        dec, _ = model.apply(
+            {"params": params, "cache": cache}, tokens, decode=True,
+            mutable=["cache"],
+        )
+        a = np.asarray(full).reshape(-1)
+        b = np.asarray(dec).reshape(-1)
+        cos = float(
+            np.dot(a, b)
+            / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-12)
+        )
+        assert cos > 0.995, cos
+
+    def test_int8_cache_banks_are_int8(self):
+        cfg = tr.TransformerConfig(
+            vocab_size=64, num_layers=1, num_heads=2, head_dim=16,
+            embed_dim=32, mlp_dim=64, max_seq_len=32, dtype="float32",
+            cache_dtype="int8",
+        )
+        model = tr.Transformer(cfg)
+        cache = tr.init_cache(model, 2, cache_len=16)
+        layer = cache["block_0"]["attn"]
+        assert layer["cached_key"].dtype == jnp.int8
+        assert layer["cached_key"].shape == (2, 16, 2, 16)
+        assert layer["cached_key_scale"].dtype == jnp.float32
+        assert layer["cached_key_scale"].shape == (2, 16, 2, 1)
+
+    def test_int8_cache_generate_matches_bf16_cache_greedy(self):
+        # decisive params: int8 cache noise must not flip the argmax
+        mk = lambda cd: tr.Transformer(tr.TransformerConfig(  # noqa: E731
+            vocab_size=64, num_layers=2, num_heads=2, head_dim=16,
+            embed_dim=32, mlp_dim=64, max_seq_len=64, dtype="float32",
+            cache_dtype=cd,
+        ))
+        model = mk("bfloat16")
+        params = jax.tree.map(
+            lambda x: x * 3.0,
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+            )["params"],
+        )
+        prompt = jnp.asarray(
+            np.random.RandomState(8).randint(0, 64, (2, 8)), jnp.int32
+        )
+        ref = tr.generate(model, params, prompt, max_new_tokens=4)
+        got = tr.generate(mk("int8"), params, prompt, max_new_tokens=4)
+        np.testing.assert_array_equal(
+            np.asarray(ref)[:, 0], np.asarray(got)[:, 0]
+        )
+
     def test_serving_builder_quantize_generate(self):
         model, params = _tiny_model()
         predict = tr.serving_builder(
