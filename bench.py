@@ -1334,6 +1334,8 @@ def main(model_name="resnet50", with_feed=True):
         for name, fn in (
             ("long_context", long_context_bench),
             ("serving_tpu", serving_tpu_bench),
+            ("decode", decode_bench),
+            ("decode_long", decode_long_bench),
         ):
             try:
                 out[name] = with_retry(fn)

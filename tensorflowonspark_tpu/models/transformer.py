@@ -543,6 +543,144 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     ) if max_new_tokens > 1 else first[:, None]
 
 
+def generate_speculative(model, params, prompt, max_new_tokens,
+                         draft_len=4, ngram=2, return_stats=False):
+    """Greedy generation with prompt-lookup speculative decoding.
+
+    Decode is HBM-bound: one token per forward re-reads all weights.
+    Speculation verifies ``draft_len`` guessed tokens in ONE forward
+    (same weight read, ``draft_len+1`` query rows — nearly free on the
+    MXU), so every accepted draft is a weight read saved.  Drafts come
+    from PROMPT LOOKUP (n-gram continuation): find the most recent
+    earlier occurrence of the last ``ngram`` emitted/prompt tokens and
+    copy what followed it — no draft model, and highly effective on
+    inputs with repeated structure (code, extraction, summarization).
+
+    Greedy-only and LOSSLESS: the verify forward recomputes the exact
+    argmax chain, accepted tokens match :func:`generate`'s output
+    token for token (tested).  Rejected verify rows leave stale cache
+    entries BEYOND the accepted position; they are masked (decode
+    attends ``kpos <= qpos``) and overwritten by the next round's
+    writes before the write pointer reaches them.  Batch rows accept
+    in lockstep (the cache write pointer is shared): the per-round
+    acceptance is the minimum over rows, so speculation pays off most
+    at small batch — exactly the bandwidth-bound serving regime.
+
+    Returns ``[B, max_new_tokens]`` int32 (with ``return_stats=True``,
+    a ``(tokens, rounds)`` pair — ``max_new_tokens/rounds`` is the
+    mean tokens per verify forward; 1.0 means nothing accepted, ``1 +
+    draft_len`` is the ceiling).
+    """
+    b, p = prompt.shape
+    k = int(draft_len)
+    total = p + max_new_tokens
+    if k < 1:
+        raise ValueError("draft_len must be >= 1")
+    if total > model.cfg.max_seq_len:
+        raise ValueError(
+            "prompt ({0}) + max_new_tokens ({1}) exceeds "
+            "max_seq_len={2}".format(
+                p, max_new_tokens, model.cfg.max_seq_len
+            )
+        )
+    from tensorflowonspark_tpu import quantize as qz
+
+    qparams = params
+    quantized = qz.is_quantized(params)
+    if quantized:
+        # same contract as generate(): prefill dequantizes once, each
+        # verify round re-dequantizes under a barrier (weights cross
+        # HBM as int8 — see quantize.py)
+        params = qz.dequantize_tree(
+            qparams, model.cfg.jdtype, barrier=False
+        )
+    # cache must hold the last verify block that crosses max_new
+    cache = init_cache(model, b, cache_len=total + k + 1)
+    logits, mut = model.apply(
+        {"params": params, "cache": cache}, prompt, decode=True,
+        mutable=["cache"],
+    )
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    hist_len = total + k + 1
+    history = jnp.zeros((b, hist_len), jnp.int32).at[:, :p].set(prompt)
+    history = history.at[:, p].set(first)
+    emitted = jnp.zeros((b, max_new_tokens + k + 1), jnp.int32)
+    emitted = emitted.at[:, 0].set(first)
+
+    def find_drafts(hist, hist_n, last):
+        """[hist_len] history with hist_n valid tokens -> [k] drafts
+        (continuation of the latest earlier n-gram match; repeat of
+        ``last`` when none)."""
+        idx = jnp.arange(hist_len)
+        suffix = jax.lax.dynamic_slice(hist, (hist_n - ngram,), (ngram,))
+        windows = hist[
+            jnp.minimum(idx[:, None] + jnp.arange(ngram)[None, :],
+                        hist_len - 1)
+        ]
+        match = jnp.all(windows == suffix[None, :], axis=-1)
+        valid = idx < hist_n - ngram  # strictly before the suffix itself
+        j = jnp.max(jnp.where(match & valid, idx, -1))
+        start = jnp.clip(j + ngram, 0, hist_len - k)
+        cont = jax.lax.dynamic_slice(hist, (start,), (k,))
+        # positions past the valid history would draft garbage zeros;
+        # the repeat-last fallback at least keeps runs alive
+        in_range = start + jnp.arange(k) < hist_n
+        fallback = jnp.full((k,), last, jnp.int32)
+        return jnp.where((j >= 0) & in_range, cont, fallback)
+
+    def round_(state):
+        history, emitted, cache, n, last, rounds = state
+        drafts = jax.vmap(find_drafts)(
+            history, jnp.full((b,), p + n), last
+        )  # [B, k]
+        block = jnp.concatenate([last[:, None], drafts], axis=1)
+        pr = (
+            qz.dequantize_tree(qparams, model.cfg.jdtype, barrier=True)
+            if quantized else params
+        )
+        logits, mut = model.apply(
+            {"params": pr, "cache": cache}, block, decode=True,
+            mutable=["cache"],
+        )
+        targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B,k+1]
+        # row r accepts drafts while they match the model's chain
+        ok = drafts == targets[:, :k]
+        m = jnp.min(
+            jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+        )  # lockstep acceptance
+        out_block = targets  # cols 0..m are valid for every row
+        emitted = jax.lax.dynamic_update_slice(
+            emitted, out_block, (0, n)
+        )
+        history = jax.lax.dynamic_update_slice(
+            history, out_block, (0, p + n)
+        )
+        gained = m + 1
+        cache = dict(mut["cache"])
+        # rewind the write pointer to the newest ACCEPTED token's slot:
+        # tokens e_0..e_{n'-1} are emitted, e_{n'-1}'s kv is not yet
+        # written, so the pointer sits at its position p + n' - 1
+        cache["position"] = jnp.asarray(
+            p + n + gained - 1, jnp.int32
+        )
+        last = jnp.take_along_axis(targets, m[None].repeat(b)[:, None],
+                                   axis=1)[:, 0]
+        return history, emitted, cache, n + gained, last, rounds + 1
+
+    def cond(state):
+        return state[3] < max_new_tokens
+
+    # after prefill the pointer is already at p — `first`'s slot
+    cache = dict(mut["cache"])
+    state = (history, emitted, cache, jnp.int32(1), first, jnp.int32(0))
+    history, emitted, cache, n, last, rounds = jax.lax.while_loop(
+        cond, round_, state
+    )
+    tokens = emitted[:, :max_new_tokens]
+    return (tokens, rounds) if return_stats else tokens
+
+
 def serving_builder(params, config):
     """``model_ref`` target for serving exports: next-token logits for
     a ``tokens`` batch (see :mod:`tensorflowonspark_tpu.serving`).
@@ -572,15 +710,30 @@ def serving_builder(params, config):
     if config.get("mode") == "generate":
         # generation serving: prompt batch in -> sampled continuations
         # out (KV-cache decode; see generate()).  config keys:
-        # max_new_tokens (required), temperature, top_k, top_p, seed.
+        # max_new_tokens (required), temperature, top_k, top_p, seed;
+        # speculative=true switches to prompt-lookup speculative
+        # decoding (greedy-only; draft_len/ngram tune it).
         max_new = int(config["max_new_tokens"])
         temperature = float(config.get("temperature", 0.0))
         top_k = int(config.get("top_k", 0))
         top_p = float(config.get("top_p", 0.0))
         rng = jax.random.PRNGKey(int(config.get("seed", 0)))
+        speculative = bool(config.get("speculative", False))
+        if speculative and temperature > 0:
+            raise ValueError(
+                "speculative generation serving is greedy-only "
+                "(temperature must be 0)"
+            )
+        draft_len = int(config.get("draft_len", 4))
+        ngram = int(config.get("ngram", 2))
         variables = base.as_variables(params)
 
         def _gen(v, tokens):
+            if speculative:
+                return generate_speculative(
+                    model, v["params"], jnp.asarray(tokens, jnp.int32),
+                    max_new, draft_len=draft_len, ngram=ngram,
+                )
             return generate(
                 model, v["params"], jnp.asarray(tokens, jnp.int32),
                 max_new, temperature=temperature, rng=rng,

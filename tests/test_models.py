@@ -150,6 +150,62 @@ class TestTransformer:
             np.asarray(got), np.stack([np.asarray(r) for r in ref], axis=1)
         )
 
+    def test_speculative_generate_is_lossless(self):
+        # prompt-lookup speculation must reproduce vanilla greedy
+        # decode token for token — acceptance only reorders the work
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(max_seq_len=64)
+        for b, seed in ((1, 4), (2, 5)):
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(seed), (b, 10), 0, 64
+            )
+            params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+            ref = tr.generate(model, params, prompt, max_new_tokens=16)
+            got, rounds = tr.generate_speculative(
+                model, params, prompt, 16, draft_len=4, ngram=2,
+                return_stats=True,
+            )
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+            assert 1 <= int(rounds) <= 16
+
+    def test_speculative_accepts_on_repetitive_input(self):
+        # a perfectly periodic prompt: the n-gram draft should keep
+        # matching, so verify rounds << tokens generated
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(max_seq_len=96)
+        prompt = jnp.asarray(
+            np.tile(np.arange(6), 6)[None, :], jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        ref = tr.generate(model, params, prompt, max_new_tokens=24)
+        got, rounds = tr.generate_speculative(
+            model, params, prompt, 24, draft_len=4, ngram=2,
+            return_stats=True,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+        assert int(rounds) < 24  # strictly fewer forwards than tokens
+
+    def test_speculative_composes_with_quantized_weights(self):
+        from tensorflowonspark_tpu import quantize as qz
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(max_seq_len=64)
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (1, 8), 0, 64)
+        params = jax.tree.map(
+            lambda x: x * 3.0,
+            model.init(jax.random.PRNGKey(0), prompt)["params"],
+        )
+        ref = tr.generate_speculative(model, params, prompt, 8)
+        got = tr.generate_speculative(
+            model, qz.quantize_tree(params, min_size=512), prompt, 8
+        )
+        # decisive params: int8 noise must not flip the first tokens
+        np.testing.assert_array_equal(
+            np.asarray(ref)[:, 0], np.asarray(got)[:, 0]
+        )
+
     def test_generate_capacity_and_sampling_guards(self):
         import pytest as _pytest
 
