@@ -34,6 +34,43 @@ import subprocess
 import sys
 import time
 
+#: wall-clock budget for the default record (``python bench.py``).  The
+#: round-4 record was killed by the driver's timeout before the single
+#: final print (BENCH_r04: rc=124, parsed null) — so (a) the record is
+#: now emitted incrementally after EVERY completed section (the driver
+#: parses the last JSON line, so a kill can only truncate, never null),
+#: and (b) auxiliary rows are skipped-with-a-note once the budget runs
+#: out rather than overrunning.  Required rows (spark_feed, resnet50,
+#: transformer, decode) run first.
+BENCH_T0 = time.monotonic()
+BENCH_BUDGET_SEC = float(os.environ.get("TFOS_BENCH_BUDGET_SEC", "780"))
+
+
+def _remaining():
+    return BENCH_BUDGET_SEC - (time.monotonic() - BENCH_T0)
+
+
+def _enable_compile_cache():
+    """Persistent XLA compilation cache: the record's wall is dominated
+    by tunnel-side compiles (~40-100s per program), and every bench
+    program is shape-stable across runs — so warm runs skip straight
+    to execution.  Best effort: unsupported backends just miss."""
+    try:
+        import jax
+
+        d = os.environ.get(
+            "JAX_COMPILATION_CACHE_DIR",
+            os.path.expanduser("~/.cache/jax_tfos"),
+        )
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 1.0
+        )
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 - cache is an optimization
+        print("compilation cache unavailable: %s" % e, file=sys.stderr)
+
 #: published anchor: NVIDIA DGX A100 single-GPU ResNet50 ImageNet
 #: training, mixed precision (~2.5k img/s); ResNet50 training cost
 #: ~12.3 GFLOP/image (3x the 4.1 GFLOP forward)
@@ -117,11 +154,19 @@ def compute_bench(model_name="resnet56"):
     platform = jax.devices()[0].platform
     on_accel = platform in ("tpu", "gpu")
 
+    t_sec = time.monotonic()
+
+    def mark(what):
+        print(
+            "compute_bench %s: +%.1fs" % (what, time.monotonic() - t_sec),
+            file=sys.stderr,
+        )
+
     if model_name == "resnet50":
         img, nclass = 224, 1000
         batch = 128 if on_accel else 8
         timed = 100 if on_accel else 2
-        K = 50 if on_accel else 2
+        K = 25 if on_accel else 2
         model = resnet.ResNet50(
             num_classes=nclass, dtype="bfloat16" if on_accel else "float32"
         )
@@ -141,7 +186,13 @@ def compute_bench(model_name="resnet56"):
     timed = int(os.environ.get("TFOS_BENCH_STEPS", timed))
 
     rng = jax.random.PRNGKey(0)
-    variables = model.init(rng, jnp.zeros((1, img, img, 3)))
+    # ONE jitted (and persistently cached) init program: eager init
+    # runs hundreds of tiny ops, each paying the tunnel RTT (measured
+    # 155s of the old record's wall)
+    variables = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, img, img, 3)))
+    )(rng)
+    mark("init")
 
     mesh = build_mesh()
     base_loss = resnet.loss_fn(model)
@@ -170,33 +221,48 @@ def compute_bench(model_name="resnet56"):
     # reference's per-step Keras feed was the known bottleneck,
     # SURVEY.md §7 'Hard parts').
     rounds = max(1, timed // K)
-    rng_np = np.random.RandomState(0)
-    stacked = [
-        (
-            rng_np.randint(
-                0, 256, size=(K, batch, img, img, 3), dtype=np.uint8
-            ),
-            np.tile((np.arange(batch) % nclass).astype(np.int32), (K, 1)),
-        )
-        for _ in range(2)
-    ]
     rngs = jax.random.split(jax.random.PRNGKey(0), K)
 
     # Device-resident synthetic batches (the reference's own synthetic
     # benchmark pattern, examples/resnet/common.py:315-363): the timed
     # region measures CHIP training throughput; host->HBM feeding is
     # measured separately (spark_feed) and by the e2e examples.
+    # Generated ON DEVICE in one jitted program with the trainer's
+    # batch sharding — the old host randint + transfer shipped ~0.5GB
+    # of synthetic uint8 over the tunnel (measured ~45s of wall).
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
     from tensorflowonspark_tpu.parallel import sharding as sh
 
+    base = sh.batch_sharding(mesh, trainer.data_axes)
+    data_sharding = NamedSharding(
+        mesh, Pspec(*((None,) + tuple(base.spec)))
+    )
+
+    def _gen_stack(key):
+        x = jax.random.randint(
+            key, (K, batch, img, img, 3), 0, 256, dtype=jnp.uint8
+        )
+        y = jnp.tile(
+            (jnp.arange(batch) % nclass).astype(jnp.int32)[None], (K, 1)
+        )
+        return x, y
+
     device_stacked = [
-        sh.shard_batch(s, mesh, trainer.data_axes, leading_dims=1)
-        for s in stacked
+        jax.jit(
+            _gen_stack,
+            out_shardings=(
+                data_sharding,
+                NamedSharding(mesh, Pspec(*((None,) + tuple(base.spec)[:1]))),
+            ),
+        )(jax.random.PRNGKey(1))
     ]
+    mark("on-device batch generated")
     for i in range(2):  # compile + settle
         state, metrics = trainer.multi_step_on_device(
-            state, device_stacked[i % 2], rngs
+            state, device_stacked[i % len(device_stacked)], rngs
         )
     float(metrics["loss"][-1])  # definitive device sync (see note below)
+    mark("compile+settle")
 
     # FLOPs of the exact compiled K-step program (fwd+bwd+update)
     group_flops = _step_flops(
@@ -211,11 +277,12 @@ def compute_bench(model_name="resnet56"):
         metrics = None
         for i in range(rounds):
             box["state"], metrics = trainer.multi_step_on_device(
-                box["state"], device_stacked[i % 2], rngs
+                box["state"], device_stacked[i % len(device_stacked)], rngs
             )
         return metrics
 
     dt = _timed_windows(run_group, on_accel)
+    mark("timed windows")
     state = box["state"]
     timed = rounds * K
 
@@ -321,7 +388,9 @@ def transformer_bench():
     )
     model = tr.Transformer(cfg)
     tokens0 = jnp.zeros((1, S), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), tokens0)["params"]
+    params = jax.jit(
+        lambda r: model.init(r, tokens0)["params"]
+    )(jax.random.PRNGKey(0))
     n_params_total = sum(
         int(np.prod(x.shape)) for x in jax.tree.leaves(params)
     )
@@ -415,6 +484,21 @@ def transformer_bench():
     baseline_tps = 0.5 * A100_PEAK_FLOPS / flops_per_token
     out["baseline_tokens_per_sec"] = round(baseline_tps, 1)
     out["vs_baseline"] = round(tokens_per_sec / baseline_tps, 4)
+    if c["E"] > 0:
+        # router drop-rate telemetry (VERDICT r4 #4): fraction of
+        # (token, choice) assignments dropped by capacity overflow on
+        # the trained state's router, measured on a real batch
+        tok1 = jax.device_get(device_stacked["tokens"])[0]
+        _, stats = jax.jit(
+            lambda p, t: model.apply(
+                {"params": p}, t, mutable=["moe_stats"]
+            )
+        )(box["state"].params, jnp.asarray(tok1))
+        rates = jax.tree.leaves(stats.get("moe_stats", {}))
+        if rates:
+            out["drop_rate"] = round(
+                float(sum(jnp.mean(r) for r in rates) / len(rates)), 4
+            )
     print(
         "transformer: %d steps of B%dxS%d in %.2fs" % (steps, B, S, best_dt),
         file=sys.stderr,
@@ -451,9 +535,9 @@ def serving_bench(rows_n=32768, batch_size=128, model="mnist"):
         from tensorflowonspark_tpu.models import resnet
 
         net = resnet.ResNet50(num_classes=1000)
-        variables = net.init(
-            jax.random.PRNGKey(0), jnp.zeros((1, 224, 224, 3))
-        )
+        variables = jax.jit(
+            lambda r: net.init(r, jnp.zeros((1, 224, 224, 3)))
+        )(jax.random.PRNGKey(0))
         export_tree = jax.tree.map(np.asarray, dict(variables))
         meta = {
             "model_ref": "tensorflowonspark_tpu.models.resnet:serving_builder",
@@ -520,9 +604,79 @@ def serving_tpu_bench():
         lambda: serving_bench(rows_n=16384, batch_size=128)
     )
     out["resnet50"] = with_retry(
-        lambda: serving_bench(rows_n=2048, batch_size=64, model="resnet50")
+        lambda: serving_bench(rows_n=1024, batch_size=64, model="resnet50")
     )
     return out
+
+
+def serving_generate_bench(rows_n=64, batch=8, max_new=64):
+    """Ragged batched generation serving (VERDICT r4 #8): dict-rows
+    with VARYING prompt lengths through predict_rows -> per-row
+    continuations, on the flagship 334M model composing GQA (Hkv=2),
+    sliding-window attention (W=512), int8 weights AND int8 KV cache
+    in one recorded config.  predict_rows left-pads each batch to a
+    64-bucket (one compiled program per bucket) and generate() masks
+    pad slots per row; equivalence vs per-row unpadded generation is
+    tested in tests/test_models.py."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    cfg = dict(
+        vocab_size=32000, num_layers=16, num_heads=8, head_dim=128,
+        embed_dim=1024, mlp_dim=4096, max_seq_len=2048,
+        dtype="bfloat16", num_kv_heads=2, attention_window=512,
+        cache_dtype="int8",
+    )
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    predict = tr.serving_builder(
+        params,
+        dict(
+            cfg, mode="generate", max_new_tokens=max_new,
+            quantize="int8", pad_multiple=128,
+        ),
+    )
+    rng = np.random.RandomState(0)
+    lens = rng.randint(100, 257, size=rows_n)
+    rows = [
+        {"prompt": rng.randint(0, 32000, (n,)).astype(np.int32)}
+        for n in lens
+    ]
+    mapping = {"prompt": "tokens"}
+    # warm both length buckets (128 and 256) outside the timed region
+    list(serving.predict_rows(
+        predict,
+        [{"prompt": rows[0]["prompt"][:100]} for _ in range(batch)]
+        + [{"prompt": rows[0]["prompt"]} for _ in range(batch)],
+        mapping, batch_size=batch,
+    ))
+    t0 = time.perf_counter()
+    n_out = 0
+    for r in serving.predict_rows(
+        predict, rows, mapping, batch_size=batch
+    ):
+        assert r["generated"].shape == (max_new,)
+        n_out += 1
+    dt = time.perf_counter() - t0
+    assert n_out == rows_n
+    return {
+        "rows_per_sec": round(rows_n / dt, 2),
+        "generated_tokens_per_sec": round(rows_n * max_new / dt, 1),
+        "rows": rows_n,
+        "batch_size": batch,
+        "max_new_tokens": max_new,
+        "prompt_lens": "ragged uniform[100,256], 128-bucketed",
+        "config": "334M GQA(Hkv=2) window=512 int8 weights + int8 KV cache",
+        "wall_sec": round(dt, 3),
+        "platform": __import__("jax").devices()[0].platform,
+    }
 
 
 def _decode_step_ms(model, params, prompt, new_tokens):
@@ -574,7 +728,9 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256,
         np.random.RandomState(0).randint(0, 32000, (batch, prompt_len)),
         jnp.int32,
     )
-    params = model.init(jax.random.PRNGKey(0), prompt[:1])["params"]
+    params = jax.jit(
+        lambda r: model.init(r, prompt[:1])["params"]
+    )(jax.random.PRNGKey(0))
     n_params = sum(
         int(np.prod(x.shape)) for x in jax.tree.leaves(params)
     )
@@ -585,7 +741,7 @@ def decode_bench(batch=8, prompt_len=128, new_tokens=256,
     # cross HBM as int8 (decode is bound by the params+cache read)
     from tensorflowonspark_tpu import quantize as qz
 
-    qparams = qz.quantize_tree(params)
+    qparams = jax.jit(lambda p: qz.quantize_tree(p))(params)
     _, _, step_ms_q = _decode_step_ms(model, qparams, prompt, new_tokens)
     return {
         "tokens_per_sec_e2e": round(batch * new_tokens / dtn, 1),
@@ -632,8 +788,10 @@ def decode_long_bench(batch=8, prompt_len=128, new_tokens=1896):
         jnp.int32,
     )
     model = mk("bfloat16")
-    params = model.init(jax.random.PRNGKey(0), prompt[:1])["params"]
-    qparams = qz.quantize_tree(params)
+    params = jax.jit(
+        lambda r: model.init(r, prompt[:1])["params"]
+    )(jax.random.PRNGKey(0))
+    qparams = jax.jit(lambda p: qz.quantize_tree(p))(params)
 
     bf16 = _decode_step_ms(model, params, prompt, new_tokens)[2]
     w8 = _decode_step_ms(model, qparams, prompt, new_tokens)[2]
@@ -653,11 +811,12 @@ def decode_long_bench(batch=8, prompt_len=128, new_tokens=1896):
     }
 
 
-def long_context_bench(seq_len=32768, iters=10):
-    """Single-chip long-context attention: flash kernel vs the ring
-    composition on a 1-device seq mesh (the ring's per-chunk pallas
-    inner step must add no overhead at p=1 — VERDICT r3 'Next' #1's
-    no-regression gate).  fwd+bwd per iteration, bf16, B1 H8 D128."""
+def _long_context_one(seq_len, iters):
+    """flash vs ring vs Ulysses at one sequence length (fwd+bwd, bf16,
+    B1 H8 D128).  Both sharded compositions run on a 1-device seq mesh:
+    the per-chunk pallas inner step (ring) and the all-to-all reshard
+    (Ulysses) must add no overhead at p=1 — the no-regression gate; the
+    p>1 paths are validated by the dryrun + cross-process Gloo tests."""
     import numpy as np
 
     import jax
@@ -668,13 +827,17 @@ def long_context_bench(seq_len=32768, iters=10):
     from tensorflowonspark_tpu.ops.ring_attention import (
         ring_attention_sharded,
     )
+    from tensorflowonspark_tpu.ops.ulysses import ulysses_attention_sharded
 
     b, h, d = 1, 8, 128
-    rng = np.random.RandomState(0)
-    q, k, v = (
-        jnp.asarray(rng.randn(b, seq_len, h, d), jnp.bfloat16)
-        for _ in range(3)
-    )
+    # generated ON DEVICE (one jitted program): host randn + transfer
+    # of 3x67MB over the tunnel cost more than the measurement
+    q, k, v = jax.jit(
+        lambda key: tuple(
+            jax.random.normal(k2, (b, seq_len, h, d), jnp.bfloat16)
+            for k2 in jax.random.split(key, 3)
+        )
+    )(jax.random.PRNGKey(0))
     mesh = Mesh(np.array(jax.devices()[:1]), ("seq",))
 
     def loss_flash(q, k, v):
@@ -689,8 +852,19 @@ def long_context_bench(seq_len=32768, iters=10):
             ).astype(jnp.float32)
         )
 
+    def loss_ulysses(q, k, v):
+        return jnp.sum(
+            ulysses_attention_sharded(
+                q, k, v, mesh, causal=True, local_impl="flash"
+            ).astype(jnp.float32)
+        )
+
     out = {"seq_len": seq_len, "shape": "B%d H%d D%d bf16" % (b, h, d)}
-    for name, fn in (("flash", loss_flash), ("ring_p1", loss_ring)):
+    for name, fn in (
+        ("flash", loss_flash),
+        ("ring_p1", loss_ring),
+        ("ulysses_p1", loss_ulysses),
+    ):
         g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
         res = g(q, k, v)
         float(jnp.ravel(res[0])[0])  # compile + definitive sync
@@ -702,7 +876,19 @@ def long_context_bench(seq_len=32768, iters=10):
             (time.perf_counter() - t0) / iters * 1e3, 1
         )
     out["ring_vs_flash"] = round(out["ring_p1_ms"] / out["flash_ms"], 3)
+    out["ulysses_vs_flash"] = round(
+        out["ulysses_p1_ms"] / out["flash_ms"], 3
+    )
     return out
+
+
+def long_context_bench():
+    """Single-chip long-context attention (VERDICT r3 #1 no-regression
+    gate + VERDICT r4 #5 Ulysses evidence): S=8k and S=32k rows."""
+    return {
+        "s8k": _long_context_one(8192, 10),
+        "s32k": _long_context_one(32768, 6),
+    }
 
 
 # ----------------------------------------------------------------------
@@ -927,6 +1113,133 @@ def ps_bench(steps=300, batch=64, hidden=256):
     return out
 
 
+def ps_tpu_bench(steps=40, batch=64, hidden=1024):
+    """Async-PS on the REAL TPU path (VERDICT r4 'Next' #6): healthy
+    async-vs-sync where the worker's grads are TPU-dispatched.  Runs in
+    the chip-owning process; the two PS shards stay in CPU child
+    processes (as ps-role nodes run).  What this isolates:
+
+    - ``async_pipelined`` vs ``async_unpipelined``: whether the PS wire
+      round trip actually hides behind TPU execution (the r4 claim —
+      on CPU-jax the jitted grad holds the GIL so worker threads cannot
+      progress; TPU dispatch is async and releases the GIL during the
+      device wait, so the previous step's round trip overlaps it).
+    - ``async_vs_sync``: the architectural cost that remains — every
+      async step must land grads on the host to cross the TCP wire
+      (device->host pull per step), while sync DP keeps the whole chain
+      device-resident.  On the tunneled chip that pull pays the tunnel
+      RTT; on a local chip it pays PCIe/DMA only.  Reported as-is.
+    """
+    import multiprocessing as mp
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp
+    from tensorflowonspark_tpu.parallel.ps import AsyncTrainer
+
+    def loss_fn(params, batch):
+        x, y = batch
+        h = jnp.maximum(x @ params["w1"] + params["b1"], 0.0)
+        logits = h @ params["w2"] + params["b2"]
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, y[:, None].astype(jnp.int32), axis=1)
+        )
+
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(784, hidden) * 0.05, jnp.float32),
+        "b1": jnp.zeros((hidden,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(hidden, 10) * 0.05, jnp.float32),
+        "b2": jnp.zeros((10,), jnp.float32),
+    }
+    x = rng.randn(batch, 784).astype(np.float32)
+    y = (rng.randint(0, 10, size=batch)).astype(np.int64)
+    data = (jnp.asarray(x), jnp.asarray(y))
+
+    ctx_mp = mp.get_context("spawn")
+    port_q = ctx_mp.Queue()
+    shard_procs = [
+        ctx_mp.Process(target=_ps_shard_proc, args=(port_q,), daemon=True)
+        for _ in range(2)
+    ]
+    for sp in shard_procs:
+        sp.start()
+    addrs = [
+        "127.0.0.1:{0}".format(port_q.get(timeout=60)) for _ in shard_procs
+    ]
+    out = {"platform": jax.devices()[0].platform}
+    try:
+        for key, pipe in (
+            ("async_pipelined_steps_per_sec", True),
+            ("async_unpipelined_steps_per_sec", False),
+        ):
+            w = AsyncTrainer(
+                loss_fn, addrs,
+                optimizer=("sgd", {"learning_rate": 0.01}),
+                pipeline=pipe,
+            )
+            p = w.init(params)
+            p = w.step(p, data)  # compile + first round trip
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                p = w.step(p, data)
+            w.drain()
+            out[key] = round(steps / (time.perf_counter() - t0), 1)
+            w.stop()
+    finally:
+        try:
+            from tensorflowonspark_tpu.parallel.ps import PSClient
+
+            PSClient(addrs, timeout=5).stop()
+        except Exception:  # noqa: BLE001 - teardown backstop below
+            pass
+        for sp in shard_procs:
+            sp.join(timeout=5)
+            if sp.is_alive():
+                sp.terminate()
+
+    trainer = dp.SyncTrainer(
+        lambda prm, b, r: loss_fn(prm, b), optax.sgd(0.01)
+    )
+    state = trainer.create_state(params)
+    state, m = trainer.step(state, data)  # compile
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = trainer.step(state, data)
+    float(m["loss"])  # forces the whole dispatched chain
+    out["sync_steps_per_sec"] = round(steps / (time.perf_counter() - t0), 1)
+    out["pipeline_overlap_gain"] = round(
+        out["async_pipelined_steps_per_sec"]
+        / out["async_unpipelined_steps_per_sec"],
+        3,
+    )
+    out["async_vs_sync"] = round(
+        out["async_pipelined_steps_per_sec"] / out["sync_steps_per_sec"], 3
+    )
+    out["model"] = "MLP 784-%d-10, batch %d, 2 PS shards" % (hidden, batch)
+    if out["async_vs_sync"] < 0.7:
+        # measured on the tunneled chip: every async step pays a
+        # synchronous device->host grad pull + host->device param push
+        # across the ~100ms-RTT tunnel (inherent to the PS wire
+        # architecture), while sync DP's whole chain stays
+        # device-resident and pipelines dispatches.  pipeline=True's
+        # overlap only hides the PS TCP time, which is tiny next to
+        # the tunnel transfer.  On a directly-attached TPU host the
+        # pull is PCIe (~ms), not a WAN RTT.
+        out["bottleneck"] = (
+            "per-step device->host grad transfer over the tunnel "
+            "(sync DP stays device-resident); PS wire time itself "
+            "overlaps (see pipeline_overlap_gain)"
+        )
+    return out
+
+
 def _aux_worker():
     """Subprocess entry (CPU-pinned): serving + async-PS benches, one
     JSON line on stdout."""
@@ -941,26 +1254,6 @@ def _aux_worker():
             print("%s bench failed: %s" % (name, e), file=sys.stderr)
             out[name] = None
     print(json.dumps(out))
-
-
-def run_aux_bench():
-    """Serving + PS benches in a CPU subprocess (the parent owns the
-    accelerator; these measure marshalling/TCP, not the chip)."""
-    try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--aux-worker"],
-            stdout=subprocess.PIPE,
-            stderr=sys.stderr,
-            timeout=600,
-            text=True,
-            cwd=os.path.dirname(os.path.abspath(__file__)),
-        )
-        if proc.returncode != 0:
-            return None
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except Exception as e:  # noqa: BLE001 - aux benches are auxiliary
-        print("aux bench unavailable: %s" % e, file=sys.stderr)
-        return None
 
 
 # ----------------------------------------------------------------------
@@ -1266,16 +1559,22 @@ def feed_worker():
     small-row queue fallback; 224px-image rows: queue vs the auto
     policy (which selects the ring at that row size)."""
     out = {}
-    out["queue"] = _median_of(_run_feed_once, "0", 3)
-    out["ring"] = _median_of(_run_feed_once, "force", 3)
-    # production setting: TFOS_SHM_FEED=1 engages the size policy —
-    # kilobyte rows ship via the queue (documented fallback); 2 runs so
-    # one transient tunnel-compile flake can't null the entry
-    out["ring_auto"] = _median_of(_run_feed_once, "1", 2)
-    if out.get("ring_auto"):
-        out["ring_auto"]["policy"] = (
-            "rows < TFOS_SHM_RING_MIN_ROW_BYTES=4096: shipped via queue"
-        )
+    # Single runs by default: the r4 3-run medians (jitter study) blew
+    # the driver's wall-clock budget and nulled the whole record
+    # (BENCH_r04 rc=124).  The measured spread (28-36%, BASELINE.md) is
+    # on record; TFOS_FEED_BENCH_REPEATS restores the median mode for
+    # manual studies.
+    rep = int(os.environ.get("TFOS_FEED_BENCH_REPEATS", "1"))
+    out["queue"] = _median_of(_run_feed_once, "0", rep)
+    out["ring"] = _median_of(_run_feed_once, "force", rep)
+    if rep > 1:
+        # production setting: TFOS_SHM_FEED=1 engages the size policy —
+        # kilobyte rows ship via the queue (documented fallback)
+        out["ring_auto"] = _median_of(_run_feed_once, "1", rep - 1)
+        if out.get("ring_auto"):
+            out["ring_auto"]["policy"] = (
+                "rows < TFOS_SHM_RING_MIN_ROW_BYTES=4096: shipped via queue"
+            )
     out["image_queue"] = _median_of(_run_image_feed_once, "0", 1)
     # image rows are ~150KB: the auto policy selects the ring
     out["image_ring"] = _median_of(_run_image_feed_once, "1", 1)
@@ -1306,7 +1605,9 @@ def run_feed_bench():
             [sys.executable, os.path.abspath(__file__), "--feed-worker"],
             stdout=subprocess.PIPE,
             stderr=sys.stderr,
-            timeout=1800,
+            # never let the feed subprocess eat the whole record's
+            # budget (required compute rows still need ~half of it)
+            timeout=min(1800, max(180, _remaining() * 0.55)),
             text=True,
             cwd=os.path.dirname(os.path.abspath(__file__)),
         )
@@ -1318,34 +1619,96 @@ def run_feed_bench():
         return None
 
 
+def start_aux_bench():
+    """Launch the CPU-pinned aux benches (serving_cpu + async_ps over
+    TCP — they never touch the chip) as a background subprocess that
+    runs CONCURRENTLY with the parent's TPU sections; collected before
+    the final emit.  Saves their full wall time from the budget."""
+    try:
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--aux-worker"],
+            stdout=subprocess.PIPE,
+            stderr=sys.stderr,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    except Exception as e:  # noqa: BLE001 - aux benches are auxiliary
+        print("aux bench unavailable: %s" % e, file=sys.stderr)
+        return None
+
+
+def collect_aux_bench(proc, timeout):
+    if proc is None:
+        return None
+    try:
+        stdout, _ = proc.communicate(timeout=max(10, timeout))
+        if proc.returncode != 0:
+            return None
+        return json.loads(stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 - aux benches are auxiliary
+        proc.kill()
+        print("aux bench unavailable: %s" % e, file=sys.stderr)
+        return None
+
+
 def main(model_name="resnet50", with_feed=True):
-    feed = run_feed_bench() if with_feed else None
-    aux = run_aux_bench() if with_feed else None
-    out = compute_bench(model_name)
+    """Default driver record.  Emits the CUMULATIVE record as one JSON
+    line after EVERY completed section (the driver parses the last
+    line, so a timeout kill truncates instead of nulling — the r4
+    failure mode), and skips budget-overrunning aux rows with a note.
+    Section order = required rows first: spark_feed (the subprocess
+    must own the chip before this process touches it), resnet50
+    headline, transformer flagship, decode."""
+    out = {}
+
+    def emit():
+        out["bench_wall_sec"] = round(time.monotonic() - BENCH_T0, 1)
+        print(json.dumps(out), flush=True)
+
+    aux_proc = start_aux_bench() if with_feed else None
     if with_feed:
-        # the flagship long-context LM rides along in the default
-        # record (the driver invokes plain `python bench.py`); retried
-        # like every other entry point — one transient tunnel error
-        # must not drop the record
+        feed = run_feed_bench()
+        if feed:
+            out["spark_feed"] = feed
+            emit()
+    try:
+        out.update(with_retry(lambda: compute_bench(model_name)))
+        emit()
+    except Exception as e:  # noqa: BLE001 - keep the partial record alive
+        print("compute bench failed: %s" % e, file=sys.stderr)
+    if with_feed:
         try:
             out["transformer"] = with_retry(transformer_bench)
+            emit()
         except Exception as e:  # noqa: BLE001 - auxiliary to the headline
             print("transformer bench failed: %s" % e, file=sys.stderr)
-        for name, fn in (
-            ("long_context", long_context_bench),
-            ("serving_tpu", serving_tpu_bench),
-            ("decode", decode_bench),
-            ("decode_long", decode_long_bench),
+        # decode is a required row -> cost 0 (never skipped); the rest
+        # are ordered cheapest-first and skipped once the budget can't
+        # cover their estimated wall (compile included)
+        for name, fn, est_sec in (
+            ("decode", decode_bench, 0),
+            ("long_context", long_context_bench, 150),
+            ("serving_generate", serving_generate_bench, 150),
+            ("decode_long", decode_long_bench, 160),
+            ("async_ps_tpu", ps_tpu_bench, 100),
+            ("serving_tpu", serving_tpu_bench, 120),
         ):
+            if est_sec and _remaining() < est_sec:
+                out.setdefault("skipped", {})[name] = (
+                    "budget: %.0fs left < ~%ds needed"
+                    % (max(0, _remaining()), est_sec)
+                )
+                emit()
+                continue
             try:
-                out[name] = with_retry(fn)
+                out[name] = with_retry(fn, attempts=2)
+                emit()
             except Exception as e:  # noqa: BLE001 - auxiliary rows
                 print("%s bench failed: %s" % (name, e), file=sys.stderr)
-    if feed:
-        out["spark_feed"] = feed
+    aux = collect_aux_bench(aux_proc, _remaining())
     if aux:
         out.update(aux)
-    print(json.dumps(out))
+    emit()
 
 
 def with_retry(fn, attempts=3):
@@ -1367,17 +1730,16 @@ def with_retry(fn, attempts=3):
     raise last
 
 
-def main_with_retry(attempts=3, **kw):
-    return with_retry(lambda: main(**kw), attempts)
-
-
 if __name__ == "__main__":
+    _enable_compile_cache()
     if "--feed-worker" in sys.argv:
         feed_worker()
     elif "--aux-worker" in sys.argv:
         _aux_worker()
     elif "serving_tpu" in sys.argv:
         print(json.dumps(with_retry(serving_tpu_bench)))
+    elif "serving_generate" in sys.argv:
+        print(json.dumps(with_retry(serving_generate_bench)))
     elif "serving" in sys.argv:
         print(json.dumps(with_retry(serving_bench)))
     elif "long_context" in sys.argv:
@@ -1386,33 +1748,51 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(decode_long_bench)))
     elif "decode" in sys.argv:
         print(json.dumps(with_retry(decode_bench)))
+    elif "ps_tpu" in sys.argv:
+        print(json.dumps(with_retry(ps_tpu_bench)))
     elif "ps" in sys.argv:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(with_retry(ps_bench)))
     elif "resnet56" in sys.argv:
-        main_with_retry(model_name="resnet56", with_feed=False)
+        main(model_name="resnet56", with_feed=False)
     elif "resnet50" in sys.argv:
-        main_with_retry(model_name="resnet50", with_feed=False)
+        main(model_name="resnet50", with_feed=False)
     elif "transformer" in sys.argv:
         print(json.dumps(with_retry(transformer_bench)))
     elif "moe" in sys.argv:
         # MoE variant of the flagship: 8 experts top-2, E*Dff capacity
         # in place of the dense FFN (metric: tokens/s at ACTIVE-param
-        # MFU accounting)
-        os.environ.setdefault(
-            "TFOS_LM_CONFIG",
-            json.dumps({
-                # 4 layers x 8 experts: 485M total / 183M active — the
-                # sparse-capacity regime at a size whose adam state
-                # fits one chip's HBM
-                "E": 8, "topk": 2, "L": 4, "timed": 24, "B": 4,
-                # expert capacity tensors are E/k x the dense
-                # activations: block remat keeps them out of HBM
-                "remat": True, "remat_policy": "block",
-            }),
-        )
-        print(json.dumps(with_retry(transformer_bench)))
+        # MFU accounting).  The recorded DEFAULT is CF=1.0 — the r4
+        # sweep measured it at 50% active MFU vs 41% for CF=1.25, and
+        # the drop_rate field now quantifies what that costs (VERDICT
+        # r4 #4); CF=1.25 stays as the conservative row and dropless as
+        # the zero-drop row.
+        base = {
+            # 4 layers x 8 experts: 485M total / 183M active — the
+            # sparse-capacity regime at a size whose adam state
+            # fits one chip's HBM
+            "E": 8, "topk": 2, "L": 4, "timed": 24, "B": 4,
+            # expert capacity tensors are E/k x the dense
+            # activations: block remat keeps them out of HBM
+            "remat": True, "remat_policy": "block",
+        }
+        user = json.loads(os.environ.get("TFOS_LM_CONFIG", "{}"))
+        out = None
+        for name, over in (
+            (None, {"CF": 1.0}),
+            ("cf125", {"CF": 1.25}),
+            ("dropless", {"DISPATCH": "dropless"}),
+        ):
+            os.environ["TFOS_LM_CONFIG"] = json.dumps(
+                {**base, **over, **user}
+            )
+            r = with_retry(transformer_bench)
+            if out is None:
+                out = r
+            else:
+                out[name] = r
+        print(json.dumps(out))
     else:
-        main_with_retry()
+        main()
