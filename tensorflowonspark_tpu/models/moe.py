@@ -38,7 +38,18 @@ class MoEMLP(nn.Module):
     #: "dropless" (NO capacity: tokens sorted by expert into a
     #: tile-aligned layout and multiplied by the pallas grouped-matmul
     #: kernel — zero drops, padding only rounds each expert's run up to
-    #: one ``gmm_block_rows`` tile instead of the CF× slack)
+    #: one ``gmm_block_rows`` tile instead of the CF× slack).
+    #:
+    #: SHARDING CONSTRAINT for "dropless": the gmm pallas call is
+    #: opaque to GSPMD, so the expert weights [E, D, M] must be fully
+    #: REPLICATED on every device that runs this module.  If they are
+    #: sharded on any mesh axis — via ``TransformerConfig.mesh`` (the
+    #: Block-level guard catches that case) or via EXTERNAL
+    #: ``jit``/``in_shardings`` specs built from ``logical_axes()``
+    #: (which the guard cannot see: tracer shardings are not
+    #: inspectable at apply time) — XLA silently all-gathers the full
+    #: expert stack onto every device, defeating EP/TP.  Use "gather"
+    #: for expert- or model-sharded deployments.
     dispatch: str = "gather"
     #: gmm row-tile size for dispatch="dropless" (per-expert padding
     #: quantum; must be a multiple of the MXU's 8-row sublane)
@@ -89,6 +100,12 @@ class MoEMLP(nn.Module):
                 logits, k=self.k
             )
             self.sow("losses", "moe_aux", aux)
+            # dropless by construction; sown for a uniform telemetry
+            # surface across dispatch modes (read via
+            # mutable=["moe_stats"], e.g. bench.py moe)
+            self.sow(
+                "moe_stats", "drop_rate", jnp.zeros((), jnp.float32)
+            )
             layout = moe_ops.dropless_layout(experts, e, bm=bm)
             xs = moe_ops.dispatch_sorted(xf.astype(jdtype), layout)
             h = gmm.grouped_matmul(
@@ -109,6 +126,13 @@ class MoEMLP(nn.Module):
                 logits, e, cap, k=self.k
             )
             self.sow("losses", "moe_aux", aux)
+            # a dropped (token, choice) has its gate zeroed by the
+            # capacity overflow mask in top_k_routing (router probs are
+            # strictly positive post-softmax, so gate==0 <=> dropped)
+            self.sow(
+                "moe_stats", "drop_rate",
+                jnp.mean((gates == 0.0).astype(jnp.float32)),
+            )
             xe = moe_ops.dispatch_gather(
                 xf.astype(jdtype), experts, slots, gates, e, cap
             )  # [E, C, D], one row-gather
@@ -117,6 +141,12 @@ class MoEMLP(nn.Module):
                 logits, e, cap, k=self.k
             )
             self.sow("losses", "moe_aux", aux)
+            g_tok = logits.shape[0]
+            self.sow(
+                "moe_stats", "drop_rate",
+                1.0 - jnp.sum(dispatch.astype(jnp.float32))
+                / (g_tok * self.k),
+            )
             # dispatch: [G,E,C] x [G,D] -> expert batches [E,C,D]
             xe = jnp.einsum(
                 "gec,gd->ecd", dispatch.astype(jdtype), xf.astype(jdtype)

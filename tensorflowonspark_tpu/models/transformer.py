@@ -118,7 +118,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, decode=False):
+    def __call__(self, x, positions, decode=False, pad_start=None):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.head_dim
         hkv = cfg.num_kv_heads or h
@@ -215,7 +215,27 @@ class Attention(nn.Module):
                     visible,
                     kpos[None, :] > qpos[:, None] - cfg.attention_window,
                 )
-            mask = jnp.where(visible, 0.0, -jnp.inf)[None, None]
+            if pad_start is not None:
+                # ragged LEFT-padded batch: row r's cache slots before
+                # pad_start[r] hold pad K/V and are never attended.
+                # RoPE scores depend only on position DIFFERENCES, so
+                # keeping physical slot positions leaves each row's
+                # numerics identical to its unpadded run.  Pad QUERY
+                # rows keep their own slot visible — otherwise their
+                # softmax sees only -inf and the resulting NaN output
+                # poisons the pad K/V of the NEXT layer (0 * NaN); for
+                # real rows self-visibility is already implied by the
+                # causal+window mask, so this changes nothing there.
+                visible = jnp.logical_or(
+                    jnp.logical_and(
+                        visible[None],
+                        kpos[None, None, :] >= pad_start[:, None, None],
+                    ),
+                    (kpos[None, :] == qpos[:, None])[None],
+                )
+                mask = jnp.where(visible, 0.0, -jnp.inf)[:, None]
+            else:
+                mask = jnp.where(visible, 0.0, -jnp.inf)[None, None]
             out = dot_attention(
                 q, ck.value, cv.value, causal=False, mask=mask,
                 k_scale=cks.value if int8_cache else None,
@@ -260,10 +280,11 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, decode=False):
+    def __call__(self, x, positions, decode=False, pad_start=None):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(name="ln1")(x), positions, decode=decode
+            RMSNorm(name="ln1")(x), positions, decode=decode,
+            pad_start=pad_start,
         )
         h = RMSNorm(name="ln2")(x)
         if cfg.num_experts > 0:
@@ -303,8 +324,13 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode=False):
+    def __call__(self, tokens, decode=False, pad_start=None):
         cfg = self.cfg
+        if pad_start is not None and not decode:
+            raise ValueError(
+                "pad_start (ragged left-padded batches) is a decode-"
+                "path feature; the training path has no pad masking"
+            )
         emb = self.param(
             "embedding",
             nn.initializers.normal(stddev=0.02),
@@ -346,7 +372,9 @@ class Transformer(nn.Module):
                 x = block(cfg, name="block_%d" % i)(x, positions)
         else:
             for i in range(cfg.num_layers):
-                x = Block(cfg, name="block_%d" % i)(x, positions, decode)
+                x = Block(cfg, name="block_%d" % i)(
+                    x, positions, decode, pad_start=pad_start
+                )
         x = RMSNorm(name="ln_f")(x)
         # tied output head would shard awkwardly under TP; a separate
         # vocab projection keeps the ``vocab`` logical axis clean
@@ -461,7 +489,7 @@ def sample_logits(logits, key, temperature=0.0, top_k=0, top_p=0.0):
 
 
 def generate(model, params, prompt, max_new_tokens, temperature=0.0,
-             rng=None, top_k=0, top_p=0.0):
+             rng=None, top_k=0, top_p=0.0, pad_start=None, eos_id=None):
     """Autoregressive sampling with a KV cache.
 
     New TPU-first capability (the reference has no text generation of
@@ -479,6 +507,17 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
       temperature: 0 = greedy argmax; otherwise categorical sampling
         (requires ``rng``), filtered by ``top_k``/``top_p`` (see
         :func:`sample_logits`).
+      pad_start: optional ``[B]`` int32 — ragged multi-request
+        batching: prompts LEFT-padded to a common ``P`` with
+        ``pad_start[r]`` pad slots before row ``r``'s real tokens.
+        Pad cache slots are masked out of every attention; RoPE scores
+        depend only on position differences, so each row generates
+        exactly what its unpadded prompt would (serving pads rows and
+        derives this automatically — see serving_builder
+        ``mode="generate"``).
+      eos_id: optional stop token — once a row samples it, every later
+        position emits ``eos_id`` again (per-row stop inside the one
+        compiled scan; the serving layer trims them).
     Returns ``[B, max_new_tokens]`` sampled tokens.
     """
     b, p = prompt.shape
@@ -518,26 +557,35 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
     cache = init_cache(model, b, cache_len=total)
     logits, mut = model.apply(
         {"params": params, "cache": cache}, prompt, decode=True,
-        mutable=["cache"],
+        mutable=["cache"], pad_start=pad_start,
     )
     rng, key = jax.random.split(rng)
     first = sample(logits[:, -1], key)
+    done0 = (
+        first == eos_id if eos_id is not None
+        else jnp.zeros((b,), jnp.bool_)
+    )
 
     def step(carry, key):
-        cache, tok = carry
+        cache, tok, done = carry
         p = (
             qz.dequantize_tree(qparams, model.cfg.jdtype, barrier=True)
             if quantized else params
         )
         logits, mut = model.apply(
             {"params": p, "cache": cache}, tok[:, None],
-            decode=True, mutable=["cache"],
+            decode=True, mutable=["cache"], pad_start=pad_start,
         )
         nxt = sample(logits[:, 0], key)
-        return (mut["cache"], nxt), nxt
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        return (mut["cache"], nxt, done), nxt
 
     keys = jax.random.split(rng, max(0, max_new_tokens - 1))
-    (_, _), rest = jax.lax.scan(step, (mut["cache"], first), keys)
+    (_, _, _), rest = jax.lax.scan(
+        step, (mut["cache"], first, done0), keys
+    )
     return jnp.concatenate(
         [first[:, None], jnp.swapaxes(rest, 0, 1)], axis=1
     ) if max_new_tokens > 1 else first[:, None]
@@ -576,6 +624,14 @@ def generate_speculative(model, params, prompt, max_new_tokens,
     total = p + max_new_tokens
     if k < 1:
         raise ValueError("draft_len must be >= 1")
+    if ngram < 1:
+        # ngram=0 would make every history position a "match" and draft
+        # from position 0 forever
+        raise ValueError("ngram must be >= 1")
+    if max_new_tokens <= 0:
+        # mirror generate(): nothing to emit — skip cache alloc/prefill
+        out = jnp.zeros((prompt.shape[0], 0), jnp.int32)
+        return (out, 0) if return_stats else out
     if total > model.cfg.max_seq_len:
         raise ValueError(
             "prompt ({0}) + max_new_tokens ({1}) exceeds "
@@ -726,26 +782,64 @@ def serving_builder(params, config):
             )
         draft_len = int(config.get("draft_len", 4))
         ngram = int(config.get("ngram", 2))
+        pad_id = int(config.get("pad_id", 0))
+        eos_id = config.get("eos_id")
+        eos_id = None if eos_id is None else int(eos_id)
+        input_name = config.get("input_name", "tokens")
         variables = base.as_variables(params)
 
-        def _gen(v, tokens):
-            if speculative:
+        if speculative:
+            # uniform-length batches only (generate_speculative has no
+            # ragged support; rows of unequal length fail at stacking)
+            def _gen_spec(v, tokens):
                 return generate_speculative(
                     model, v["params"], jnp.asarray(tokens, jnp.int32),
                     max_new, draft_len=draft_len, ngram=ngram,
                 )
-            return generate(
-                model, v["params"], jnp.asarray(tokens, jnp.int32),
-                max_new, temperature=temperature, rng=rng,
-                top_k=top_k, top_p=top_p,
+
+            return base.make_serving_predict(
+                variables,
+                _gen_spec,
+                input_name,
+                lambda toks: {"generated": np.asarray(toks, np.int32)},
             )
 
-        return base.make_serving_predict(
-            variables,
-            _gen,
-            config.get("input_name", "tokens"),
-            lambda toks: {"generated": np.asarray(toks, np.int32)},
+        # ragged multi-request batching: predict_rows left-pads each
+        # batch's prompts (predict.column_padding) and ships per-row
+        # pad counts; generate() masks the pad slots and stops rows at
+        # eos_id inside the one compiled scan
+        jitted = jax.jit(
+            lambda v, tokens, pads: generate(
+                model, v["params"], tokens, max_new,
+                temperature=temperature, rng=rng, top_k=top_k,
+                top_p=top_p, pad_start=pads, eos_id=eos_id,
+            )
         )
+
+        def predict(batch):
+            tokens = jnp.asarray(batch[input_name], jnp.int32)
+            pads = batch.get(input_name + "_pad")
+            pads = (
+                jnp.zeros((tokens.shape[0],), jnp.int32)
+                if pads is None else jnp.asarray(pads, jnp.int32)
+            )
+            out = np.asarray(jitted(variables, tokens, pads), np.int32)
+            res = {"generated": out}
+            if eos_id is not None:
+                first_eos = np.where(
+                    (out == eos_id).any(axis=1),
+                    (out == eos_id).argmax(axis=1),
+                    out.shape[1],
+                ).astype(np.int32)
+                res["generated_len"] = first_eos
+            return res
+
+        predict.column_padding = {input_name: pad_id}
+        # bucket prompt lengths to multiples of 64 so the compiled
+        # generate program is reused across batches (config:
+        # pad_multiple)
+        predict.pad_multiple = int(config.get("pad_multiple", 64))
+        return predict
     return base.make_serving_predict(
         base.as_variables(params),
         lambda v, tokens: model.apply(v, jnp.asarray(tokens, jnp.int32)),

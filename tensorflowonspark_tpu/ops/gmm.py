@@ -21,7 +21,10 @@ consecutive tiles of the same expert reuse the resident weight block;
 the full weight matrix is DMA'd exactly once per ``bf`` stripe):
 
 - forward  ``y[t] = x[t] @ w[tile_expert[t]]``
-- dx       the same kernel against ``w`` transposed ``[E, F, D]``
+- dx       ``dx[t] = dy[t] @ w[tile_expert[t]].T`` with ``w`` read in
+  its STORED ``[E, D, F]`` layout (lane-dim contraction, full-``F``
+  resident blocks); falls back to a transposed HBM copy + the forward
+  kernel only when ``F`` is too wide for VMEM residency
 - dw       ``dw[e] = sum_{t: te[t]=e} x[t].T @ dy[t]`` — an output
   block revisited across the contiguous run of ``t`` for each expert,
   zeroed at the first visit (f32 accumulation in VMEM).
@@ -138,6 +141,78 @@ def gmm_call(x, w, tile_expert, *, bm=256, bf=None, interpret=None):
     )(tile_expert, x, w)
 
 
+def _gmm_dxt_kernel(te_ref, dy_ref, w_ref, dx_ref):
+    del te_ref  # consumed by the index maps
+    # contract the LANE dim of both operands: dy[bm, F] x w[bd, F]^T
+    # -> dx[bm, bd]; reads w in its stored [E, D, F] layout
+    dx_ref[...] = jax.lax.dot_general(
+        dy_ref[...], w_ref[0],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(dx_ref.dtype)
+
+
+def _pick_bd(bm, d, f, bd):
+    """Output-dim block for the dx kernel: largest 128·2^k divisor of
+    ``d`` (or full ``d``) whose double-buffered working set with a
+    FULL-``f`` block fits the scoped-VMEM budget.  Full-width f blocks
+    mean no stripe loop, so a group's weight block stays resident
+    across its consecutive row tiles exactly like the forward.  Returns
+    0 when ``f`` is too wide for any resident block (caller falls back
+    to the transposed-copy path)."""
+    budget = 14 * 1024 * 1024
+
+    def fits(c):
+        return 2 * 2 * (bm * f + c * f + bm * c) <= budget
+
+    if bd is not None and d % bd == 0 and fits(bd):
+        return min(bd, d)
+    best = 0
+    c = 128
+    while c <= min(d, 2048):
+        if d % c == 0 and fits(c):
+            best = c
+        c *= 2
+    if not best and fits(d):
+        best = d  # small or non-128-divisible d: one full-width block
+    return best
+
+
+def gmm_dxt_call(dy, w, tile_expert, *, bm=256, bd=None, interpret=None):
+    """``dx[N, D] = dy[N, F] @ w[te].T`` reading ``w[E, D, F]`` in its
+    STORED layout — the backward's input gradient without materializing
+    ``swapaxes(w, 1, 2)`` (a full transposed weight copy in HBM every
+    step; ADVICE r4 #4).  Returns None when no resident block exists
+    for this ``f`` (then the caller takes the transposed-copy path)."""
+    if interpret is None:
+        interpret = _interpret()
+    n, f = dy.shape
+    e, d, f2 = w.shape
+    assert f == f2, (dy.shape, w.shape)
+    assert n % bm == 0, (n, bm)
+    t = n // bm
+    assert tile_expert.shape == (t,), (tile_expert.shape, t)
+    bd = _pick_bd(bm, d, f, bd)
+    if not bd:
+        return None
+    grid_spec = _grid_spec(
+        1,
+        (d // bd, t),
+        [
+            pl.BlockSpec((bm, f), lambda di, ti, te: (ti, 0)),
+            pl.BlockSpec((1, bd, f), lambda di, ti, te: (te[ti], di, 0)),
+        ],
+        pl.BlockSpec((bm, bd), lambda di, ti, te: (ti, di)),
+    )
+    return pl.pallas_call(
+        _gmm_dxt_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, d), dy.dtype),
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(tile_expert, dy, w)
+
+
 def _tgmm_kernel(te_ref, x_ref, dy_ref, dw_ref, acc_ref):
     ti = pl.program_id(2)
     nt = pl.num_programs(2)
@@ -245,8 +320,12 @@ def _grouped_matmul_fwd(x, w, tile_expert, bm, bf):
 
 def _grouped_matmul_bwd(bm, bf, res, dy):
     x, w, tile_expert = res
-    wt = jnp.swapaxes(w, 1, 2)  # [E, F, D]
-    dx = gmm_call(dy, wt, tile_expert, bm=bm, bf=bf)
+    dx = gmm_dxt_call(dy, w, tile_expert, bm=bm)
+    if dx is None:
+        # F too wide for a resident full-width block: pay the HBM
+        # transpose copy and reuse the striped forward kernel
+        wt = jnp.swapaxes(w, 1, 2)  # [E, F, D]
+        dx = gmm_call(dy, wt, tile_expert, bm=bm, bf=bf)
     dw = tgmm_call(
         x, dy, tile_expert, w.shape[0], bm=bm, bf=bf
     ).astype(w.dtype)

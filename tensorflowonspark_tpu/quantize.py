@@ -79,6 +79,12 @@ def quantize_tree(params, min_size=16384, embed_key="embedding",
     """
 
     def _one(path, w):
+        if _is_q(w):
+            # already quantized: pass through unchanged (descending into
+            # the QTensor would re-quantize large float scale leaves —
+            # e.g. an embedding's [V, 1] scales — nesting QTensors and
+            # breaking dequantize later); double application is a no-op
+            return w
         if not hasattr(w, "ndim") or w.ndim < 2:
             return w
         if w.size < min_size or not jnp.issubdtype(w.dtype, jnp.floating):
@@ -90,7 +96,12 @@ def quantize_tree(params, min_size=16384, embed_key="embedding",
             return quantize_leaf(w, reduce_axes=(1,))
         return quantize_leaf(w, reduce_axes=tuple(range(w.ndim - 1)))
 
-    return jax.tree_util.tree_map_with_path(_one, params)
+    # is_leaf=_is_q: QTensor is itself a pytree (NamedTuple) — without
+    # the leaf predicate, tree_map would descend into an already-
+    # quantized tree and hand _one the raw q/scale children (a large
+    # float scale, e.g. an embedding's [V, 1], would then re-quantize
+    # into a NESTED QTensor that crashes dequantize)
+    return jax.tree_util.tree_map_with_path(_one, params, is_leaf=_is_q)
 
 
 def is_quantized(params):

@@ -116,6 +116,31 @@ def _stack_column(values):
     return np.stack([np.asarray(v) for v in values])
 
 
+def _stack_ragged_left(values, pad_value, multiple=1):
+    """Stack ragged 1-D rows by LEFT-padding to the batch max length
+    (rounded up to ``multiple`` — shape BUCKETING, so the jitted
+    generate program retraces once per bucket instead of once per
+    unique prompt length); returns ``(stacked [n, max_len],
+    pad_counts [n] int32)``.  Left-padding keeps every row's real
+    tokens ending at the same position, so the compiled decode scan
+    starts uniformly (the model masks the pad slots via
+    ``pad_start``)."""
+    arrs = [np.asarray(v) for v in values]
+    if any(a.ndim != 1 for a in arrs):
+        raise ValueError(
+            "ragged padding supports 1-D token rows; got shapes %s"
+            % ([a.shape for a in arrs],)
+        )
+    max_len = max(a.shape[0] for a in arrs)
+    max_len = ((max_len + multiple - 1) // multiple) * multiple
+    pads = np.asarray([max_len - a.shape[0] for a in arrs], np.int32)
+    out = np.full((len(arrs), max_len), pad_value, arrs[0].dtype)
+    for i, a in enumerate(arrs):
+        if a.shape[0]:
+            out[i, max_len - a.shape[0]:] = a
+    return out, pads
+
+
 def predict_rows(
     predict,
     rows,
@@ -141,12 +166,25 @@ def predict_rows(
     """
     cols = sorted(input_mapping)
     buf = []
+    # generation predictors declare ragged columns (prompts of varying
+    # length) via ``predict.column_padding = {input_name: pad_value}``;
+    # those stack left-padded and ship a ``<input>_pad`` count column
+    # the model uses to mask the pad slots
+    column_padding = getattr(predict, "column_padding", None) or {}
 
     def _flush(chunk):
         n = len(chunk)
-        batch = {
-            input_mapping[c]: _stack_column([r[c] for r in chunk]) for c in cols
-        }
+        batch = {}
+        for c in cols:
+            name = input_mapping[c]
+            values = [r[c] for r in chunk]
+            if name in column_padding:
+                batch[name], batch[name + "_pad"] = _stack_ragged_left(
+                    values, column_padding[name],
+                    getattr(predict, "pad_multiple", 1),
+                )
+            else:
+                batch[name] = _stack_column(values)
         if pad_to_batch and n < batch_size:
             batch = {
                 k: np.concatenate(

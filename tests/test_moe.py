@@ -1,5 +1,9 @@
 """MoE routing invariants + expert-parallel numerics."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -154,6 +158,26 @@ class TestGroupedMatmul:
         gr = jax.grad(loss_r, argnums=(0, 1))(x, w)
         np.testing.assert_allclose(gk[0], gr[0], atol=1e-3, rtol=1e-3)
         np.testing.assert_allclose(gk[1], gr[1], atol=1e-3, rtol=1e-3)
+
+    def test_dxt_kernel_matches_transposed_copy(self):
+        # the stored-layout dx kernel (ADVICE r4 #4: no swapaxes HBM
+        # copy) vs the old transposed-copy path, multi-block d
+        x, w, te = self._case(d=256, f=32, seed=3)
+        dy = jnp.asarray(
+            np.random.RandomState(9).randn(x.shape[0], 32).astype(np.float32)
+        )
+        dx = gmm_ops.gmm_dxt_call(dy, w, te, bm=8, bd=128)
+        wt = jnp.swapaxes(w, 1, 2)
+        dx_ref = gmm_ops.gmm_call(dy, wt, te, bm=8, bf=16)
+        assert dx is not None
+        np.testing.assert_allclose(dx, dx_ref, atol=1e-4, rtol=1e-4)
+
+    def test_dxt_falls_back_when_f_exceeds_vmem(self):
+        # no resident full-F block possible -> None (bwd then takes the
+        # transposed-copy path); exercised with a fake huge f via the
+        # picker directly so the test stays small
+        assert gmm_ops._pick_bd(256, 1024, 4096, None) > 0
+        assert gmm_ops._pick_bd(256, 1024, 1 << 22, None) == 0
 
     def test_absent_expert_gets_zero_grad(self):
         # expert never referenced by any tile -> dw exactly 0 there

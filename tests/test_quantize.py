@@ -1,5 +1,9 @@
 """Weight-only int8 quantization: scheme invariants + decode parity."""
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -60,6 +64,46 @@ class TestScheme:
         )
         assert qz.is_quantized(qparams)
         assert not qz.is_quantized(params)
+
+    def test_quantize_tree_is_idempotent(self):
+        # double application must be a no-op: re-quantizing used to
+        # descend into QTensor nodes and quantize large scale leaves,
+        # nesting QTensors and breaking dequantize (ADVICE r4)
+        _, params = _tiny_model()
+        q1 = qz.quantize_tree(params, min_size=512)
+        q2 = qz.quantize_tree(q1, min_size=512)
+        l1 = jax.tree.leaves(q1, is_leaf=lambda x: isinstance(x, qz.QTensor))
+        l2 = jax.tree.leaves(q2, is_leaf=lambda x: isinstance(x, qz.QTensor))
+        assert len(l1) == len(l2)
+        for a, b in zip(l1, l2):
+            if isinstance(a, qz.QTensor):
+                assert isinstance(b, qz.QTensor)
+                assert not isinstance(b.scale, qz.QTensor)
+                np.testing.assert_array_equal(
+                    np.asarray(a.q), np.asarray(b.q)
+                )
+        # and dequantize still works on the twice-quantized tree
+        jax.tree.map(
+            lambda x: x,
+            qz.dequantize_tree(q2),
+        )
+        # the regression case: an embedding whose [V, 1] keepdims SCALE
+        # itself exceeds min_size — without is_leaf=_is_q the second
+        # pass descends into the QTensor and re-quantizes the scale
+        # into a nested QTensor that crashes dequantize
+        big = {
+            "embedding": jnp.asarray(
+                np.random.RandomState(3).randn(20000, 8), jnp.float32
+            )
+        }
+        b1 = qz.quantize_tree(big)
+        b2 = qz.quantize_tree(b1)
+        assert isinstance(b2["embedding"], qz.QTensor)
+        assert not isinstance(b2["embedding"].scale, qz.QTensor)
+        np.testing.assert_array_equal(
+            np.asarray(b1["embedding"].q), np.asarray(b2["embedding"].q)
+        )
+        qz.dequantize_tree(b2)
 
     def test_embedding_uses_per_row_scales(self):
         _, params = _tiny_model()
