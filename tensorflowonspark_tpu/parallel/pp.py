@@ -187,6 +187,8 @@ class PipelineTrainer(object):
         stage_specs=None,
         first_specs=None,
         last_specs=None,
+        batch_spec=None,
+        grad_sync_axes=None,
     ):
         """``schedule``: ``"gpipe"`` (fwd scan + AD backward; activation
         memory O(M) microbatches/stage), ``"1f1b"`` (hand-scheduled
@@ -210,7 +212,16 @@ class PipelineTrainer(object):
         row-parallel, with ``layer_fn`` using
         :func:`~tensorflowonspark_tpu.parallel.tp.tp_copy` /
         :func:`~tensorflowonspark_tpu.parallel.tp.tp_reduce` around its
-        sharded matmuls)."""
+        sharded matmuls).
+
+        ``batch_spec`` overrides the default batch PartitionSpec
+        (``P(data_axes)`` on the leading dim) — pass e.g.
+        ``P("data", "seq")`` to ALSO shard a non-leading activation dim
+        (PP x SP composition: stage attention then runs a seq-axis
+        collective like ring attention inside ``layer_fn``).  When the
+        override shards extra axes, name them in ``grad_sync_axes``
+        (defaults to ``data_axes``) so gradients and metrics are
+        averaged over every axis that splits the batch."""
         if mesh.shape.get(axis_name, 1) < 2:
             raise ValueError(
                 "PipelineTrainer needs a mesh with a >=2-wide {0!r} axis, "
@@ -234,6 +245,14 @@ class PipelineTrainer(object):
         self.interleave = interleave if schedule == "interleaved" else 1
         self.data_axes = tuple(
             a for a in data_axes if mesh.shape.get(a, 1) > 1
+        )
+        self.batch_spec_override = batch_spec
+        self.grad_sync_axes = (
+            tuple(
+                a for a in grad_sync_axes if mesh.shape.get(a, 1) > 1
+            )
+            if grad_sync_axes is not None
+            else self.data_axes
         )
         if stage_specs is not None:
             # a spec that forgets the leading pipe dim leaves the stage
@@ -331,7 +350,12 @@ class PipelineTrainer(object):
         data_axes = self.data_axes
         mesh = self.mesh
 
-        batch_spec = P(data_axes if data_axes else None)
+        sync_axes = self.grad_sync_axes
+        batch_spec = (
+            self.batch_spec_override
+            if self.batch_spec_override is not None
+            else P(data_axes if data_axes else None)
+        )
         param_specs = self._spec_tree()
 
         def local_loss(params, batch):
@@ -392,7 +416,7 @@ class PipelineTrainer(object):
             #   stage: psum over pipe shares them to every stage's
             #   replicated copy.
             def _dmean(g):
-                return lax.pmean(g, data_axes) if data_axes else g
+                return lax.pmean(g, sync_axes) if sync_axes else g
 
             grads = {
                 "stages": jax.tree.map(_dmean, grads["stages"]),
@@ -458,7 +482,12 @@ class PipelineTrainer(object):
         n_ticks = int(prog["do_f"].shape[0])
         stash_slots = min(p, m)
 
-        batch_spec = P(data_axes if data_axes else None)
+        sync_axes = self.grad_sync_axes
+        batch_spec = (
+            self.batch_spec_override
+            if self.batch_spec_override is not None
+            else P(data_axes if data_axes else None)
+        )
         param_specs = self._spec_tree()
 
         stage_fn = functools.partial(_layers_scan, layer_fn)
@@ -585,7 +614,7 @@ class PipelineTrainer(object):
             )
 
             def _dmean(g):
-                return lax.pmean(g, data_axes) if data_axes else g
+                return lax.pmean(g, sync_axes) if sync_axes else g
 
             inv_m = 1.0 / m
             grads = {
@@ -675,7 +704,12 @@ class PipelineTrainer(object):
         qf = geom["fwd_slots"]
         qb = geom["bwd_slots"]
 
-        batch_spec = P(data_axes if data_axes else None)
+        sync_axes = self.grad_sync_axes
+        batch_spec = (
+            self.batch_spec_override
+            if self.batch_spec_override is not None
+            else P(data_axes if data_axes else None)
+        )
         param_specs = self._spec_tree()
 
         stage_fn = functools.partial(_layers_scan, layer_fn)
@@ -855,7 +889,7 @@ class PipelineTrainer(object):
             )
 
             def _dmean(g):
-                return lax.pmean(g, data_axes) if data_axes else g
+                return lax.pmean(g, sync_axes) if sync_axes else g
 
             inv_m = 1.0 / m
             grads = {
@@ -901,9 +935,19 @@ class PipelineTrainer(object):
 
     def step(self, state, batch):
         """One pipelined step on a host-local batch pytree."""
-        from tensorflowonspark_tpu.parallel import sharding as sh
+        if self.batch_spec_override is not None:
+            # place with the override's FULL spec (e.g. P('data','seq')
+            # for PP x SP): placing on data_axes alone would land the
+            # extra-sharded dims replicated and make jit reshard the
+            # whole batch every step
+            sharding = NamedSharding(self.mesh, self.batch_spec_override)
+            device_batch = jax.tree.map(
+                lambda x: jax.device_put(x, sharding), batch
+            )
+        else:
+            from tensorflowonspark_tpu.parallel import sharding as sh
 
-        device_batch = sh.shard_batch(
-            batch, self.mesh, self.data_axes or ("data",)
-        )
+            device_batch = sh.shard_batch(
+                batch, self.mesh, self.data_axes or ("data",)
+            )
         return self._step(state, device_batch)

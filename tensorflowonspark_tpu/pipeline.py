@@ -391,9 +391,12 @@ class TFEstimator(TFParams, *_ESTIMATOR_MIXINS):
 _TRANSFORM_STATE = {"key": None, "predict": None}
 
 
-def _run_model(rows, args, predictor_builder=None):
+def _run_model_iter(rows, args, predictor_builder=None):
     """Per-partition inference body (reference: pipeline.py:596-642
-    ``_run_model_tf2``); runs inside an executor process."""
+    ``_run_model_tf2``); runs inside an executor process.  Yields
+    output dict-rows as they are produced (the lazy Spark path streams
+    them straight into the result RDD without materializing the
+    partition)."""
     from tensorflowonspark_tpu import serving
 
     key = (
@@ -410,15 +413,49 @@ def _run_model(rows, args, predictor_builder=None):
         _TRANSFORM_STATE["key"] = key
     predict = _TRANSFORM_STATE["predict"]
 
-    return list(
-        serving.predict_rows(
-            predict,
-            rows,
-            input_mapping=args.input_mapping,
-            output_mapping=args.output_mapping,
-            batch_size=args.batch_size,
-        )
+    return serving.predict_rows(
+        predict,
+        rows,
+        input_mapping=args.input_mapping,
+        output_mapping=args.output_mapping,
+        batch_size=args.batch_size,
     )
+
+
+def _run_model(rows, args, predictor_builder=None):
+    return list(_run_model_iter(rows, args, predictor_builder))
+
+
+def _py_value(v):
+    """numpy output -> Spark-compatible python value (scalars via
+    ``.item()``; arrays flattened to 1-D lists — the reference's Scala
+    path likewise emits each output tensor as one flat ArrayType
+    column per row, TFModel.scala:294-335)."""
+    import numpy as np
+
+    if isinstance(v, np.ndarray):
+        return v.ravel().tolist()
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _infer_output_type(v):
+    """numpy output value -> interchange type string for the derived
+    DataFrame schema."""
+    import numpy as np
+
+    a = np.asarray(v)
+    kind = a.dtype.kind
+    if kind == "f":
+        base = "float" if a.dtype.itemsize <= 4 else "double"
+    elif kind in "iu":
+        base = "int" if a.dtype.itemsize <= 4 else "long"
+    elif kind == "b":
+        base = "boolean"
+    else:
+        base = "string"
+    return "array<{0}>".format(base) if a.ndim >= 1 else base
 
 
 class TFModel(TFParams, *_MODEL_MIXINS):
@@ -465,6 +502,13 @@ class TFModel(TFParams, *_MODEL_MIXINS):
         elif not isinstance(engine, Engine) and hasattr(engine, "parallelize"):
             engine = SparkEngine(engine)
 
+        if engine.is_native_dataset(dataset):
+            # engine-native dataset: executor-side, LAZY transform
+            # returning a typed DataFrame — rows never transit the
+            # driver (reference: pipeline.py:460-489 mapPartitions +
+            # TFModel.scala:294-335 schema derivation)
+            return self._transform_native(engine, dataset, args)
+
         partitions = _to_partitions(
             dataset, num_partitions or engine.num_executors
         )
@@ -478,6 +522,87 @@ class TFModel(TFParams, *_MODEL_MIXINS):
         finally:
             if owns_engine:
                 engine.stop()
+
+    def _transform_native(self, engine, dataset, args):
+        """Distributed, lazy transform over an engine-native dataset.
+
+        The reference transforms a DataFrame with
+        ``df.rdd.mapPartitions(...)`` on the executors, lazily
+        (reference: pipeline.py:460-489), and the Scala path derives
+        the typed output schema from the model
+        (reference: TFModel.scala:294-335).  Matching that contract:
+
+        - rows NEVER transit the driver — the predictor loads (cached)
+          in each executor process and the result is a lazily-evaluated
+          DataFrame with the input's partitioning;
+        - the output schema comes from, in priority order:
+          ``args.output_schema`` (interchange list or struct string),
+          the export's ``metadata.json`` ``output_schema`` key (write
+          it via ``save_for_serving(extra_metadata=...)``), or an
+          executor-side one-row probe (a ``take(1)``-scale job — the
+          only evaluation transform itself triggers).
+        """
+        import json as _json
+        import os as _os
+
+        from tensorflowonspark_tpu.data import spark_io
+
+        builder = self.predictor_builder
+        if _is_spark_dataframe(dataset):
+            # ship only the predictor's input columns to the map — the
+            # driver-side twin of the reference's
+            # ``df.select(sorted(input_mapping))`` (pipeline.py:411-413)
+            dataset = dataset.select(*sorted(args.input_mapping))
+
+        def _mapfn(iterator, _args=args, _builder=builder):
+            rows = (
+                r.asDict(recursive=True) if hasattr(r, "asDict") else dict(r)
+                for r in iterator
+            )
+            for out in _run_model_iter(rows, _args, _builder):
+                yield out
+
+        out_rdd = engine.map_partitions_native(_mapfn, dataset)
+
+        schema = getattr(args, "output_schema", None)
+        if not schema:
+            meta_path = _os.path.join(args.export_dir, "metadata.json")
+            if _os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    schema = _json.load(f).get("output_schema")
+        if not schema:
+            probe = out_rdd.take(1)
+            if not probe:
+                raise ValueError(
+                    "cannot derive an output schema from an empty "
+                    "dataset; set args.output_schema or write "
+                    "output_schema into the export metadata"
+                )
+            schema = [
+                (name, _infer_output_type(probe[0][name]))
+                for name in sorted(probe[0])
+            ]
+        if isinstance(schema, str):
+            from tensorflowonspark_tpu.data import interchange
+
+            schema = interchange.parse_schema(schema)
+        schema = [tuple(f) for f in schema]
+        spark_schema = spark_io.to_spark_schema(schema)
+        cols = [name for name, _ in schema]
+
+        def _to_row(out, _cols=tuple(cols)):
+            return tuple(_py_value(out.get(c)) for c in _cols)
+
+        spark = dataset.sparkSession if hasattr(
+            dataset, "sparkSession"
+        ) else None
+        if spark is None:
+            from pyspark.sql import SparkSession
+
+            spark = SparkSession.builder.getOrCreate()
+        return spark.createDataFrame(
+            out_rdd.map(_to_row), schema=spark_schema
+        )
 
 
 #: Aliases matching the new framework's naming alongside reference parity

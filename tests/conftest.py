@@ -39,6 +39,13 @@ def pytest_configure(config):
         "spark: end-to-end tests against a real pyspark local-cluster "
         "(skipped when pyspark is not installed; CI runs them)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute suites (cluster e2e, kernels, multi-process "
+        "Gloo) — CI runs them in their own lane so the fast lane stays "
+        "under its wall-clock cap; locally: -m 'not slow' for the "
+        "quick signal, -m slow for the heavy one",
+    )
 
 
 def launch_two_workers(worker_src, tmp_path, extra_env=None, timeout=300):

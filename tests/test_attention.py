@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tensorflowonspark_tpu.ops.attention import attention, dot_attention
 from tensorflowonspark_tpu.ops.flash_attention import flash_attention
 from tensorflowonspark_tpu.ops.ring_attention import ring_attention_sharded

@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
 from tensorflowonspark_tpu.cluster.cluster import InputMode
 from tensorflowonspark_tpu.engine import LocalEngine

@@ -23,6 +23,8 @@ import time
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
 from tensorflowonspark_tpu.cluster import manager as mgr_mod
 from tensorflowonspark_tpu.cluster.cluster import InputMode

@@ -9,6 +9,10 @@ microbatches through ``pp.pipeline`` under ``shard_map``, and the
 result must equal applying all layers sequentially in one process.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from tests.conftest import launch_two_workers

@@ -9,6 +9,10 @@ one 4-device ``seq`` mesh, runs both sharded attentions on global
 arrays, and checks the results against single-process dense attention.
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from tests.conftest import launch_two_workers

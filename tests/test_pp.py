@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
+
+pytestmark = pytest.mark.slow
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
