@@ -1,0 +1,85 @@
+"""Isolated gmm kernel profile on the chip: fwd, dxt (stored-layout dx),
+old transposed-copy dx, tgmm (dw) — useful TFLOP/s each, at the moe
+bench's real shapes."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np, jax, jax.numpy as jnp
+from tensorflowonspark_tpu.ops import gmm
+
+E, D, M = 8, 1024, 4096
+N = 4 * 2048 * 2  # tokens*topk at the moe bench shape
+bm = 256
+T = N // bm
+rng = np.random.RandomState(0)
+key = jax.random.PRNGKey(0)
+x = jax.random.normal(key, (N, D), jnp.bfloat16)
+w = jax.random.normal(key, (E, D, M), jnp.bfloat16) * 0.02
+dy = jax.random.normal(key, (N, M), jnp.bfloat16)
+te = jnp.asarray(np.sort(rng.randint(0, E, T)).astype(np.int32))
+
+flops_fwd = 2 * N * D * M  # useful
+# slope method: time a 250-iteration and a 50-iteration chained-scan
+# program and divide the DIFFERENCE by 200 — the forcing scalar pull's
+# tunnel RTT (~100ms, same order as 100 kernel iterations!) and every
+# other constant overhead cancel exactly.  RTT-subtraction variants
+# read 205-327 TFLOP/s (over the 197 peak) because the RTT's run-to-run
+# variance exceeded the kernel time.
+N_LO, N_HI = 50, 250
+
+
+def timeit_scan(call, arg0):
+    def prog_of(n):
+        def body(s, _):
+            y = call(arg0 + s.astype(arg0.dtype))
+            return jnp.ravel(y)[0].astype(jnp.float32) * 0.0, None
+
+        return jax.jit(
+            lambda a0: jax.lax.scan(
+                body, jnp.float32(0), None, length=n
+            )[0]
+        )
+
+    p_lo, p_hi = prog_of(N_LO), prog_of(N_HI)
+    float(p_lo(arg0))  # compile + settle
+    float(p_hi(arg0))
+
+    def once(p):
+        t0 = time.perf_counter()
+        float(p(arg0))
+        return time.perf_counter() - t0
+
+    t_lo = min(once(p_lo) for _ in range(3))
+    t_hi = min(once(p_hi) for _ in range(3))
+    return max(1e-9, t_hi - t_lo) / (N_HI - N_LO)
+
+
+out = {}
+dt = timeit_scan(lambda a: gmm.gmm_call(a, w, te, bm=bm), x)
+out["fwd_tflops"] = round(flops_fwd / dt / 1e12, 1)
+
+dt = timeit_scan(lambda a: gmm.gmm_dxt_call(a, w, te, bm=bm), dy)
+out["dx_stored_layout_tflops"] = round(flops_fwd / dt / 1e12, 1)
+
+dt = timeit_scan(
+    lambda a: gmm.gmm_call(a, jnp.swapaxes(w, 1, 2), te, bm=bm), dy
+)
+out["dx_transposed_copy_tflops"] = round(flops_fwd / dt / 1e12, 1)
+
+dt = timeit_scan(lambda a: gmm.tgmm_call(a, dy, te, E, bm=bm), x)
+out["dw_tgmm_tflops"] = round(flops_fwd / dt / 1e12, 1)
+
+# whole registered backward (dx + dw) with the COTANGENT chained —
+# chaining x instead would leave dx loop-invariant and XLA hoists it
+# out of the scan (measured "600 TFLOP/s")
+_, vjp_fn = jax.vjp(
+    lambda xx, ww: gmm.grouped_matmul(xx, ww, te, bm), x, w
+)
+dt = timeit_scan(lambda a: vjp_fn(a)[0], dy)
+out["bwd_dx_plus_dw_tflops"] = round(2 * flops_fwd / dt / 1e12, 1)
+out["shapes"] = "E%d D%d M%d N%d bm%d" % (E, D, M, N, bm)
+print(json.dumps(out))
