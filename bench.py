@@ -601,10 +601,10 @@ def serving_tpu_bench():
     marshalling-only ceiling is the serving_cpu row)."""
     out = {}
     out["mnist"] = with_retry(
-        lambda: serving_bench(rows_n=16384, batch_size=128)
+        lambda: serving_bench(rows_n=8192, batch_size=128)
     )
     out["resnet50"] = with_retry(
-        lambda: serving_bench(rows_n=1024, batch_size=64, model="resnet50")
+        lambda: serving_bench(rows_n=512, batch_size=64, model="resnet50")
     )
     return out
 

@@ -9,6 +9,8 @@ synthetic 1-step tests, reference: resnet_cifar_test.py:36-40).
 
 import os
 import subprocess
+
+import pytest
 import sys
 
 import numpy as np
@@ -73,3 +75,29 @@ def test_synthetic_tokens_learnable_and_deterministic():
     assert t1.shape == (4, 16)
     # the stream is exactly learnable: next = (cur + 1) % vocab
     np.testing.assert_array_equal((t1[:, :-1] + 1) % 64, t1[:, 1:])
+
+
+@pytest.mark.slow
+def test_serve_generate_example_cli():
+    # the ragged-generation serving app end to end (tiny model, CPU):
+    # export -> load_predictor -> ragged predict_rows -> per-row output
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(
+                _EXAMPLES, "transformer", "serve_generate_tpu.py"
+            ),
+            "--num_requests", "4", "--max_new_tokens", "4",
+            "--num_layers", "2", "--embed_dim", "32", "--mlp_dim", "64",
+            "--head_dim", "8", "--max_seq_len", "128",
+            "--max_prompt", "20", "--quantize", "int8",
+        ],
+        check=True,
+        timeout=300,
+        capture_output=True,
+        text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("req")]
+    assert len(lines) == 4, proc.stdout
+    assert "4 ragged requests" in proc.stdout

@@ -155,3 +155,190 @@ def test_estimator_fit_then_model_transform(tmp_path):
     assert preds[0] == pytest.approx(4.758, abs=0.15)
     assert preds[1] == pytest.approx(6.28, abs=0.2)
     assert preds[2] == pytest.approx(1.618, abs=0.15)
+
+
+# --- lazy executor-side transform on a duck-typed native dataset -------
+# (the real-Spark twin lives in tests/test_spark_real.py -m spark; this
+# exercises _transform_native's flow — laziness, schema priority, row
+# conversion — without pyspark, like tests/test_engine_spark.py)
+
+
+class _FakeRow(dict):
+    def asDict(self, recursive=False):
+        return dict(self)
+
+
+class _LazyRDD:
+    """Partitioned fake RDD tracking which partitions were computed."""
+
+    def __init__(self, parts, log):
+        self._parts = parts
+        self._log = log
+        self._stages = []
+
+    def mapPartitions(self, fn):
+        child = _LazyRDD(self._parts, self._log)
+        child._stages = self._stages + [("mapPartitions", fn)]
+        return child
+
+    def map(self, f):
+        child = _LazyRDD(self._parts, self._log)
+        child._stages = self._stages + [("map", f)]
+        return child
+
+    def _compute(self, idx):
+        self._log.append(idx)
+        rows = iter(self._parts[idx])
+        for kind, f in self._stages:
+            rows = f(rows) if kind == "mapPartitions" else map(f, rows)
+        return list(rows)
+
+    def take(self, n):
+        out = []
+        for i in range(len(self._parts)):
+            out.extend(self._compute(i))
+            if len(out) >= n:
+                break
+        return out[:n]
+
+    def collect(self):
+        return [
+            r for i in range(len(self._parts)) for r in self._compute(i)
+        ]
+
+    def getNumPartitions(self):
+        return len(self._parts)
+
+
+class _FakeDataFrame:
+    def __init__(self, parts, log):
+        self.rdd = _LazyRDD(parts, log)
+        self.sparkSession = _FakeSession()
+
+    def select(self, *cols):
+        return self  # rows already carry only the selected columns
+
+
+class _FakeResultDF:
+    def __init__(self, rdd, schema):
+        self.rdd, self.schema = rdd, schema
+
+    def collect(self):
+        return self.rdd.collect()
+
+
+class _FakeSession:
+    def createDataFrame(self, rdd, schema=None):
+        return _FakeResultDF(rdd, schema)
+
+
+class _FakeNativeEngine:
+    """LocalEngine-shaped engine that treats _FakeDataFrame as native."""
+
+    num_executors = 2
+
+    def is_native_dataset(self, dataset):
+        return isinstance(dataset, _FakeDataFrame)
+
+    def map_partitions_native(self, fn, dataset):
+        return dataset.rdd.mapPartitions(fn)
+
+
+@pytest.fixture
+def _linear_export(tmp_path):
+    from tensorflowonspark_tpu.checkpoint import save_for_serving
+
+    export = str(tmp_path / "export")
+    save_for_serving(
+        export,
+        {"w": np.asarray(W_TRUE), "b": np.zeros((), np.float32)},
+        extra_metadata={
+            "model_ref":
+                "tensorflowonspark_tpu.models.linear:serving_builder",
+            "model_config": {"input_name": "features"},
+        },
+    )
+    return export
+
+
+def _mk_model(export, extra_args=None, monkeypatch=None):
+    # to_spark_schema needs pyspark; the flow under test doesn't —
+    # substitute an identity so the fake session records the schema
+    from tensorflowonspark_tpu.data import spark_io
+
+    monkeypatch.setattr(spark_io, "to_spark_schema", lambda s: s)
+    m = (
+        TFModel(dict(extra_args or {}))
+        .setExportDir(export)
+        .setInputMapping({"x": "features"})
+        .setOutputMapping({"prediction": "pred"})
+    )
+    m.engine = _FakeNativeEngine()
+    return m
+
+
+def _parts(n_parts=3, rows_per=4):
+    vals, parts = [], []
+    i = 0
+    for p in range(n_parts):
+        part = []
+        for _ in range(rows_per):
+            v = [float(i), float(i % 3)]
+            part.append(_FakeRow(x=v))
+            vals.append(v)
+            i += 1
+        parts.append(part)
+    return parts, vals
+
+
+def test_transform_native_lazy_with_explicit_schema(monkeypatch, tmp_path, _linear_export):
+    parts, vals = _parts()
+    log = []
+    df = _FakeDataFrame(parts, log)
+    m = _mk_model(
+        _linear_export, {"output_schema": [("pred", "float")]},
+        monkeypatch=monkeypatch,
+    )
+    out = m.transform(df)
+    # fully lazy: NO partition computed at transform() time
+    assert log == [], "explicit schema must not trigger evaluation"
+    assert out.schema == [("pred", "float")]
+    assert out.rdd.getNumPartitions() == len(parts)
+    got = [r[0] for r in out.collect()]
+    want = [float(np.dot(v, W_TRUE)) for v in vals]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # every partition computed exactly once, in place
+    assert sorted(log) == list(range(len(parts)))
+
+
+def test_transform_native_schema_from_export_metadata(monkeypatch, tmp_path, _linear_export):
+    import json
+
+    meta_path = f"{_linear_export}/metadata.json"
+    with open(meta_path) as f:
+        meta = json.load(f)
+    meta["output_schema"] = [["pred", "float"]]
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    parts, vals = _parts(2, 3)
+    log = []
+    m = _mk_model(_linear_export, monkeypatch=monkeypatch)
+    out = m.transform(_FakeDataFrame(parts, log))
+    assert log == []  # metadata schema: still no evaluation
+    assert [tuple(f) for f in out.schema] == [("pred", "float")]
+    got = [r[0] for r in out.collect()]
+    np.testing.assert_allclose(
+        sorted(got), sorted(float(np.dot(v, W_TRUE)) for v in vals),
+        rtol=1e-5,
+    )
+
+
+def test_transform_native_probe_evaluates_one_partition(monkeypatch, tmp_path, _linear_export):
+    parts, vals = _parts(3, 2)
+    log = []
+    m = _mk_model(_linear_export, monkeypatch=monkeypatch)
+    out = m.transform(_FakeDataFrame(parts, log))
+    # no schema anywhere: transform probes ONE row executor-side — only
+    # the first partition computes
+    assert log == [0]
+    assert [tuple(f) for f in out.schema] == [("pred", "float")]
