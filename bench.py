@@ -598,7 +598,13 @@ def serving_tpu_bench():
     predict_rows path with the jitted batch program on the chip.  Runs
     in the chip-owning process; per-batch numbers include the tunneled
     dispatch RTT, which dominates small models — reported as-is (the
-    marshalling-only ceiling is the serving_cpu row)."""
+    marshalling-only ceiling is the serving_cpu row).
+
+    MEASUREMENT-CONDITION NOTE (r5): rows_n halved vs the r4 rows
+    (mnist 16384 -> 8192, resnet50 1024 -> 512) to fit the record's
+    wall budget.  rows/s amortizes fixed per-run overhead over rows_n,
+    so r5 serving_tpu numbers are not 1:1 comparable with r4's — the
+    r4 conditions are preserved in BASELINE.md's row."""
     out = {}
     out["mnist"] = with_retry(
         lambda: serving_bench(rows_n=8192, batch_size=128)
@@ -1667,7 +1673,17 @@ def main(model_name="resnet50", with_feed=True):
 
     aux_proc = start_aux_bench() if with_feed else None
     if with_feed:
+        # spark_feed is a REQUIRED record key: one transient subprocess
+        # failure must not drop it.  Retry only FAST failures (a crash,
+        # not a timeout): a hung first attempt already burned its
+        # subprocess timeout, and a second hang would starve the
+        # required compute rows of the remaining budget.
+        t_feed = time.monotonic()
         feed = run_feed_bench()
+        feed_elapsed = time.monotonic() - t_feed
+        if not feed and feed_elapsed < 120 and _remaining() > 240:
+            print("feed bench failed fast; retrying once", file=sys.stderr)
+            feed = run_feed_bench()
         if feed:
             out["spark_feed"] = feed
             emit()

@@ -9,9 +9,9 @@ synthetic 1-step tests, reference: resnet_cifar_test.py:36-40).
 
 import os
 import subprocess
+import sys
 
 import pytest
-import sys
 
 import numpy as np
 

@@ -261,7 +261,7 @@ def _linear_export(tmp_path):
     return export
 
 
-def _mk_model(export, extra_args=None, monkeypatch=None):
+def _mk_model(export, monkeypatch, extra_args=None):
     # to_spark_schema needs pyspark; the flow under test doesn't —
     # substitute an identity so the fake session records the schema
     from tensorflowonspark_tpu.data import spark_io
@@ -296,8 +296,8 @@ def test_transform_native_lazy_with_explicit_schema(monkeypatch, tmp_path, _line
     log = []
     df = _FakeDataFrame(parts, log)
     m = _mk_model(
-        _linear_export, {"output_schema": [("pred", "float")]},
-        monkeypatch=monkeypatch,
+        _linear_export, monkeypatch,
+        extra_args={"output_schema": [("pred", "float")]},
     )
     out = m.transform(df)
     # fully lazy: NO partition computed at transform() time
@@ -322,7 +322,7 @@ def test_transform_native_schema_from_export_metadata(monkeypatch, tmp_path, _li
         json.dump(meta, f)
     parts, vals = _parts(2, 3)
     log = []
-    m = _mk_model(_linear_export, monkeypatch=monkeypatch)
+    m = _mk_model(_linear_export, monkeypatch)
     out = m.transform(_FakeDataFrame(parts, log))
     assert log == []  # metadata schema: still no evaluation
     assert [tuple(f) for f in out.schema] == [("pred", "float")]
@@ -336,7 +336,7 @@ def test_transform_native_schema_from_export_metadata(monkeypatch, tmp_path, _li
 def test_transform_native_probe_evaluates_one_partition(monkeypatch, tmp_path, _linear_export):
     parts, vals = _parts(3, 2)
     log = []
-    m = _mk_model(_linear_export, monkeypatch=monkeypatch)
+    m = _mk_model(_linear_export, monkeypatch)
     out = m.transform(_FakeDataFrame(parts, log))
     # no schema anywhere: transform probes ONE row executor-side — only
     # the first partition computes
