@@ -21,14 +21,155 @@ Design changes for the TPU build:
   + process ranks), replacing TF_CONFIG.
 """
 
+import itertools
 import logging
 import threading
 import time
 import uuid
 
 from tensorflowonspark_tpu.cluster import manager, node, reservation
+from tensorflowonspark_tpu.cluster.marker import PartitionStart
 
 logger = logging.getLogger(__name__)
+
+
+class DeadExecutorError(RuntimeError):
+    """A cluster node was declared dead by the heartbeat liveness plane.
+
+    Raised from the driver's feed loop within seconds of the death (the
+    reference's only signal was the 600s feed timeout).  The message
+    names the executor id, host, and diagnosis; ``executor_id`` carries
+    the id programmatically."""
+
+    def __init__(self, message, executor_id=None):
+        super(DeadExecutorError, self).__init__(message)
+        self.executor_id = executor_id
+
+
+class ClusterMonitor(object):
+    """Driver-side liveness watcher over the rendezvous server's
+    heartbeat registry.
+
+    Polls ``server.liveness`` (in-process — the server lives on the
+    driver) every half heartbeat-interval:
+
+    - ``elastic=False``: the first dead executor becomes a permanent
+      failure; :meth:`check` raises :class:`DeadExecutorError` naming
+      the node, enriched with the node's error-queue traceback when one
+      is reachable.
+    - ``elastic=True``: a death opens a recovery window
+      (``recovery_timeout`` seconds).  A generation bump or resumed
+      beats close it (counted in ``restart_events`` — the feed loop's
+      cue to requeue uncommitted partitions); an executor still dead
+      past the window becomes a permanent failure.
+    """
+
+    def __init__(self, server, cluster_info, elastic=False,
+                 recovery_timeout=120.0, error_peek=None):
+        self.server = server
+        self.cluster_info = cluster_info
+        self.elastic = bool(elastic)
+        self.recovery_timeout = float(recovery_timeout)
+        self.error = None
+        self.dead_executor_id = None
+        #: total per-executor generation bumps observed (monotonic)
+        self.restart_events = 0
+        self._by_id = {n["executor_id"]: n for n in cluster_info}
+        self._first_dead = {}
+        self._known_gen = {}
+        self._error_peek = error_peek  # fn(node_meta) -> str | None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="cluster-monitor"
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def interval(self):
+        return self.server.liveness.interval
+
+    def _run(self):
+        while not self._stop.wait(self.interval / 2.0):
+            try:
+                self._poll()
+            except Exception:  # noqa: BLE001 - monitor must not die quiet
+                logger.warning("cluster monitor poll failed", exc_info=True)
+            if self.error is not None:
+                return
+
+    def _poll(self):
+        snapshot = self.server.liveness.snapshot()
+        for eid_s, rec in snapshot.items():
+            eid = int(eid_s)
+            known = self._known_gen.get(eid, 0)
+            if rec["generation"] > known:
+                self.restart_events += rec["generation"] - known
+                self._known_gen[eid] = rec["generation"]
+                logger.info(
+                    "monitor: executor %d reborn at generation %d",
+                    eid, rec["generation"],
+                )
+        dead = self.server.liveness.dead()
+        now = time.monotonic()
+        for eid in list(self._first_dead):
+            if eid not in dead:
+                logger.info("monitor: executor %d recovered", eid)
+                self._first_dead.pop(eid)
+        for eid, diag in dead.items():
+            if not self.elastic:
+                self._fail(eid, diag)
+                return
+            first = self._first_dead.setdefault(eid, now)
+            if now - first > self.recovery_timeout:
+                diag = dict(
+                    diag,
+                    reason="{0}; no recovery within the {1:.0f}s elastic "
+                    "window".format(diag["reason"], self.recovery_timeout),
+                )
+                self._fail(eid, diag)
+                return
+
+    def _fail(self, eid, diag):
+        node_meta = self._by_id.get(eid, {})
+        msg = (
+            "executor {0} (host {1}, {2}:{3}) declared dead: {4} "
+            "[last heartbeat {5:.1f}s ago, generation {6}]".format(
+                eid,
+                diag.get("host") or node_meta.get("host", "?"),
+                node_meta.get("job_name", "?"),
+                node_meta.get("task_index", "?"),
+                diag["reason"],
+                diag["age"],
+                diag.get("generation", 0),
+            )
+        )
+        # enrich with the node's own traceback when reachable — the
+        # user should see WHY it died, not just THAT it died
+        if self._error_peek is not None and node_meta:
+            try:
+                err = self._error_peek(node_meta)
+            except Exception:  # noqa: BLE001 - node likely unreachable
+                err = None
+            if err:
+                msg += "\nlast error from executor {0}:\n{1}".format(eid, err)
+        logger.error("cluster monitor: %s", msg)
+        self.error = msg
+        self.dead_executor_id = eid
+
+    def check(self):
+        """Raise :class:`DeadExecutorError` if a permanent failure was
+        detected; no-op otherwise.  Feed loops call this every poll."""
+        if self.error is not None:
+            raise DeadExecutorError(self.error, self.dead_executor_id)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
 
 
 class InputMode(object):
@@ -73,6 +214,7 @@ class TPUCluster(object):
         queues,
         owns_engine=False,
         driver_ps=(),
+        monitor=None,
     ):
         self.engine = engine
         self.cluster_meta = cluster_meta
@@ -84,6 +226,9 @@ class TPUCluster(object):
         self._owns_engine = owns_engine
         self._driver_ps = list(driver_ps)
         self.cluster_id = cluster_meta["id"]
+        self.elastic = bool(cluster_meta.get("elastic", False))
+        #: liveness watcher (started by run(); None in bare-handle tests)
+        self.monitor = monitor
 
     # -- data plane ----------------------------------------------------
 
@@ -112,9 +257,14 @@ class TPUCluster(object):
             self.cluster_info, self.cluster_meta, feed_timeout, qname
         )
         if self.engine.is_native_dataset(data):
+            # native datasets are fed in place by the engine; the
+            # partition-requeue path needs driver-held partitions, so
+            # elastic recovery here relies on the engine's own task
+            # retries + checkpoint resume (documented limitation)
             logger.info("feeding native dataset x %d epochs", num_epochs)
             for _ in range(num_epochs):
                 self.engine.run_data_job(feed_fn, data)
+                self._check_monitor()
             return
         # normalize once so generators of partitions and one-shot
         # iterator partitions survive multi-epoch re-feeding (callables
@@ -123,8 +273,130 @@ class TPUCluster(object):
         logger.info(
             "feeding %d partitions x %d epochs", len(data), num_epochs
         )
-        for _ in range(num_epochs):
-            self.engine.run_job(feed_fn, data)
+        for epoch in range(num_epochs):
+            if self.elastic:
+                self._feed_epoch_elastic(feed_fn, data, epoch, feed_timeout)
+            else:
+                self._run_feed_monitored(feed_fn, data)
+
+    # -- fault-tolerant feeding ---------------------------------------
+
+    def _check_monitor(self):
+        if self.monitor is not None:
+            self.monitor.check()
+
+    def _run_feed_monitored(self, feed_fn, partitions):
+        """Run one feed job while watching the liveness plane: a dead
+        executor fails the feed in seconds (with a diagnosis naming the
+        node) instead of wedging until feed_timeout."""
+        if self.monitor is None:
+            self.engine.run_job(feed_fn, partitions)
+            return
+        handle = self.engine.run_job_async(feed_fn, partitions)
+        while not handle.done():
+            self.monitor.check()
+            time.sleep(min(0.2, self.monitor.interval / 2.0))
+        handle.wait(timeout=0)
+
+    def _feed_epoch_elastic(self, feed_fn, partitions, epoch, feed_timeout):
+        """Feed one epoch with partition requeue: every partition leads
+        with a PartitionStart marker feeding the per-node ledger; after
+        a restart event, partitions not committed by a checkpoint are
+        fed again (at-least-once — see docs/fault_tolerance.md)."""
+        pending = {
+            "e{0}p{1}".format(epoch, i): p
+            for i, p in enumerate(partitions)
+        }
+        seen_restarts = (
+            self.monitor.restart_events if self.monitor is not None else 0
+        )
+        max_rounds = 1 + int(self.cluster_meta.get("max_restarts", 3))
+        for round_no in range(max_rounds):
+            if round_no:
+                logger.warning(
+                    "elastic requeue round %d: re-feeding %d "
+                    "uncommitted partition(s): %s",
+                    round_no, len(pending), sorted(pending),
+                )
+            wrapped = [
+                _with_partition_marker(pid, p)
+                for pid, p in sorted(pending.items())
+            ]
+            handle = self.engine.run_job_async(feed_fn, wrapped)
+            while not handle.done():
+                self._check_monitor()
+                time.sleep(0.2)
+            try:
+                handle.wait(timeout=0)
+            except RuntimeError:
+                # a feed task died mid-restart (e.g. it saw the dead
+                # incarnation's error queue); if a rebirth explains it,
+                # the requeue below re-feeds — otherwise it's real
+                if self.monitor is None:
+                    raise
+                if not self._await_restart_signal(seen_restarts):
+                    raise
+                logger.warning(
+                    "feed job failed during an elastic restart; "
+                    "requeuing uncommitted partitions", exc_info=True,
+                )
+            committed = self._ledger_committed()
+            pending = {
+                pid: p for pid, p in pending.items() if pid not in committed
+            }
+            if not pending:
+                return
+            # a rebirth releases blocked feeders BEFORE it re-registers
+            # under the new generation, so the feed round can complete
+            # a beat ahead of the restart signal — settle briefly before
+            # concluding nothing happened (concluding wrongly would skip
+            # the requeue and silently drop the reset partitions)
+            if not self._await_restart_signal(seen_restarts):
+                logger.info(
+                    "epoch %d: %d partition(s) delivered but not yet "
+                    "checkpoint-committed (no restart occurred)",
+                    epoch, len(pending),
+                )
+                return
+            seen_restarts = self.monitor.restart_events
+        logger.warning(
+            "elastic requeue budget exhausted with %d partition(s) "
+            "still uncommitted: %s", len(pending), sorted(pending),
+        )
+
+    def _await_restart_signal(self, seen_restarts, window=None):
+        """True if a restart event beyond ``seen_restarts`` surfaces
+        within the settle window; re-raises via check() if the monitor
+        declared a permanent failure meanwhile."""
+        if self.monitor is None:
+            return False
+        window = (
+            max(2.0, 4.0 * self.monitor.interval) if window is None else window
+        )
+        deadline = time.monotonic() + window
+        while True:
+            self.monitor.check()
+            if self.monitor.restart_events > seen_restarts:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.1)
+
+    def _ledger_committed(self):
+        """Union of checkpoint-committed partition ids across workers."""
+        committed = set()
+        for n in self.cluster_info:
+            if n["job_name"] not in ("worker", "chief", "master"):
+                continue
+            try:
+                m = self._connect(n)
+                committed.update(m.ledger("committed")._getvalue())
+            except Exception:  # noqa: BLE001 - node mid-restart: its
+                logger.warning(  # partitions simply stay pending
+                    "unable to read partition ledger of executor %d",
+                    n["executor_id"], exc_info=True,
+                )
+        return committed
 
     def train_stream(self, batches, feed_timeout=600, qname="input"):
         """Feed an unbounded stream of partition micro-batches.
@@ -248,6 +520,8 @@ class TPUCluster(object):
             SIGALRM guard (reference: TFCluster.py:136-144).
         """
         deadline = time.monotonic() + timeout
+        if self.monitor is not None:
+            self.monitor.stop()
         workers = [
             n
             for n in self.cluster_info
@@ -278,7 +552,11 @@ class TPUCluster(object):
                     try:
                         m.get_queue(qname).put(None, block=True)
                     except Exception:  # noqa: BLE001 - role may lack queue
-                        pass
+                        logger.warning(
+                            "unable to post end-of-feed sentinel on "
+                            "queue %r of executor %d",
+                            qname, w["executor_id"], exc_info=True,
+                        )
             # Wait for each worker's compute process to report completion
             # ('compute_state' set by _compute_process_main) instead of the
             # reference's blind grace_secs sleep (TFCluster.py:125):
@@ -309,9 +587,11 @@ class TPUCluster(object):
                 m.get_queue("control").put(None, block=True)
             except Exception:  # noqa: BLE001 - node may be gone already
                 logger.warning(
-                    "unable to post shutdown to %s:%d",
+                    "unable to post shutdown to %s:%d (executor %d)",
                     s["job_name"],
                     s["task_index"],
+                    s["executor_id"],
+                    exc_info=True,
                 )
 
         # the start job completes once every foreground task returns
@@ -327,8 +607,11 @@ class TPUCluster(object):
         for w in workers:
             try:
                 self._connect(w).set("state", "stopped")
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception:  # noqa: BLE001 - node gone: state moot, but
+                logger.warning(  # the diagnosis must not vanish with it
+                    "unable to mark executor %d stopped during shutdown",
+                    w["executor_id"], exc_info=True,
+                )
 
         for shard in self._driver_ps:
             shard.stop()
@@ -409,7 +692,14 @@ class TPUCluster(object):
             return err
         except _queue_mod.Empty:
             return None
-        except Exception:  # noqa: BLE001 - unreachable node: no error to report
+        except Exception:  # noqa: BLE001 - unreachable node: no error to
+            logger.warning(  # report, but say WHICH node was unreachable
+                "unable to check error queue of executor %d (%s:%d)",
+                node_meta["executor_id"],
+                node_meta["job_name"],
+                node_meta["task_index"],
+                exc_info=True,
+            )
             return None
 
     def _stop_tensorboard(self):
@@ -448,6 +738,17 @@ class TPUCluster(object):
         return coordinator
 
 
+def _with_partition_marker(pid, partition):
+    """Prefix a partition with its PartitionStart marker (lazily for
+    callable partitions — the rows still never transit the driver)."""
+    if callable(partition):
+        def gen():
+            return itertools.chain([PartitionStart(pid)], iter(partition()))
+
+        return gen
+    return [PartitionStart(pid)] + list(partition)
+
+
 def run(
     engine,
     map_fun,
@@ -464,6 +765,10 @@ def run(
     eval_node=False,
     num_chips_per_node=None,
     name="tpucluster",
+    elastic=False,
+    max_restarts=3,
+    heartbeat_interval=None,
+    recovery_timeout=120.0,
 ):
     """Start a cluster over an executor fleet (reference: TFCluster.py:215-383).
 
@@ -493,6 +798,21 @@ def run(
         (reference: TFCluster.py:236).
       num_chips_per_node: TPU chips visible per node (replaces the
         reference's ``num_gpus``-via-resources allocation).
+      elastic: treat worker death as a recoverable event: the node's
+        supervisor respawns the compute process under a new rendezvous
+        generation, survivors park/respawn at the re-rendezvous barrier,
+        training resumes from the last complete checkpoint (the
+        ``train_on_feed(checkpointer=...)`` hook), and uncommitted feed
+        partitions are requeued.  Default False: a dead worker fails
+        the feed fast with a diagnosis naming the node (still a huge
+        improvement over the reference's 600s feed-timeout silence).
+        See docs/fault_tolerance.md.
+      max_restarts: per-node restart budget under ``elastic``.
+      heartbeat_interval: seconds between node heartbeats (default
+        ``reservation.HEARTBEAT_INTERVAL``; liveness declares a node
+        dead after 3 missed intervals).
+      recovery_timeout: under ``elastic``, seconds a dead node may take
+        to come back before the failure is permanent.
     """
     from tensorflowonspark_tpu.engine import Engine, LocalEngine, SparkEngine
 
@@ -575,7 +895,9 @@ def run(
             driver_ps_addrs.append("{0}:{1}".format(host, port))
         logger.info("driver-hosted ps shards at %s", driver_ps_addrs)
 
-    server = reservation.Server(num_executors)
+    server = reservation.Server(
+        num_executors, heartbeat_interval=heartbeat_interval
+    )
     server_addr = server.start()
 
     cluster_meta = {
@@ -588,6 +910,9 @@ def run(
         "queues": list(queues),
         "num_chips_per_node": num_chips_per_node,
         "driver_ps_addrs": driver_ps_addrs,
+        "elastic": bool(elastic),
+        "max_restarts": int(max_restarts),
+        "heartbeat_interval": heartbeat_interval,
     }
 
     # async start job: one blocking task per executor
@@ -640,6 +965,13 @@ def run(
         owns_engine=owns_engine,
         driver_ps=driver_ps,
     )
+    cluster.monitor = ClusterMonitor(
+        server,
+        cluster_info,
+        elastic=elastic,
+        recovery_timeout=recovery_timeout,
+        error_peek=cluster._peek_error,
+    ).start()
     if tensorboard:
         url = cluster.tensorboard_url()
         if url:

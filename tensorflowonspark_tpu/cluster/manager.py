@@ -59,6 +59,89 @@ class _KVStore(object):
             return dict(self._data)
 
 
+class PartitionLedger(object):
+    """Per-node feed-partition ledger: the at-least-once delivery record
+    the elastic restart path relies on (no reference analogue — the
+    reference silently lost any data a dead worker had consumed).
+
+    States per partition id:
+
+    - ``inflight``  — a feeder called ``begin``: rows are entering the
+      node's input queue;
+    - ``delivered`` — the feeder's ``queue.join()`` completed: every row
+      reached the compute process, but is only as durable as that
+      process;
+    - ``committed`` — the compute process checkpointed *after* consuming
+      the partition (``commit`` promotes all delivered partitions), so a
+      restart resuming from that checkpoint never needs it again.
+
+    On worker death the driver requeues every partition not committed —
+    some rows may be trained twice (at-least-once), but none are
+    silently dropped.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}  # pid -> 'inflight' | 'delivered' | 'committed'
+
+    def op(self, name, arg=None):
+        """Single proxied entry point (BaseManager registration stays a
+        one-liner and client stubs need no per-method knowledge)."""
+        with self._lock:
+            if name == "begin":
+                self._state[arg] = "inflight"
+                return None
+            if name == "deliver":
+                if self._state.get(arg) == "inflight":
+                    self._state[arg] = "delivered"
+                return None
+            if name == "commit":
+                promoted = [
+                    pid for pid, st in self._state.items()
+                    if st == "delivered"
+                ]
+                for pid in promoted:
+                    self._state[pid] = "committed"
+                return len(promoted)
+            if name == "pending":
+                return sorted(
+                    pid for pid, st in self._state.items()
+                    if st != "committed"
+                )
+            if name == "committed":
+                return sorted(
+                    pid for pid, st in self._state.items()
+                    if st == "committed"
+                )
+            if name == "snapshot":
+                return dict(self._state)
+            raise ValueError("unknown ledger op {0!r}".format(name))
+
+
+def _reset_joinable_queue(q):
+    """Drain a JoinableQueue AND zero its unfinished-task count, so
+    ``join()`` callers blocked on items a *dead consumer* popped (it can
+    never call ``task_done`` again) are released.  Runs inside the
+    manager server process via the registered ``reset_queue`` callable —
+    the JoinableQueue's semaphores are shared with the creating process,
+    so the effect is cluster-wide."""
+    discarded = 0
+    while True:
+        try:
+            q.get(block=False)
+            discarded += 1
+        except _queue_mod.Empty:
+            break
+    # zero the unfinished counter: one task_done per get() above, plus
+    # one per item the dead consumer removed without acknowledging
+    while True:
+        try:
+            q.task_done()
+        except ValueError:
+            break
+    return discarded
+
+
 class QueueManager(BaseManager):
     """Named JoinableQueues + kv state shared across processes
     (reference: TFManager.py:14-17)."""
@@ -79,6 +162,7 @@ def start(authkey, queue_names, mode="local"):
     """
     qdict = {}
     kv = _KVStore()
+    ledger = PartitionLedger()
     for name in queue_names:
         qdict[name] = multiprocessing.JoinableQueue()
 
@@ -86,6 +170,13 @@ def start(authkey, queue_names, mode="local"):
     QueueManager.register("get_queue", callable=lambda qname: qdict[qname])
     QueueManager.register("get", callable=lambda key: kv.get(key))
     QueueManager.register("set", callable=lambda key, value: kv.set(key, value))
+    QueueManager.register(
+        "ledger", callable=lambda op, arg=None: ledger.op(op, arg)
+    )
+    QueueManager.register(
+        "reset_queue",
+        callable=lambda qname: _reset_joinable_queue(qdict[qname]),
+    )
 
     if mode == "remote":
         addr = ("", 0)
@@ -111,6 +202,8 @@ def connect(address, authkey):
     QueueManager.register("get_queue")
     QueueManager.register("get")
     QueueManager.register("set")
+    QueueManager.register("ledger")
+    QueueManager.register("reset_queue")
     m = QueueManager(address=tuple(address), authkey=authkey)
     m.connect()
     return m

@@ -14,6 +14,20 @@ class EndPartition(Marker):
     """Marks the end of one input partition within the feed stream."""
 
 
+class PartitionStart(Marker):
+    """First element of an elastic feed partition: carries the driver's
+    partition id so the feeder can open a :class:`PartitionLedger`
+    record before any row ships.  Stripped by the feeder — it never
+    enters the node's input queue (no reference analogue; the elastic
+    requeue path needs partition identity, the plain path doesn't pay
+    for it)."""
+
+    __slots__ = ("pid",)
+
+    def __init__(self, pid):
+        self.pid = pid
+
+
 class Block(Marker):
     """A batch of feed items shipped as ONE queue element.
 

@@ -46,6 +46,7 @@ from tensorflowonspark_tpu.cluster.marker import (
     Block,
     ColumnarBlock,
     EndPartition,
+    PartitionStart,
     encode_columnar_parts,
     encode_rows_parts,
     pack_columnar,
@@ -86,6 +87,7 @@ class NodeContext(object):
         device_info=None,
         manager_addr=None,
         manager_authkey=None,
+        generation=0,
     ):
         self.executor_id = executor_id
         self.job_name = job_name
@@ -105,6 +107,12 @@ class NodeContext(object):
         #: undefined behavior, so we spawn and reconnect instead).
         self.manager_addr = manager_addr
         self.manager_authkey = manager_authkey
+        #: elastic re-rendezvous generation: 0 on the first launch, N
+        #: after the Nth supervised restart — user code can log it or
+        #: branch on "am I a restart" (checkpoint auto-resume needs
+        #: neither: ``train_on_feed(checkpointer=...)`` restores
+        #: whenever a checkpoint exists).
+        self.generation = generation
         self.num_workers = sum(
             len(v)
             for k, v in self.cluster_spec.items()
@@ -357,9 +365,20 @@ def _compute_process_main(fn_bytes, args, ctx):
     except ImportError:  # pragma: no cover
         import pickle as _cp
 
+    from tensorflowonspark_tpu.utils.retry import retry_call
+
     authkey = bytes.fromhex(ctx.manager_authkey)
     multiprocessing.current_process().authkey = authkey
-    ctx.mgr = manager.connect(tuple(ctx.manager_addr), authkey)
+    # a freshly spawned (or supervisor-respawned) compute process can
+    # race its executor's manager: backoff briefly instead of dying on
+    # one refused connect
+    ctx.mgr = retry_call(
+        lambda: manager.connect(tuple(ctx.manager_addr), authkey),
+        "connect to node manager at {0}".format(tuple(ctx.manager_addr)),
+        exceptions=(OSError, EOFError),
+        deadline=30.0,
+        base=0.1,
+    )
     try:
         fn = _cp.loads(fn_bytes)
         fn(args, ctx)
@@ -434,6 +453,14 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
                 # legitimate retry — start fresh.
                 state = "dead"
             if state == "running":
+                # Still a poison-fail — but under elastic this is now
+                # the rare true-duplicate case only: a retry after the
+                # node died finds a dead manager and starts fresh
+                # (above), and an in-place compute death never fails
+                # the start task at all — the Supervisor respawns the
+                # compute process locally (cluster/supervisor.py),
+                # which is what replaced the reference's
+                # always-poison-the-retry recovery story.
                 raise RuntimeError(
                     "TFOS node already running on executor {0}; "
                     "duplicate start task".format(executor_id)
@@ -616,18 +643,19 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
             except ImportError:  # pragma: no cover
                 import pickle as _cp
 
-            # The compute process owns the TPU chips; exactly one per
-            # node (SURVEY.md §7 'Spark process model vs TPU ownership').
-            proc = multiprocessing.get_context("spawn").Process(
-                target=_compute_process_main,
-                args=(_cp.dumps(fn), args, ctx),
-                daemon=True,
-                name="compute-%s-%d" % (job_name, task_index),
-            )
-            proc.start()
-            mgr.set("compute_pid", proc.pid)
-
             if is_service_node:
+                # The compute process owns the TPU chips; exactly one
+                # per node (SURVEY.md §7 'Spark process model vs TPU
+                # ownership').  Service nodes are not supervised: their
+                # loss is not recoverable by checkpoint resume.
+                proc = multiprocessing.get_context("spawn").Process(
+                    target=_compute_process_main,
+                    args=(_cp.dumps(fn), args, ctx),
+                    daemon=True,
+                    name="compute-%s-%d" % (job_name, task_index),
+                )
+                proc.start()
+                mgr.set("compute_pid", proc.pid)
                 # ps/evaluator executors block on the control queue until
                 # the driver posts None (reference: TFSparkNode.py:409-426),
                 # pinning the executor slot so no feed task lands here.
@@ -640,13 +668,49 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
                 _check_error_queue(mgr)
                 proc.terminate()
                 mgr.set("state", "stopped")
+            else:
+                # Compute workers run under a Supervisor: it spawns the
+                # compute process, pumps heartbeats to the rendezvous
+                # server (dead-node detection in seconds instead of the
+                # 600s feed timeout), and — with elastic=True — wraps
+                # the process in the rebirth/re-rendezvous restart loop
+                # (cluster/supervisor.py).
+                from tensorflowonspark_tpu.cluster import (
+                    supervisor as _supervisor,
+                )
+                from tensorflowonspark_tpu.testing import chaos as _chaos
+
+                compute_eids = [
+                    n["executor_id"]
+                    for n in cluster_info
+                    if n["job_name"] in ("chief", "master", "worker")
+                ]
+                sup = _supervisor.Supervisor(
+                    _cp.dumps(fn),
+                    args,
+                    ctx,
+                    mgr,
+                    cluster_meta,
+                    compute_eids,
+                    node_meta,
+                    chaos_fn=_chaos.heartbeat_chaos_fn(executor_id),
+                )
+                sup.start()
+                _supervisor.register_local_supervisor(sup)
             # SPARK-mode workers return immediately, freeing the executor
             # for feed tasks; the compute process keeps running.
         else:
             # TENSORFLOW input mode: user fn reads its own data; run in
             # the foreground, pinning this executor for the duration
-            # (reference: TFSparkNode.py:427-431).
+            # (reference: TFSparkNode.py:427-431).  A heartbeater runs
+            # for the duration so the driver monitor sees this node too.
             ctx.mgr = mgr
+            hb = reservation.Heartbeater(
+                cluster_meta["server_addr"],
+                executor_id,
+                interval=cluster_meta.get("heartbeat_interval"),
+                host=host,
+            ).start()
             try:
                 fn(args, ctx)
             except Exception:
@@ -655,6 +719,8 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
                 mgr.get_queue("error").put(traceback.format_exc())
                 mgr.set("state", "stopped")
                 raise
+            finally:
+                hb.stop()
             mgr.set("state", "stopped")
         return []
 
@@ -706,12 +772,27 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     (reference: TFSparkNode.py:436-503)."""
 
     def _train(iterator):
+        import itertools
+
+        # elastic partitions lead with a PartitionStart marker carrying
+        # the driver's partition id — strip it and open a ledger record
+        # so the driver can requeue this partition if the consumer dies
+        # before a checkpoint commits it (at-least-once delivery)
+        iterator = iter(iterator)
+        first = next(iterator, None)
+        pid = None
+        if isinstance(first, PartitionStart):
+            pid = first.pid
+        elif first is not None:
+            iterator = itertools.chain([first], iterator)
         mgr, state = _manager_first_call(
             cluster_info,
             _local_executor_id(),
             lambda m: str(m.get("state")._getvalue()),
         )
         logger.info("connected to node manager, state=%s", state)
+        if pid is not None and state != "terminating":
+            mgr.ledger("begin", pid)
         terminating = state == "terminating"
         queue = mgr.get_queue(qname)
         if terminating:
@@ -936,6 +1017,11 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     "(feed_timeout exceeded)"
                 )
         _check_error_queue(mgr, err_q)
+        if pid is not None:
+            # every row was consumed (join + ring drain both completed):
+            # the partition is DELIVERED — it becomes durable (committed)
+            # only when the compute process checkpoints past it
+            mgr.ledger("deliver", pid)
         logger.info("fed %d items", count)
         return []
 

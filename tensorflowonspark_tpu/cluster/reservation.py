@@ -13,6 +13,13 @@ changes for the TPU build:
   ``jax.distributed.initialize`` coordination plan and a logical mesh.
 - Same message vocabulary as the reference: REG / QINFO / QUERY / STOP
   (reference: reservation.py:130-146) plus LOOKUP for keyed queries.
+- **HEARTBEAT frames + liveness registry** (no reference analogue — the
+  reference's only failure signal was the 600s feed timeout): every
+  node sends a HEARTBEAT every ``HEARTBEAT_INTERVAL`` seconds carrying
+  its executor id, rendezvous *generation*, and whether its compute
+  process is alive; the server-side :class:`Liveness` registry marks an
+  executor dead after ``HEARTBEAT_MISS_THRESHOLD`` missed intervals, so
+  the driver's ClusterMonitor detects a dead worker in seconds.
 
 The server survives in the TPU architecture as the component that produces
 the coordinator address + topology and enforces the startup barrier
@@ -28,7 +35,19 @@ import struct
 import threading
 import time
 
+from tensorflowonspark_tpu.utils.retry import Backoff
+
 logger = logging.getLogger(__name__)
+
+#: Seconds between HEARTBEAT frames (env-tunable: TFOS_HEARTBEAT_INTERVAL).
+HEARTBEAT_INTERVAL = float(os.environ.get("TFOS_HEARTBEAT_INTERVAL", "1.0"))
+
+#: Missed intervals before an executor is declared dead (env-tunable:
+#: TFOS_HEARTBEAT_MISS_THRESHOLD).  3 intervals balances detection speed
+#: against GC-pause / scheduler-jitter false positives.
+HEARTBEAT_MISS_THRESHOLD = int(
+    os.environ.get("TFOS_HEARTBEAT_MISS_THRESHOLD", "3")
+)
 
 #: Env overrides for multi-homed driver hosts
 #: (reference: reservation.py:25-26 TFOS_SERVER_HOST/TFOS_SERVER_PORT).
@@ -88,6 +107,109 @@ class Reservations(object):
             return self.required - len(self._reservations)
 
 
+class Liveness(object):
+    """Server-side heartbeat registry.
+
+    Tracks the last heartbeat per executor id.  An executor is *dead*
+    when its newest beat is older than ``interval * miss_threshold`` —
+    i.e. it missed ``miss_threshold`` consecutive heartbeats — or when
+    its node explicitly reported ``compute_alive=False`` (immediate,
+    no waiting out the threshold).  Executors are only tracked once
+    they have beaten at least once: a cluster that never enables
+    heartbeats reports nobody dead, keeping the feature opt-in.
+    """
+
+    def __init__(self, interval=None, miss_threshold=None):
+        self.interval = (
+            HEARTBEAT_INTERVAL if interval is None else float(interval)
+        )
+        self.miss_threshold = (
+            HEARTBEAT_MISS_THRESHOLD
+            if miss_threshold is None
+            else int(miss_threshold)
+        )
+        self._lock = threading.Lock()
+        #: executor_id -> {"t": monotonic, "generation": int,
+        #:                 "compute_alive": bool, "host": str}
+        self._beats = {}
+
+    @property
+    def deadline(self):
+        """Seconds of silence after which an executor is dead."""
+        return self.interval * self.miss_threshold
+
+    def beat(self, executor_id, generation=0, compute_alive=True, host=""):
+        with self._lock:
+            self._beats[int(executor_id)] = {
+                "t": time.monotonic(),
+                "generation": int(generation),
+                "compute_alive": bool(compute_alive),
+                "host": host,
+            }
+
+    def forget(self, executor_id):
+        """Drop an executor from tracking (its node left on purpose)."""
+        with self._lock:
+            self._beats.pop(int(executor_id), None)
+
+    def last_seen(self, executor_id):
+        """Seconds since the executor's last beat; None if never seen."""
+        with self._lock:
+            rec = self._beats.get(int(executor_id))
+        return None if rec is None else time.monotonic() - rec["t"]
+
+    def generation(self, executor_id):
+        with self._lock:
+            rec = self._beats.get(int(executor_id))
+        return 0 if rec is None else rec["generation"]
+
+    def dead(self):
+        """Return ``{executor_id: diagnosis}`` for every tracked executor
+        currently considered dead.  Diagnosis dicts carry ``age`` (secs
+        of silence), ``reason`` and the last known ``host``/``generation``
+        so the driver can name the node in its failure."""
+        now = time.monotonic()
+        out = {}
+        with self._lock:
+            for eid, rec in self._beats.items():
+                age = now - rec["t"]
+                if not rec["compute_alive"]:
+                    out[eid] = {
+                        "age": age,
+                        "reason": "node reported its compute process dead",
+                        "host": rec["host"],
+                        "generation": rec["generation"],
+                    }
+                elif age > self.deadline:
+                    out[eid] = {
+                        "age": age,
+                        "reason": (
+                            "no heartbeat for {0:.1f}s "
+                            "(> {1} x {2:.1f}s interval)".format(
+                                age, self.miss_threshold, self.interval
+                            )
+                        ),
+                        "host": rec["host"],
+                        "generation": rec["generation"],
+                    }
+        return out
+
+    def snapshot(self):
+        """Last-seen ages + metadata for every tracked executor (the
+        LIVENESS query payload)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                str(eid): {
+                    "age": now - rec["t"],
+                    "generation": rec["generation"],
+                    "compute_alive": rec["compute_alive"],
+                    "host": rec["host"],
+                }
+                for eid, rec in self._beats.items()
+            }
+
+
 class MessageSocket(object):
     """Length-prefixed JSON framing over a TCP socket
     (reference: reservation.py:68-97, re-done without pickle)."""
@@ -125,12 +247,34 @@ class Server(MessageSocket):
     """Driver-side rendezvous server: single-thread ``select()`` loop
     (reference: reservation.py:100-199)."""
 
-    def __init__(self, count):
+    def __init__(self, count, heartbeat_interval=None, miss_threshold=None):
         assert count > 0
         self.reservations = Reservations(count)
+        self.liveness = Liveness(heartbeat_interval, miss_threshold)
         self.done = threading.Event()
         self._stop_requested = threading.Event()
         self._listener = None
+        #: elastic re-rendezvous generation — bumped by REBIRTH frames
+        self._generation = 0
+        self._gen_lock = threading.Lock()
+
+    @property
+    def generation(self):
+        with self._gen_lock:
+            return self._generation
+
+    def next_generation(self, executor_id, old_generation):
+        """Atomically claim the generation a reborn executor joins.
+
+        Monotonic and race-safe for simultaneous deaths: the first
+        rebirth bumps the cluster generation; a second executor dying in
+        the same window *joins* that generation instead of bumping past
+        it (its ``old_generation`` is still the pre-death value)."""
+        with self._gen_lock:
+            self._generation = max(self._generation, int(old_generation) + 1)
+            gen = self._generation
+        self.liveness.beat(executor_id, generation=gen)
+        return gen
 
     @property
     def stop_requested(self):
@@ -206,8 +350,57 @@ class Server(MessageSocket):
         # message vocabulary (reference: reservation.py:130-146)
         mtype = msg.get("type")
         if mtype == "REG":
-            self.reservations.add(msg["data"])
+            data = msg["data"]
+            self.reservations.add(data)
+            # A REG carrying a generation > 0 is an elastic re-rendezvous:
+            # the replacement node primes the liveness registry so the
+            # monitor stops counting the old incarnation's silence.
+            if isinstance(data, dict) and data.get("generation"):
+                self.liveness.beat(
+                    data.get("executor_id", -1),
+                    generation=data.get("generation", 0),
+                    host=data.get("host", ""),
+                )
             self.send(sock, {"type": "OK"})
+        elif mtype == "HEARTBEAT":
+            self.liveness.beat(
+                msg.get("executor_id", -1),
+                generation=msg.get("generation", 0),
+                compute_alive=msg.get("compute_alive", True),
+                host=msg.get("host", ""),
+            )
+            # stop flag + cluster generation piggyback on the reply, so
+            # heartbeaters double as the survivors' rebirth signal
+            self.send(
+                sock,
+                {
+                    "type": "OK",
+                    "stop": self.stop_requested,
+                    "generation": self.generation,
+                },
+            )
+        elif mtype == "FAREWELL":
+            # orderly departure: stop tracking, so a node whose work
+            # completed is never misread as dead-by-silence
+            self.liveness.forget(msg.get("executor_id", -1))
+            self.send(sock, {"type": "OK"})
+        elif mtype == "REBIRTH":
+            gen = self.next_generation(
+                msg.get("executor_id", -1), msg.get("generation", 0)
+            )
+            self.send(sock, {"type": "REBIRTH_RESP", "generation": gen})
+        elif mtype == "LIVENESS":
+            self.send(
+                sock,
+                {
+                    "type": "LIVENESS_RESP",
+                    "executors": self.liveness.snapshot(),
+                    "dead": {
+                        str(k): v for k, v in self.liveness.dead().items()
+                    },
+                    "generation": self.generation,
+                },
+            )
         elif mtype == "QUERY":
             self.send(
                 sock,
@@ -261,45 +454,82 @@ class Server(MessageSocket):
 class Client(MessageSocket):
     """Executor-side rendezvous client (reference: reservation.py:206-273)."""
 
-    def __init__(self, server_addr):
+    def __init__(self, server_addr, retry_deadline=None):
         self.server_addr = tuple(server_addr)
-        self.sock = self._connect(self.server_addr)
+        if retry_deadline is not None:
+            # instance override of the class default (heartbeaters use a
+            # ~1-interval budget: blocking 30s on a dead server would
+            # defeat the liveness signal they exist to provide)
+            self.RETRY_DEADLINE = float(retry_deadline)
+        self.sock = self._connect(self.server_addr, self.RETRY_DEADLINE)
 
     #: Client-side socket timeout: a stalled server must surface as a
     #: retryable error, not an unbounded block that bypasses the polling
     #: timeout in ``await_reservations``.
     SOCKET_TIMEOUT = 30.0
 
+    #: Wall-clock budget for connect / request retries.  Backoff with
+    #: jitter under a HARD deadline (utils/retry.py) replaced the seed's
+    #: fixed 1s/2s/3s sleeps: a restarting server sees a desynchronized
+    #: trickle instead of a lockstep stampede, and exhaustion raises a
+    #: ConnectionError that names the server address.
+    RETRY_DEADLINE = 30.0
+
     @staticmethod
-    def _connect(addr, retries=3):
-        last = None
-        for i in range(retries):
+    def _connect(addr, deadline=None):
+        bo = Backoff(
+            deadline=Client.RETRY_DEADLINE if deadline is None else deadline,
+            base=0.2,
+            max_delay=3.0,
+        )
+        for attempt in bo:
             try:
                 sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
                 sock.settimeout(Client.SOCKET_TIMEOUT)
                 sock.connect(addr)
                 return sock
             except OSError as e:
-                last = e
-                time.sleep(1 + i)
+                attempt.note(e)
+                logger.warning(
+                    "connect to reservation server at %s failed "
+                    "(attempt %d): %s", addr, attempt.attempts, e,
+                )
         raise ConnectionError(
-            "unable to connect to reservation server at {0}: {1}".format(addr, last)
+            "unable to connect to reservation server at {0} within "
+            "{1:.0f}s ({2} attempts): {3}".format(
+                addr, bo.deadline, bo.attempts, bo.last_error
+            )
         )
 
     def _request(self, msg):
-        """Send with retry + reconnect (reference: reservation.py:228-241)."""
-        for i in range(3):
+        """Send with backoff + reconnect under a hard deadline
+        (reference: reservation.py:228-241 used three fixed-sleep tries;
+        see utils/retry.py for the replacement policy)."""
+        bo = Backoff(deadline=self.RETRY_DEADLINE, base=0.2, max_delay=3.0)
+        for attempt in bo:
             try:
                 self.send(self.sock, msg)
                 return self.receive(self.sock)
-            except (ConnectionError, OSError):
-                logger.warning("lost connection to server, reconnecting (try %d)", i)
+            except (ConnectionError, OSError) as e:
+                attempt.note(e)
+                logger.warning(
+                    "lost connection to reservation server at %s "
+                    "(attempt %d): %s — reconnecting",
+                    self.server_addr, attempt.attempts, e,
+                )
                 try:
                     self.sock.close()
                 except OSError:
                     pass
-                self.sock = self._connect(self.server_addr)
-        raise ConnectionError("unable to reach reservation server")
+                # connect retries share the request's remaining budget
+                self.sock = self._connect(self.server_addr,
+                                          self.RETRY_DEADLINE)
+        raise ConnectionError(
+            "unable to reach reservation server at {0} within {1:.0f}s "
+            "({2} attempts): {3}".format(
+                self.server_addr, bo.deadline, bo.attempts, bo.last_error
+            )
+        )
 
     def register(self, reservation):
         resp = self._request({"type": "REG", "data": reservation})
@@ -329,6 +559,45 @@ class Client(MessageSocket):
         (reference: reservation.py:270-273; examples/utils/stop_streaming.py)."""
         return self._request({"type": "STOP"})
 
+    def heartbeat(self, executor_id, generation=0, compute_alive=True,
+                  host=""):
+        """Send one HEARTBEAT frame; returns the server's reply (which
+        carries the cluster-wide ``stop`` flag, so heartbeaters double
+        as stop-signal listeners)."""
+        return self._request(
+            {
+                "type": "HEARTBEAT",
+                "executor_id": int(executor_id),
+                "generation": int(generation),
+                "compute_alive": bool(compute_alive),
+                "host": host,
+            }
+        )
+
+    def get_liveness(self):
+        """Fetch the server's liveness snapshot: ``(executors, dead)``
+        dicts keyed by executor id (string keys — JSON wire format)."""
+        resp = self._request({"type": "LIVENESS"})
+        return resp["executors"], resp["dead"]
+
+    def farewell(self, executor_id):
+        """Remove this executor from liveness tracking (orderly exit)."""
+        return self._request(
+            {"type": "FAREWELL", "executor_id": int(executor_id)}
+        )
+
+    def rebirth(self, executor_id, generation):
+        """Claim the generation a reborn executor rejoins under (see
+        ``Server.next_generation`` for the simultaneous-death rule)."""
+        resp = self._request(
+            {
+                "type": "REBIRTH",
+                "executor_id": int(executor_id),
+                "generation": int(generation),
+            }
+        )
+        return int(resp["generation"])
+
     def get_stop_requested(self):
         resp = self._request({"type": "QUERY"})
         return resp.get("stop", False)
@@ -338,3 +607,126 @@ class Client(MessageSocket):
             self.sock.close()
         except OSError:
             pass
+
+
+class Heartbeater(object):
+    """Background thread pumping HEARTBEAT frames to the rendezvous
+    server — the node-side half of the liveness plane.
+
+    Args:
+      server_addr: ``(host, port)`` of the rendezvous server.
+      executor_id: this node's logical id.
+      interval: seconds between beats (default ``HEARTBEAT_INTERVAL``).
+      alive_fn: zero-arg callable polled each beat; its bool rides the
+        frame as ``compute_alive`` so a node whose compute process died
+        is reported *immediately* instead of after the miss threshold.
+      generation_fn: zero-arg callable returning the node's current
+        rendezvous generation (elastic restarts bump it).
+      chaos_fn: optional zero-arg callable; truthy = drop this beat
+        (the chaos harness's heartbeat-delay/drop injection point —
+        dropping frames here exercises exactly the miss-threshold path
+        a real network partition would).
+
+    A beat that cannot reach the server is logged and *dropped* — the
+    next interval retries with a fresh connection.  Missing frames is
+    precisely the failure signal the server-side registry measures, so
+    the heartbeater must never block or die trying to be reliable.
+    """
+
+    def __init__(self, server_addr, executor_id, interval=None,
+                 alive_fn=None, generation_fn=None, host="", chaos_fn=None):
+        self.server_addr = tuple(server_addr)
+        self.executor_id = int(executor_id)
+        self.interval = (
+            HEARTBEAT_INTERVAL if interval is None else float(interval)
+        )
+        self.alive_fn = alive_fn
+        self.generation_fn = generation_fn
+        self.host = host
+        self.chaos_fn = chaos_fn
+        self.stop_seen = False  # server's stop flag, piggybacked on beats
+        #: newest cluster generation seen in a reply — supervisors poll
+        #: this to learn a peer was reborn (their cue to park/respawn)
+        self.cluster_generation = 0
+        self._stop = threading.Event()
+        self._client = None
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run,
+            daemon=True,
+            name="heartbeat-%d" % self.executor_id,
+        )
+        self._thread.start()
+        return self
+
+    def beat_once(self):
+        """Send a single beat synchronously (used to prime the registry
+        at startup so death-by-silence is measured from 'now')."""
+        self._send_beat()
+
+    def _send_beat(self):
+        alive = True if self.alive_fn is None else bool(self.alive_fn())
+        gen = 0 if self.generation_fn is None else int(self.generation_fn())
+        if self._client is None:
+            self._client = Client(
+                self.server_addr,
+                retry_deadline=max(1.0, self.interval),
+            )
+        resp = self._client.heartbeat(
+            self.executor_id, generation=gen, compute_alive=alive,
+            host=self.host,
+        )
+        if resp.get("stop"):
+            self.stop_seen = True
+        self.cluster_generation = max(
+            self.cluster_generation, int(resp.get("generation", 0))
+        )
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            if self.chaos_fn is not None and self.chaos_fn():
+                logger.debug(
+                    "chaos: dropping heartbeat of executor %d",
+                    self.executor_id,
+                )
+                continue
+            try:
+                self._send_beat()
+            except Exception as e:  # noqa: BLE001 - see class docstring
+                logger.warning(
+                    "heartbeat of executor %d to %s failed: %s "
+                    "(will retry next interval)",
+                    self.executor_id, self.server_addr, e,
+                )
+                try:
+                    if self._client is not None:
+                        self._client.close()
+                except Exception:  # noqa: BLE001 - socket already gone
+                    pass
+                self._client = None
+
+    def stop(self, farewell=True):
+        """Stop beating; with ``farewell`` (default) tell the server to
+        drop this executor from tracking — an orderly exit must not be
+        misread as death-by-silence."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+        if farewell:
+            try:
+                if self._client is None:
+                    self._client = Client(
+                        self.server_addr,
+                        retry_deadline=max(1.0, self.interval),
+                    )
+                self._client.farewell(self.executor_id)
+            except Exception:  # noqa: BLE001 - server may already be down
+                pass
+        if self._client is not None:
+            try:
+                self._client.close()
+            except Exception:  # noqa: BLE001 - socket already gone
+                pass
+            self._client = None

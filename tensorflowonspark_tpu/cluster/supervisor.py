@@ -1,0 +1,431 @@
+"""Per-node compute supervision: heartbeats, restart loop, re-rendezvous.
+
+The reference had no worker-recovery story at all: a dead worker was
+invisible to the driver until the 600s feed timeout, and its Spark task
+retry was deliberately *poisoned* to fail (the duplicate-start check in
+``node.py``).  This module is the opposite contract, modeled on how
+TF-Replicator treats preemption as a normal event (PAPERS.md):
+
+- every compute node runs a :class:`Supervisor` in its executor process
+  that (a) pumps HEARTBEAT frames to the rendezvous server so the
+  driver's ClusterMonitor sees death within ~3 intervals, and (b) —
+  when the cluster was started with ``elastic=True`` — wraps the
+  compute process in a restart loop;
+- on compute death the supervisor performs a **rebirth**: it asks the
+  rendezvous server for the next *generation* number, resets the node's
+  queues (releasing feeders blocked on ``join()`` for rows the dead
+  process popped), re-registers under the new generation, parks at the
+  **re-rendezvous barrier** until every compute peer reports the same
+  generation, and respawns the compute process with
+  ``ctx.generation = N+1`` so user code (via the
+  ``train_on_feed(checkpointer=...)`` resume hook) restores the last
+  complete checkpoint;
+- survivors observe the generation bump piggybacked on their heartbeat
+  replies and take the same park → reset → respawn path (without a
+  bump), so the whole cluster resumes from one consistent checkpoint
+  step;
+- partitions the dead incarnation had consumed past the last checkpoint
+  stay un-``committed`` in the node's :class:`PartitionLedger`; the
+  driver requeues them (at-least-once delivery — some rows may train
+  twice, none are silently dropped).
+
+State machine (docs/fault_tolerance.md has the full diagram)::
+
+    RUNNING --proc dies, elastic, budget left--> REBIRTH
+    RUNNING --peer generation bump-------------> PARK
+    REBIRTH --new generation from server-------> PARK
+    PARK    --all peers at generation G--------> RESPAWN --> RUNNING
+    RUNNING --proc dies, budget exhausted------> FAILED (error queued)
+    RUNNING --proc exits, state stopped--------> DONE
+"""
+
+import logging
+import multiprocessing
+import os
+import threading
+import time
+
+from tensorflowonspark_tpu.cluster import manager, reservation
+
+logger = logging.getLogger(__name__)
+
+#: Default restart budget per node (env-tunable: TFOS_MAX_RESTARTS).
+MAX_RESTARTS = int(os.environ.get("TFOS_MAX_RESTARTS", "3"))
+
+#: Seconds a supervisor waits at the re-rendezvous barrier before
+#: proceeding alone (a permanently-lost peer is the driver monitor's
+#: failure to report, not a reason to wedge the survivors).
+BARRIER_TIMEOUT = float(os.environ.get("TFOS_REBIRTH_BARRIER_TIMEOUT", "60"))
+
+#: Seconds between the two queue-reset passes of a rebirth.  A consumer
+#: that died inside a proxied ``get()`` leaves a zombie thread in the
+#: manager server which swallows exactly one later item without
+#: acknowledging it; DataFeed bounds its gets at 1s, so any zombie is
+#: guaranteed dead (its bounded get expired and the reply to the dead
+#: socket failed) once this grace has passed — the second pass then
+#: zeroes whatever the zombie swallowed.
+ZOMBIE_GRACE = 1.2
+
+#: Module-level keepalive: supervisors must outlive the start task that
+#: created them (same rationale and caveat as ``node._LOCAL_MANAGERS`` —
+#: mutate only via :func:`register_local_supervisor`, never through a
+#: cloudpickled closure's ``__globals__`` copy).
+_LOCAL_SUPERVISORS = []
+
+
+def register_local_supervisor(sup):
+    _LOCAL_SUPERVISORS.append(sup)
+
+
+class Supervisor(object):
+    """Watches one node's compute process; restarts it when elastic.
+
+    Args:
+      fn_bytes: cloudpickled user ``main_fun`` (respawns need it again).
+      args: opaque user args.
+      ctx: the node's :class:`~tensorflowonspark_tpu.cluster.node.NodeContext`.
+      mgr: this node's queue-manager proxy.
+      cluster_meta: driver metadata dict (``server_addr``, ``elastic``,
+        ``max_restarts``, ``heartbeat_interval``, ``queues``).
+      compute_eids: executor ids of all compute (worker/chief/master)
+        nodes — the re-rendezvous barrier membership.
+      node_meta: this node's registration record (re-sent on rebirth,
+        with ``generation`` added).
+      chaos_fn: optional zero-arg callable; truthy = drop the next
+        heartbeat (threaded through to :class:`reservation.Heartbeater`).
+    """
+
+    def __init__(self, fn_bytes, args, ctx, mgr, cluster_meta,
+                 compute_eids, node_meta, chaos_fn=None):
+        self.fn_bytes = fn_bytes
+        self.args = args
+        self.ctx = ctx
+        self.mgr = mgr
+        self.cluster_meta = cluster_meta
+        self.compute_eids = sorted(compute_eids)
+        self.node_meta = dict(node_meta)
+        self.server_addr = tuple(cluster_meta["server_addr"])
+        self.elastic = bool(cluster_meta.get("elastic", False))
+        self.max_restarts = int(
+            cluster_meta.get("max_restarts", MAX_RESTARTS)
+        )
+        self.interval = float(
+            cluster_meta.get("heartbeat_interval")
+            or reservation.HEARTBEAT_INTERVAL
+        )
+        self.generation = 0
+        self.restarts = 0
+        self.proc = None
+        self.heartbeater = None
+        self._stop = threading.Event()
+        self._thread = None
+        self._chaos_fn = chaos_fn
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self):
+        """Spawn the compute process, prime the liveness registry, and
+        start the watch thread.  Returns self."""
+        self._spawn()
+        self.heartbeater = reservation.Heartbeater(
+            self.server_addr,
+            self.ctx.executor_id,
+            interval=self.interval,
+            alive_fn=self._proc_alive,
+            generation_fn=lambda: self.generation,
+            host=self.node_meta.get("host", ""),
+            chaos_fn=self._chaos_fn,
+        )
+        try:
+            # prime: death-by-silence is measured from "now", and the
+            # registry starts tracking this node
+            self.heartbeater.beat_once()
+        except Exception as e:  # noqa: BLE001 - server may be slow; the
+            logger.warning(  # periodic beats will catch up
+                "priming heartbeat for executor %d failed: %s",
+                self.ctx.executor_id, e,
+            )
+        self.heartbeater.start()
+        self._thread = threading.Thread(
+            target=self._watch,
+            daemon=True,
+            name="supervisor-%d" % self.ctx.executor_id,
+        )
+        self._thread.start()
+        return self
+
+    @property
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+    def _proc_alive(self):
+        """What the heartbeat's ``compute_alive`` flag reports.  A
+        process that exited after marking itself 'finished' is a clean
+        completion, NOT a death — a worker that finishes its share
+        while peers still train must not trip the monitor.  (The mark
+        happens before the exit in _compute_process_main, so there is
+        no window where a clean finish reads as dead.)"""
+        if self.proc is not None and self.proc.is_alive():
+            return True
+        try:
+            return (
+                self.mgr.get("compute_state")._getvalue() == "finished"
+            )
+        except Exception:  # noqa: BLE001 - manager gone = node dying
+            return False
+
+    def _spawn(self):
+        from tensorflowonspark_tpu.cluster.node import _compute_process_main
+
+        self.ctx.generation = self.generation
+        proc = multiprocessing.get_context("spawn").Process(
+            target=_compute_process_main,
+            args=(self.fn_bytes, self.args, self.ctx),
+            daemon=True,
+            name="compute-%s-%d-gen%d" % (
+                self.ctx.job_name, self.ctx.task_index, self.generation
+            ),
+        )
+        proc.start()
+        self.proc = proc
+        try:
+            self.mgr.set("compute_pid", proc.pid)
+            self.mgr.set("generation", self.generation)
+            self.mgr.set("restarts", self.restarts)
+        except Exception:  # noqa: BLE001 - kv is observability, not control
+            logger.warning(
+                "unable to record compute pid/generation for executor %d",
+                self.ctx.executor_id, exc_info=True,
+            )
+        logger.info(
+            "spawned compute process pid=%d for executor %d generation %d",
+            proc.pid, self.ctx.executor_id, self.generation,
+        )
+
+    # -- watch loop ----------------------------------------------------
+
+    def _node_state(self):
+        try:
+            return str(self.mgr.get("state")._getvalue())
+        except Exception:  # noqa: BLE001 - manager down = executor dying
+            return "unknown"
+
+    def _watch(self):
+        while not self._stop.is_set():
+            self.proc.join(timeout=self.interval / 2.0)
+            state = self._node_state()
+            if not self.proc.is_alive():
+                if state in ("terminating", "stopped"):
+                    break  # orderly teardown, nothing to supervise
+                compute_state = None
+                try:
+                    compute_state = self.mgr.get(
+                        "compute_state"
+                    )._getvalue()
+                except Exception:  # noqa: BLE001 - manager going down
+                    pass
+                if compute_state == "finished":
+                    break  # clean completion
+                # abnormal death: exitcode != 0 or 'failed'
+                if not self.elastic:
+                    logger.error(
+                        "compute process of executor %d died "
+                        "(exitcode %s) and elastic=False; the driver "
+                        "monitor will fail the run",
+                        self.ctx.executor_id, self.proc.exitcode,
+                    )
+                    self._final_beat()
+                    break
+                if self.restarts >= self.max_restarts:
+                    self._give_up()
+                    break
+                self._rebirth()
+                continue
+            # proc alive: did a peer trigger a new generation?
+            peer_gen = (
+                self.heartbeater.cluster_generation
+                if self.heartbeater is not None else 0
+            )
+            if self.elastic and peer_gen > self.generation:
+                logger.info(
+                    "executor %d parking: peer rebirth raised the "
+                    "cluster generation to %d (own %d)",
+                    self.ctx.executor_id, peer_gen, self.generation,
+                )
+                self._park_and_respawn(peer_gen)
+        # heartbeats stay up until the node is told to stop, so the
+        # driver can still distinguish 'compute done' from 'node gone'
+        self._await_stop_then_quiesce()
+
+    def _final_beat(self):
+        """Push one immediate compute_alive=False beat so the monitor
+        learns of the death now instead of after the miss threshold."""
+        try:
+            self.heartbeater.beat_once()
+        except Exception:  # noqa: BLE001 - silence also signals death
+            pass
+
+    def _give_up(self):
+        msg = (
+            "compute process of executor {0} died {1} times "
+            "(restart budget {2} exhausted); last exitcode {3}".format(
+                self.ctx.executor_id, self.restarts + 1,
+                self.max_restarts, self.proc.exitcode,
+            )
+        )
+        logger.error(msg)
+        try:
+            self.mgr.get_queue("error").put(msg)
+            self.mgr.set("compute_state", "failed")
+        except Exception:  # noqa: BLE001 - best effort error reporting
+            logger.warning(
+                "unable to report restart-budget exhaustion for "
+                "executor %d", self.ctx.executor_id, exc_info=True,
+            )
+        self._final_beat()
+
+    # -- rebirth -------------------------------------------------------
+
+    def _rebirth(self):
+        """Own compute died: claim the next generation and restart."""
+        exitcode = self.proc.exitcode
+        self.restarts += 1
+        logger.warning(
+            "compute process of executor %d died (exitcode %s); "
+            "rebirth %d/%d",
+            self.ctx.executor_id, exitcode, self.restarts,
+            self.max_restarts,
+        )
+        try:
+            client = reservation.Client(self.server_addr)
+            new_gen = client.rebirth(self.ctx.executor_id, self.generation)
+            client.close()
+        except Exception:  # noqa: BLE001 - server gone: no cluster left
+            logger.error(
+                "executor %d could not reach the rendezvous server for "
+                "rebirth; giving up", self.ctx.executor_id, exc_info=True,
+            )
+            return
+        self._park_and_respawn(new_gen)
+
+    def _park_and_respawn(self, generation):
+        """Park at the re-rendezvous barrier for ``generation``, reset
+        the local data plane, and respawn the compute process."""
+        # a surviving (healthy) proc is stopped first so every node
+        # resumes from the same checkpoint step
+        if self.proc.is_alive():
+            self.proc.terminate()
+            self.proc.join(timeout=10)
+            if self.proc.is_alive():
+                self.proc.kill()
+                self.proc.join(timeout=10)
+        self.generation = int(generation)
+        self._reset_data_plane()
+        # re-register under the new generation (keeps cluster_info fresh
+        # and primes the liveness registry for this incarnation)
+        try:
+            client = reservation.Client(self.server_addr)
+            meta = dict(self.node_meta, generation=self.generation)
+            client.register(meta)
+            self._await_generation(client, self.generation)
+            client.close()
+        except Exception:  # noqa: BLE001 - barrier is best-effort; the
+            logger.warning(  # monitor owns permanent-failure detection
+                "executor %d re-rendezvous for generation %d was "
+                "incomplete; respawning anyway",
+                self.ctx.executor_id, self.generation, exc_info=True,
+            )
+        self._spawn()
+
+    def _reset_data_plane(self):
+        """Release feeders and drop stale state: zero every feed queue's
+        unfinished count (rows the dead process popped can never be
+        task_done'd by it), and clear the error queue of the death's
+        traceback — the restart is handling it.
+
+        Two passes around a ``ZOMBIE_GRACE`` sleep: the dead consumer
+        may have left a zombie get() thread in the manager server that
+        swallows one more item after the first pass (see the constant's
+        docstring); pass two runs once the zombie is provably gone."""
+        self._reset_queues_once()
+        time.sleep(ZOMBIE_GRACE)
+        self._reset_queues_once()
+        try:
+            errors = manager.drain(self.mgr.get_queue("error"), timeout=0)
+            if errors:
+                logger.info(
+                    "rebirth of executor %d cleared %d queued error(s) "
+                    "from the dead incarnation", self.ctx.executor_id,
+                    errors,
+                )
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "unable to drain error queue on executor %d",
+                self.ctx.executor_id, exc_info=True,
+            )
+        try:
+            self.mgr.set("compute_state", None)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _reset_queues_once(self):
+        for qname in self.cluster_meta.get("queues", ["input"]):
+            if qname == "error":
+                continue
+            try:
+                discarded = self.mgr.reset_queue(qname)._getvalue()
+                if discarded:
+                    logger.info(
+                        "rebirth of executor %d discarded %d stale "
+                        "items from queue %r (their partitions stay "
+                        "uncommitted in the ledger and will be requeued)",
+                        self.ctx.executor_id, discarded, qname,
+                    )
+            except Exception:  # noqa: BLE001 - queue may not exist for role
+                logger.warning(
+                    "unable to reset queue %r on executor %d",
+                    qname, self.ctx.executor_id, exc_info=True,
+                )
+
+    def _await_generation(self, client, generation):
+        """Re-rendezvous barrier: block until every compute peer's
+        liveness record reports ``generation`` (or the barrier times
+        out — a permanently-dead peer must not wedge survivors)."""
+        deadline = time.monotonic() + BARRIER_TIMEOUT
+        while time.monotonic() < deadline:
+            executors, _ = client.get_liveness()
+            gens = {
+                eid: executors.get(str(eid), {}).get("generation", -1)
+                for eid in self.compute_eids
+            }
+            behind = [e for e, g in gens.items() if g < generation]
+            if not behind:
+                logger.info(
+                    "executor %d: re-rendezvous barrier for generation "
+                    "%d complete", self.ctx.executor_id, generation,
+                )
+                return True
+            time.sleep(min(0.2, self.interval / 2.0))
+        logger.warning(
+            "executor %d: re-rendezvous barrier for generation %d timed "
+            "out waiting for %s", self.ctx.executor_id, generation, behind,
+        )
+        return False
+
+    # -- teardown ------------------------------------------------------
+
+    def _await_stop_then_quiesce(self):
+        """After the compute story ends (done/failed), keep beating until
+        the driver marks the node stopped, then stop the heartbeater so
+        a long-lived executor doesn't spam a dead server forever."""
+        while not self._stop.is_set():
+            if self._node_state() in ("stopped", "terminating", "unknown"):
+                break
+            time.sleep(self.interval)
+        if self.heartbeater is not None:
+            self.heartbeater.stop()
+
+    def stop(self):
+        self._stop.set()
+        if self.heartbeater is not None:
+            self.heartbeater.stop()

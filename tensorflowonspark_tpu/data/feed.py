@@ -147,7 +147,18 @@ class DataFeed(object):
                     except queue_mod.Empty:
                         continue
             else:
-                return queue_in.get(block=True)
+                # Bounded block, retried: an UNbounded proxied get()
+                # parks a thread inside the manager server holding the
+                # queue's read lock; if this process then dies, that
+                # zombie thread survives it and silently swallows the
+                # next item (it only discovers the dead socket when it
+                # tries to reply).  A 1s bound makes any zombie expire
+                # within a second of the death — the supervisor's
+                # queue-reset grace period relies on this constant.
+                try:
+                    return queue_in.get(block=True, timeout=1.0)
+                except queue_mod.Empty:
+                    continue
 
     def _set_pending(self, obj):
         """Install a ring/queue block as the pending element (a row list
@@ -341,6 +352,27 @@ class DataFeed(object):
         """True once the feeder posted the end-of-feed sentinel
         (reference: TFNode.py:290-292)."""
         return self.done_feeding
+
+    def commit_partitions(self):
+        """Promote every *delivered* feed partition to *committed* in
+        this node's :class:`~tensorflowonspark_tpu.cluster.manager.PartitionLedger`.
+
+        Call immediately AFTER a checkpoint save has been made durable
+        (``Checkpointer.save(..., wait=True)`` or
+        ``wait_until_finished()``): a committed partition is one the
+        elastic restart path will never requeue, so committing before
+        durability would turn a crash into silent data loss.  The
+        ``train_on_feed(checkpointer=...)`` resume hook sequences this
+        correctly.  Returns the number of partitions promoted (0 when
+        feeding isn't elastic — the ledger is simply empty)."""
+        try:
+            return int(self.mgr.ledger("commit")._getvalue())
+        except Exception:  # noqa: BLE001 - pre-ledger manager (rolling
+            logger.warning(  # upgrade): requeue stays conservative
+                "partition-ledger commit failed; partitions stay "
+                "requeue-eligible", exc_info=True,
+            )
+            return 0
 
     def batch_results(self, results):
         """Push a batch of inference results to the output queue

@@ -188,6 +188,16 @@ def _executor_main(
     effects (their job's waiter already raised)."""
     os.environ[TFOS_EXECUTOR_WORKDIR] = workdir
     os.environ.update(env_overrides or {})
+    # executor processes otherwise only surface >=WARNING through the
+    # last-resort handler; recovery diagnostics (supervisor rebirths,
+    # queue resets) log at INFO — opt in when debugging chaos runs
+    loglevel = os.environ.get("TFOS_EXECUTOR_LOGLEVEL")
+    if loglevel:
+        logging.basicConfig(
+            level=getattr(logging, loglevel.upper(), logging.INFO),
+            format="%(asctime)s exec-%(process)d %(levelname)s "
+                   "%(name)s: %(message)s",
+        )
     os.chdir(workdir)
     # Own process group so engine.stop() can reap the whole executor tree
     # (queue-manager and compute children included).

@@ -238,6 +238,9 @@ class SyncTrainer(object):
         metrics_callback=None,
         columnar=False,
         terminate_on_max_steps=True,
+        checkpointer=None,
+        checkpoint_every=0,
+        step_callback=None,
     ):
         """Run the synchronized feed loop: pull batches from a
         :class:`~tensorflowonspark_tpu.data.feed.DataFeed`, stop globally
@@ -266,6 +269,21 @@ class SyncTrainer(object):
             so the feeder's ``queue.join()`` doesn't block until
             feed_timeout.  Pass False for incremental training that
             resumes consuming from the same feed.
+          checkpointer: a :class:`~tensorflowonspark_tpu.checkpoint.Checkpointer`
+            — THE fault-tolerance resume hook.  At entry, if it holds a
+            checkpoint, ``state`` is replaced by the restored latest
+            step (so a supervised restart auto-resumes — user code does
+            not branch on ``ctx.generation``); every ``checkpoint_every``
+            steps and at exit the state is saved durably
+            (``wait=True``) and the feed's delivered partitions are
+            promoted to committed (``feed.commit_partitions``), fencing
+            them from elastic requeue.  See docs/fault_tolerance.md.
+          checkpoint_every: step spacing of periodic saves (0 = only the
+            final save).
+          step_callback: optional ``fn(step)`` called before each
+            executed group — the chaos harness's deterministic
+            kill-at-step injection point
+            (:func:`tensorflowonspark_tpu.testing.chaos.step_fault_fn`).
         Returns the final state.
         """
         if steps_per_execution < 1:
@@ -277,6 +295,10 @@ class SyncTrainer(object):
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         columnar = bool(columnar)
         steps = 0
+        if checkpointer is not None and checkpointer.latest_step() is not None:
+            state = checkpointer.restore(state)
+            steps = int(jax.device_get(state.step))
+            logger.info("resumed from checkpoint at step %d", steps)
         stop = False
         while not stop:
             if max_steps is not None and steps >= max_steps:
@@ -317,6 +339,8 @@ class SyncTrainer(object):
                 subs.append(sub)
             if not group:
                 break
+            if step_callback is not None:
+                step_callback(steps)
             if len(group) == 1:
                 state, metrics = self.step(state, group[0], subs[0])
             else:
@@ -328,6 +352,15 @@ class SyncTrainer(object):
             steps += len(group)
             if metrics_callback is not None:
                 metrics_callback(steps, metrics)
+            if (
+                checkpointer is not None
+                and checkpoint_every
+                and steps % checkpoint_every < len(group)
+            ):
+                # durable BEFORE commit: a committed partition must
+                # never be lost to a crash between the two
+                checkpointer.save(steps, state, wait=True)
+                feed.commit_partitions()
             if log_every and (steps % log_every < len(group)):
                 logger.info(
                     "step %d loss %.4f", steps, float(metrics["loss"])
@@ -346,6 +379,11 @@ class SyncTrainer(object):
             # examples/mnist/estimator/mnist_spark.py:16-24).
             logger.info("max_steps reached; terminating the feed")
             feed.terminate()
+        if checkpointer is not None and checkpointer.latest_step() != steps:
+            # final durable save (skipped when a resumed run made no
+            # progress — that step already exists on disk)
+            checkpointer.save(steps, state, wait=True)
+            feed.commit_partitions()
         return state
 
 

@@ -331,27 +331,27 @@ class PSClient(object):
     """
 
     def __init__(self, addresses, timeout=60):
+        from tensorflowonspark_tpu.utils.retry import retry_call
+
         self.addresses = list(addresses)
         self._socks = []
         for a in self.addresses:
             host, _, port = a.rpartition(":")
-            # Retry refused connections until the deadline: workers race
-            # the ps shards' startup (the shard binds in a background
-            # compute process after the rendezvous barrier releases).
-            import time as _time
-
-            deadline = _time.monotonic() + timeout
-            while True:
-                try:
-                    s = socket.create_connection(
-                        (host, int(port)),
-                        timeout=max(1.0, deadline - _time.monotonic()),
-                    )
-                    break
-                except (ConnectionRefusedError, socket.timeout, OSError):
-                    if _time.monotonic() >= deadline:
-                        raise
-                    _time.sleep(0.2)
+            # Backoff-with-jitter under a hard deadline (utils/retry.py)
+            # — workers race the ps shards' startup (the shard binds in
+            # a background compute process after the rendezvous barrier
+            # releases), and a whole fleet reconnecting to a restarted
+            # shard must not stampede it in lockstep.
+            s = retry_call(
+                lambda h=host, p=int(port): socket.create_connection(
+                    (h, p), timeout=max(1.0, timeout)
+                ),
+                "connect to ps shard at {0}".format(a),
+                exceptions=(OSError,),
+                deadline=timeout,
+                base=0.2,
+                max_delay=2.0,
+            )
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._socks.append(s)
         self._treedef = None

@@ -1,0 +1,6 @@
+"""Test-support subpackage: deterministic fault injection (chaos.py).
+
+Shipped inside the library (not under tests/) because the runtime has
+exactly one sanctioned chaos hook — the supervisor's heartbeat-drop
+callable — and it must resolve the plan without importing test code.
+"""

@@ -1,0 +1,295 @@
+"""Deterministic chaos injection for fault-tolerance tests.
+
+Three fault families, all reproducible (no randomness — a chaos test
+that fails must fail the same way every run):
+
+- **kill worker k at step s** — a :class:`ChaosPlan` written to a JSON
+  file and advertised via the ``TFOS_CHAOS_PLAN`` env var; the user fn
+  under test calls :func:`step_fault_fn` and invokes the returned
+  callable once per training step.  The kill is a SIGKILL to the
+  compute process's own pid: no atexit handlers, no error-queue post —
+  exactly what a preemption or OOM kill looks like to the rest of the
+  system.
+- **drop heartbeats** — the same plan file can order executor k to drop
+  its next N heartbeat frames; the supervisor threads this through
+  :class:`~tensorflowonspark_tpu.cluster.reservation.Heartbeater`'s
+  ``chaos_fn``, exercising the miss-threshold path a real network
+  partition would take.
+- **sever a TCP connection** — :class:`TcpGremlin`, a forwarding proxy
+  to put in front of a reservation server or node manager; it can
+  refuse the next N connections or cut every live one on command,
+  driving the client retry/backoff paths end to end.
+
+Nothing here runs unless a test opts in: ``heartbeat_chaos_fn`` returns
+``None`` when ``TFOS_CHAOS_PLAN`` is unset, so production paths carry a
+single dict lookup of overhead.
+"""
+
+import json
+import logging
+import os
+import signal
+import socket
+import threading
+
+logger = logging.getLogger(__name__)
+
+#: Env var naming the JSON chaos-plan file executors should load.
+TFOS_CHAOS_PLAN = "TFOS_CHAOS_PLAN"
+
+
+class ChaosPlan(object):
+    """A deterministic fault plan, serializable to the plan file.
+
+    Build with the fluent helpers::
+
+        plan = (ChaosPlan()
+                .kill_worker(executor_id=1, at_step=5)
+                .drop_heartbeats(executor_id=0, beats=4))
+        plan.save(path)          # point TFOS_CHAOS_PLAN at this
+    """
+
+    def __init__(self, faults=None):
+        self.faults = list(faults or [])
+
+    def kill_worker(self, executor_id, at_step):
+        """SIGKILL executor ``executor_id``'s compute process the first
+        time its step counter reaches ``at_step``."""
+        self.faults.append(
+            {"kind": "kill", "executor_id": int(executor_id),
+             "at_step": int(at_step)}
+        )
+        return self
+
+    def drop_heartbeats(self, executor_id, beats):
+        """Drop the next ``beats`` HEARTBEAT frames of ``executor_id``
+        (simulates a network partition of exactly that length)."""
+        self.faults.append(
+            {"kind": "drop_heartbeats", "executor_id": int(executor_id),
+             "beats": int(beats)}
+        )
+        return self
+
+    def save(self, path):
+        path = os.fspath(path)
+        with open(path, "w") as f:
+            json.dump({"faults": self.faults}, f)
+        return path
+
+    def env(self, path):
+        """The env dict to hand a LocalEngine so executors see the plan."""
+        return {TFOS_CHAOS_PLAN: os.fspath(path)}
+
+    @classmethod
+    def load(cls, path):
+        with open(path) as f:
+            return cls(json.load(f).get("faults", []))
+
+
+def load_plan():
+    """The plan advertised via ``TFOS_CHAOS_PLAN``, or None."""
+    path = os.environ.get(TFOS_CHAOS_PLAN)
+    if not path:
+        return None
+    try:
+        return ChaosPlan.load(path)
+    except (OSError, ValueError):
+        logger.warning("unreadable chaos plan at %r", path, exc_info=True)
+        return None
+
+
+def step_fault_fn(ctx):
+    """Build the per-step fault hook for this compute process.
+
+    Returns ``fault(step)`` — call it once per training step; it
+    SIGKILLs this process when a ``kill`` fault for this executor is
+    due.  Kill faults fire once per *incarnation reborn after them*:
+    a restarted process (``ctx.generation > 0``) skips faults already
+    spent, so kill-at-step-5 does not re-kill the replacement when it
+    replays step 5 from the checkpoint.  With no plan configured the
+    hook is a no-op lambda.
+    """
+    plan = load_plan()
+    if plan is None:
+        return lambda step: None
+    kills = [
+        f for f in plan.faults
+        if f["kind"] == "kill" and f["executor_id"] == ctx.executor_id
+    ]
+    generation = getattr(ctx, "generation", 0)
+
+    def fault(step):
+        for i, f in enumerate(kills):
+            # fault i belongs to incarnation i: generation 0 arms the
+            # first kill, the replacement (generation 1) the second, ...
+            if i == generation and step >= f["at_step"]:
+                logger.warning(
+                    "chaos: killing executor %d compute (pid %d) at "
+                    "step %d per plan", ctx.executor_id, os.getpid(), step,
+                )
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    return fault
+
+
+def heartbeat_chaos_fn(executor_id):
+    """Build the Heartbeater ``chaos_fn`` for this executor, or None
+    when no plan orders heartbeat drops for it (the common case —
+    callers pass the None straight through, zero overhead)."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    budget = sum(
+        f["beats"] for f in plan.faults
+        if f["kind"] == "drop_heartbeats"
+        and f["executor_id"] == int(executor_id)
+    )
+    if budget <= 0:
+        return None
+    state = {"left": budget}
+
+    def drop():
+        if state["left"] > 0:
+            state["left"] -= 1
+            return True
+        return False
+
+    return drop
+
+
+def kill_compute(cluster, executor_id, sig=signal.SIGKILL):
+    """Driver-side kill: SIGKILL the compute process of ``executor_id``
+    right now (same-host clusters — the LocalEngine substrate).  Returns
+    the pid killed.  The step-precise path is :func:`step_fault_fn`;
+    this one is for tests that only need "a worker died mid-feed"."""
+    from tensorflowonspark_tpu.cluster import manager as mgr_mod
+
+    node = next(
+        n for n in cluster.cluster_info if n["executor_id"] == executor_id
+    )
+    m = mgr_mod.connect(tuple(node["addr"]), bytes.fromhex(node["authkey"]))
+    pid = m.get("compute_pid")._getvalue()
+    if not pid:
+        raise RuntimeError(
+            "executor {0} has no compute pid recorded".format(executor_id)
+        )
+    os.kill(pid, sig)
+    logger.warning(
+        "chaos: killed compute pid %d of executor %d", pid, executor_id
+    )
+    return pid
+
+
+class TcpGremlin(object):
+    """A deterministic TCP fault proxy.
+
+    Sits between a client and a real server::
+
+        gremlin = TcpGremlin(server_addr)
+        addr = gremlin.start()        # hand THIS to the client
+        gremlin.refuse_next(2)        # next 2 connects are cut on accept
+        gremlin.cut_all()             # sever every live connection NOW
+        gremlin.stop()
+
+    ``refuse_next`` models a server that is briefly unreachable (the
+    client's connect succeeds at the TCP level, then the peer vanishes
+    mid-handshake — the hard flavor of refusal to retry correctly);
+    ``cut_all`` severs established connections the way a mid-request
+    network partition does.
+    """
+
+    def __init__(self, target_addr):
+        self.target_addr = tuple(target_addr)
+        self._listener = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._refuse = 0
+        self._pairs = []  # live (client_sock, server_sock) pairs
+        self.connections = 0  # total accepted (observability for tests)
+
+    def start(self):
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(16)
+        addr = ("127.0.0.1", self._listener.getsockname()[1])
+        threading.Thread(
+            target=self._accept_loop, daemon=True, name="gremlin-accept"
+        ).start()
+        return addr
+
+    def refuse_next(self, n):
+        with self._lock:
+            self._refuse += int(n)
+
+    def cut_all(self):
+        """Sever every live proxied connection immediately."""
+        with self._lock:
+            pairs, self._pairs = self._pairs, []
+        for a, b in pairs:
+            for s in (a, b):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    s.close()
+                except OSError:
+                    pass
+        return len(pairs)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            self.connections += 1
+            with self._lock:
+                refuse = self._refuse > 0
+                if refuse:
+                    self._refuse -= 1
+            if refuse:
+                try:
+                    client.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                server = socket.create_connection(self.target_addr, timeout=5)
+            except OSError:
+                client.close()
+                continue
+            with self._lock:
+                self._pairs.append((client, server))
+            for src, dst in ((client, server), (server, client)):
+                threading.Thread(
+                    target=self._pipe, args=(src, dst), daemon=True,
+                    name="gremlin-pipe",
+                ).start()
+
+    @staticmethod
+    def _pipe(src, dst):
+        try:
+            while True:
+                data = src.recv(1 << 16)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self.cut_all()

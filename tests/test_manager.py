@@ -1,0 +1,89 @@
+"""Tests for cluster/manager.py primitives: drain() quiet-gap semantics
+under racing producers (the DataFeed.terminate release path), previously
+untested — a regression here strands feeders at feed_timeout."""
+
+import queue
+import threading
+import time
+
+from tensorflowonspark_tpu.cluster import manager
+
+
+class _JoinableQueue(object):
+    """In-process JoinableQueue stand-in (same get/task_done surface
+    drain() uses) — keeps these timing-sensitive tests free of
+    multiprocessing scheduling noise."""
+
+    def __init__(self):
+        self._q = queue.Queue()
+
+    def put(self, item):
+        self._q.put(item)
+
+    def get(self, block=True, timeout=None):
+        return self._q.get(block=block, timeout=timeout)
+
+    def task_done(self):
+        pass
+
+
+def test_drain_empty_queue_costs_quiet_gap_not_budget():
+    q = _JoinableQueue()
+    t0 = time.monotonic()
+    assert manager.drain(q, timeout=10, quiet_gap=0.3) == 0
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0, "empty drain blocked ~the full budget: %.1fs" % elapsed
+
+
+def test_drain_absorbs_racing_producer_within_budget():
+    # satellite contract: a producer still putting DURING the drain is
+    # fully absorbed — nothing may be left for the next consumer
+    q = _JoinableQueue()
+    for i in range(5):
+        q.put(i)
+    produced = 20
+
+    def producer():
+        for i in range(produced):
+            q.put(100 + i)
+            time.sleep(0.05)  # inter-put gap well under quiet_gap
+
+    t = threading.Thread(target=producer)
+    t.start()
+    count = manager.drain(q, timeout=10, quiet_gap=2.0)
+    t.join()
+    assert count == 5 + produced, count
+    # and the queue really is dry afterwards
+    assert manager.drain(q, timeout=0) == 0
+
+
+def test_drain_budget_respected_when_producer_never_stops():
+    # satellite contract: an unbounded producer must not hold drain()
+    # past its overall budget
+    q = _JoinableQueue()
+    stop = threading.Event()
+
+    def producer():
+        while not stop.is_set():
+            q.put("x")
+            time.sleep(0.02)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        t0 = time.monotonic()
+        manager.drain(q, timeout=1.0, quiet_gap=0.5)
+        elapsed = time.monotonic() - t0
+        # one in-flight get may overshoot by at most ~quiet_gap
+        assert elapsed < 2.0, "drain overran its budget: %.1fs" % elapsed
+    finally:
+        stop.set()
+
+
+def test_drain_nonblocking_sweep():
+    q = _JoinableQueue()
+    for i in range(3):
+        q.put(i)
+    t0 = time.monotonic()
+    assert manager.drain(q, timeout=0) == 3
+    assert time.monotonic() - t0 < 0.5
