@@ -615,15 +615,27 @@ def serving_tpu_bench():
     return out
 
 
-def serving_generate_bench(rows_n=64, batch=8, max_new=64):
-    """Ragged batched generation serving (VERDICT r4 #8): dict-rows
-    with VARYING prompt lengths through predict_rows -> per-row
-    continuations, on the flagship 334M model composing GQA (Hkv=2),
-    sliding-window attention (W=512), int8 weights AND int8 KV cache
-    in one recorded config.  predict_rows left-pads each batch to a
-    64-bucket (one compiled program per bucket) and generate() masks
-    pad slots per row; equivalence vs per-row unpadded generation is
-    tested in tests/test_models.py."""
+def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
+    """Ragged batched generation serving (VERDICT r4 #8 + r5 'Next'
+    #4): dict-rows with VARYING prompt lengths through predict_rows,
+    on the flagship 334M model composing GQA (Hkv=2), sliding-window
+    attention (W=512), int8 weights AND int8 KV cache in one recorded
+    config — STATIC batches vs the CONTINUOUS in-flight scheduler, at
+    equal batch size / slot count.
+
+    Workload: prompts uniform[100,256] tokens, and per-request token
+    BUDGETS uniform[16,max_new] (the stand-in for first-eos stops —
+    completion lengths vary, which is what real serving traffic looks
+    like).  The static path cannot stop early: every request pays the
+    full max_new-step compiled scan (its rows/s is therefore
+    identical to the budget-free measurement, r5 comparable).  The
+    continuous path evicts each row at its budget between chunked
+    scans and admits the next prompt into the freed KV slot
+    (token-identical outputs up to each budget, parity-tested in
+    tests/test_serving.py).  Both paths report per-request latency
+    p50/p99 (all requests submitted at t0; a request's latency ends
+    when ITS tokens are done — for static that is its whole batch's
+    scan end)."""
     import numpy as np
 
     import jax
@@ -638,6 +650,14 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64):
         dtype="bfloat16", num_kv_heads=2, attention_window=512,
         cache_dtype="int8",
     )
+    # sweep/smoke hook (the flagship takes minutes on CPU):
+    # TFOS_SERVING_GEN_CONFIG='{"num_layers":2,...,"rows_n":16}'
+    over = json.loads(os.environ.get("TFOS_SERVING_GEN_CONFIG", "{}"))
+    rows_n = int(over.pop("rows_n", rows_n))
+    batch = int(over.pop("batch", batch))
+    max_new = int(over.pop("max_new", max_new))
+    chunk = int(over.pop("chunk", chunk))
+    cfg.update(over)
     model = tr.Transformer(tr.TransformerConfig(**cfg))
     params = jax.jit(
         lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
@@ -647,15 +667,25 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64):
         dict(
             cfg, mode="generate", max_new_tokens=max_new,
             quantize="int8", pad_multiple=128,
+            chunk_size=chunk, max_prompt_len=256,
         ),
     )
     rng = np.random.RandomState(0)
     lens = rng.randint(100, 257, size=rows_n)
+    budgets = rng.randint(16, max_new + 1, size=rows_n)
     rows = [
-        {"prompt": rng.randint(0, 32000, (n,)).astype(np.int32)}
-        for n in lens
+        {
+            "prompt": rng.randint(0, 32000, (n,)).astype(np.int32),
+            "max_new": int(b),
+        }
+        for n, b in zip(lens, budgets)
     ]
     mapping = {"prompt": "tokens"}
+    mapping_cont = {"prompt": "tokens", "max_new": "max_new"}
+
+    def _pct(lat_ms, q):
+        return round(float(np.percentile(np.asarray(lat_ms), q)), 1)
+
     # warm both length buckets (128 and 256) outside the timed region
     list(serving.predict_rows(
         predict,
@@ -665,24 +695,75 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64):
     ))
     t0 = time.perf_counter()
     n_out = 0
+    lat_static = []
     for r in serving.predict_rows(
         predict, rows, mapping, batch_size=batch
     ):
         assert r["generated"].shape == (max_new,)
+        lat_static.append((time.perf_counter() - t0) * 1e3)
         n_out += 1
     dt = time.perf_counter() - t0
     assert n_out == rows_n
-    return {
+
+    # continuous: warm the slot engine's prefill buckets + chunk
+    # program outside the timed region (tiny budgets — two chunks)
+    list(serving.predict_rows(
+        predict,
+        [{"prompt": rows[0]["prompt"][:100], "max_new": 2}
+         for _ in range(batch)]
+        + [{"prompt": rows[0]["prompt"], "max_new": 2}
+           for _ in range(batch)],
+        mapping_cont, batch_size=batch, schedule="continuous",
+    ))
+    sched = {}
+    t0c = time.perf_counter()
+    n_out = 0
+    for r in serving.predict_rows(
+        predict, rows, mapping_cont, batch_size=batch,
+        schedule="continuous", stats=sched,
+    ):
+        assert r["generated"].shape == (max_new,)
+        n_out += 1
+    dt_cont = time.perf_counter() - t0c
+    assert n_out == rows_n
+    lat_cont = [1e3 * v for v in sched["latency_sec"].values()]
+
+    out = {
         "rows_per_sec": round(rows_n / dt, 2),
         "generated_tokens_per_sec": round(rows_n * max_new / dt, 1),
+        "delivered_tokens_per_sec": round(int(budgets.sum()) / dt, 1),
+        "latency_p50_ms": _pct(lat_static, 50),
+        "latency_p99_ms": _pct(lat_static, 99),
         "rows": rows_n,
         "batch_size": batch,
         "max_new_tokens": max_new,
         "prompt_lens": "ragged uniform[100,256], 128-bucketed",
-        "config": "334M GQA(Hkv=2) window=512 int8 weights + int8 KV cache",
+        "budgets": "per-request token budgets uniform[16,%d] "
+                   "(completion-length spread; static cannot stop "
+                   "early, continuous evicts at budget)" % max_new,
+        "config": "L%d Dm%d GQA(Hkv=%d) window=%d int8 weights + "
+                  "int8 KV cache" % (
+                      cfg["num_layers"], cfg["embed_dim"],
+                      cfg["num_kv_heads"], cfg["attention_window"],
+                  ),
         "wall_sec": round(dt, 3),
         "platform": __import__("jax").devices()[0].platform,
+        "continuous": {
+            "rows_per_sec": round(rows_n / dt_cont, 2),
+            "delivered_tokens_per_sec": round(
+                int(budgets.sum()) / dt_cont, 1
+            ),
+            "latency_p50_ms": _pct(lat_cont, 50),
+            "latency_p99_ms": _pct(lat_cont, 99),
+            "slots": batch,
+            "chunk_size": chunk,
+            "admitted": sched["admitted"],
+            "chunks": sched["chunks"],
+            "speedup_vs_static": round(dt / dt_cont, 3),
+            "wall_sec": round(dt_cont, 3),
+        },
     }
+    return out
 
 
 def _decode_step_ms(model, params, prompt, new_tokens):
@@ -1657,19 +1738,86 @@ def collect_aux_bench(proc, timeout):
         return None
 
 
+#: default sink for the FULL benchmark record; the driver's stdout tail
+#: window is ~2000 chars, so stdout only ever carries the compact
+#: summary line (VERDICT r5 Weak #1: the old single giant line
+#: overflowed it and nulled the parsed record)
+BENCH_FULL_PATH = os.environ.get("TFOS_BENCH_FULL_PATH", "bench_full.json")
+
+
+def _pluck(record, *path):
+    """record[path0][path1]... or None (missing/None sections)."""
+    cur = record
+    for p in path:
+        if not isinstance(cur, dict) or cur.get(p) is None:
+            return None
+        cur = cur[p]
+    return cur
+
+
+def bench_summary(record):
+    """Compact headline dict for the driver: ONLY the summary keys, a
+    handful of numbers — structurally bounded far under the 1500-char
+    line budget (unit-tested in tests/test_bench.py)."""
+    metric = str(record.get("metric") or "")
+    return {
+        "resnet50_img_s": (
+            record.get("value") if metric.startswith("resnet50") else None
+        ),
+        "vs_baseline": record.get("vs_baseline"),
+        "lm_tok_s": _pluck(record, "transformer", "value"),
+        "lm_mfu": _pluck(record, "transformer", "mfu"),
+        "spark_feed_steps_s": (
+            _pluck(record, "spark_feed", "ring", "steps_per_sec")
+            or _pluck(record, "spark_feed", "queue", "steps_per_sec")
+        ),
+        "moe_tok_s": _pluck(record, "moe", "value"),
+        "serving_generate_rows_s": _pluck(
+            record, "serving_generate", "rows_per_sec"
+        ),
+        "serving_continuous_rows_s": _pluck(
+            record, "serving_generate", "continuous", "rows_per_sec"
+        ),
+        "wall_sec": record.get("bench_wall_sec"),
+    }
+
+
+def emit_record(record, full_path=None):
+    """Persist the FULL record to ``full_path`` and return the compact
+    summary JSON line for stdout.  Called after every completed
+    section, so a driver timeout kill truncates the record to the last
+    finished section instead of nulling it — and the last stdout line
+    is always standalone-parseable and <= 1500 chars."""
+    path = full_path or BENCH_FULL_PATH
+    try:
+        with open(path, "w") as f:
+            json.dump(record, f)
+    except OSError as e:
+        print("full record not writable (%s): %s" % (path, e),
+              file=sys.stderr)
+        path = None
+    summary = bench_summary(record)
+    summary["full_record"] = path
+    line = json.dumps(summary)
+    assert len(line) <= 1500, len(line)
+    return line
+
+
 def main(model_name="resnet50", with_feed=True):
-    """Default driver record.  Emits the CUMULATIVE record as one JSON
-    line after EVERY completed section (the driver parses the last
-    line, so a timeout kill truncates instead of nulling — the r4
-    failure mode), and skips budget-overrunning aux rows with a note.
-    Section order = required rows first: spark_feed (the subprocess
-    must own the chip before this process touches it), resnet50
-    headline, transformer flagship, decode."""
+    """Default driver record.  After EVERY completed section the
+    CUMULATIVE full record goes to BENCH_FULL_PATH and ONE compact
+    summary line (bench_summary) goes to stdout — the driver parses
+    the last stdout line, so a timeout kill truncates instead of
+    nulling (the r4 failure mode) and the line always fits its tail
+    window (the r5 failure mode).  Budget-overrunning aux rows are
+    skipped with a note.  Section order = required rows first:
+    spark_feed (the subprocess must own the chip before this process
+    touches it), resnet50 headline, transformer flagship, decode."""
     out = {}
 
     def emit():
         out["bench_wall_sec"] = round(time.monotonic() - BENCH_T0, 1)
-        print(json.dumps(out), flush=True)
+        print(emit_record(out), flush=True)
 
     aux_proc = start_aux_bench() if with_feed else None
     if with_feed:
@@ -1704,7 +1852,9 @@ def main(model_name="resnet50", with_feed=True):
         for name, fn, est_sec in (
             ("decode", decode_bench, 0),
             ("long_context", long_context_bench, 150),
-            ("serving_generate", serving_generate_bench, 150),
+            # static + continuous schedules (two extra compiled
+            # programs: slot prefill x2 buckets + the chunk scan)
+            ("serving_generate", serving_generate_bench, 220),
             ("decode_long", decode_long_bench, 160),
             ("async_ps_tpu", ps_tpu_bench, 100),
             ("serving_tpu", serving_tpu_bench, 120),

@@ -17,13 +17,23 @@ through ``serving.predict_rows``:
 - ``--quantize int8`` composes weight-only int8 + the int8 KV cache
   with GQA (``--num_kv_heads``) and sliding-window attention
   (``--attention_window``) — the full decode-efficiency stack in one
-  serving config (measured: ``python bench.py serving_generate``).
+  serving config (measured: ``python bench.py serving_generate``);
+- ``--schedule continuous`` runs the same requests through the
+  slot-level in-flight scheduler instead of static batches: finished
+  rows are evicted and waiting prompts admitted into the freed
+  KV-cache slots between chunked decode scans (docs/serving.md).
+
+The export also writes ``output_schema`` into the serving metadata
+(via ``serving.infer_output_schema``), so a distributed
+``TFModel.transform`` over this export types its DataFrame without
+the legacy one-row probe job.
 
 Run (CPU or a real chip):
 
     python examples/transformer/serve_generate_tpu.py
     python examples/transformer/serve_generate_tpu.py \
-        --quantize int8 --num_kv_heads 2 --attention_window 128
+        --quantize int8 --num_kv_heads 2 --attention_window 128 \
+        --schedule continuous
 """
 
 import argparse
@@ -56,6 +66,9 @@ def main():
     p.add_argument("--pad_multiple", type=int, default=16)
     p.add_argument("--eos_id", type=int, default=None)
     p.add_argument("--quantize", choices=["none", "int8"], default="none")
+    p.add_argument("--schedule", choices=["static", "continuous"],
+                   default="static")
+    p.add_argument("--chunk_size", type=int, default=8)
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args()
 
@@ -98,20 +111,32 @@ def main():
             mode="generate",
             max_new_tokens=args.max_new_tokens,
             pad_multiple=args.pad_multiple,
+            chunk_size=args.chunk_size,
+            max_prompt_len=args.max_prompt,
         )
         if args.eos_id is not None:
             model_config["eos_id"] = args.eos_id
         if args.quantize == "int8":
             model_config["quantize"] = "int8"
+        np_params = jax.tree.map(np.asarray, params)
+        # one tiny row through the predictor types the export: the
+        # distributed transform reads output_schema from metadata
+        # instead of probing (and re-decoding) partition 0
+        schema = serving.infer_output_schema(
+            tr.serving_builder(np_params, model_config),
+            {"prompt": np.zeros((4,), np.int32)},
+            {"prompt": "tokens"},
+        )
         save_for_serving(
             export,
-            jax.tree.map(np.asarray, params),
+            np_params,
             extra_metadata={
                 "model_ref":
                     "tensorflowonspark_tpu.models.transformer:"
                     "serving_builder",
                 "model_config": model_config,
             },
+            output_schema=schema,
         )
         predict = serving.load_predictor(export)
 
@@ -124,9 +149,11 @@ def main():
             for n in lens
         ]
         t0 = time.time()
+        sched_stats = {}
         outs = list(serving.predict_rows(
             predict, rows, {"prompt": "tokens"},
             batch_size=args.batch_size,
+            schedule=args.schedule, stats=sched_stats,
         ))
         dt = time.time() - t0
         for i, (n, o) in enumerate(zip(lens, outs)):
@@ -143,12 +170,22 @@ def main():
         toks = args.num_requests * args.max_new_tokens
         print(
             "%d ragged requests (%d-%d tokens), %d generated tokens "
-            "in %.2fs (%.0f tok/s incl. compile)"
+            "in %.2fs (%.0f tok/s incl. compile, %s schedule)"
             % (
                 args.num_requests, int(lens.min()), int(lens.max()),
-                toks, dt, toks / dt,
+                toks, dt, toks / dt, args.schedule,
             )
         )
+        if sched_stats.get("latency_sec"):
+            lat = sorted(sched_stats["latency_sec"].values())
+            print(
+                "continuous: %d admitted / %d chunks, per-request "
+                "p50=%.0fms p99=%.0fms"
+                % (
+                    sched_stats["admitted"], sched_stats["chunks"],
+                    1e3 * lat[len(lat) // 2], 1e3 * lat[-1],
+                )
+            )
 
 
 if __name__ == "__main__":

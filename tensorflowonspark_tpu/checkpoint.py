@@ -129,15 +129,33 @@ def _abstractify(x):
 # ----------------------------------------------------------------------
 
 
-def save_for_serving(directory, params, extra_metadata=None):
+def save_for_serving(directory, params, extra_metadata=None,
+                     output_schema=None):
     """Export inference params (+ JSON metadata) — the role the
     reference filled with SavedModel export (TFNode.py:159-208,
     compat.py:10-17: chief exports, workers write to a dummy dir; here
-    non-zero processes simply skip)."""
+    non-zero processes simply skip).
+
+    ``output_schema`` — an interchange field list
+    (``[(name, type), ...]``) or struct string — lands in the export's
+    ``metadata.json``, where :class:`~tensorflowonspark_tpu.pipeline.
+    TFModel`'s native transform reads it to type the result DataFrame
+    WITHOUT the legacy one-row probe job (which evaluates the
+    predictor over partition 0 twice — a full compiled decode, for
+    generation exports).  Derive it from a live predictor with
+    :func:`tensorflowonspark_tpu.serving.infer_output_schema`.
+    """
     import json
 
+    import numpy as np
     import orbax.checkpoint as ocp
 
+    # bare numpy scalars (np.float32(0.5)) are rejected by current
+    # orbax; 0-d arrays round-trip identically
+    params = jax.tree.map(
+        lambda x: np.asarray(x) if isinstance(x, np.generic) else x,
+        params,
+    )
     if jax.process_index() != 0 and jax.process_count() > 1:
         # orbax saves distributed arrays cooperatively; for the common
         # replicated-params serving export, process 0 alone suffices
@@ -150,6 +168,11 @@ def save_for_serving(directory, params, extra_metadata=None):
     ckptr.close()
     if jax.process_index() == 0:
         meta = dict(extra_metadata or {})
+        if output_schema is not None:
+            meta["output_schema"] = (
+                output_schema if isinstance(output_schema, str)
+                else [list(f) for f in output_schema]
+            )
         with open(os.path.join(directory, "metadata.json"), "w") as f:
             json.dump(meta, f)
     logger.info("serving export written to %s", directory)

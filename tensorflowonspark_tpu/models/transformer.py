@@ -118,7 +118,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, decode=False, pad_start=None):
+    def __call__(self, x, positions, decode=False, pad_start=None,
+                 per_slot=False):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.head_dim
         hkv = cfg.num_kv_heads or h
@@ -171,7 +172,29 @@ class Attention(nn.Module):
                 "cache", "cached_value", jnp.zeros,
                 (b, cfg.max_seq_len, hkv, d), bank_dtype,
             )
-            i = positions[0, 0]
+            if per_slot:
+                # continuous-batching slot mode: every batch lane is an
+                # independent request with its OWN write pointer
+                # (positions[:, 0]), so appends are per-row
+                # dynamic_update_slice (vmapped -> one scatter) instead
+                # of one batch-wide slice write.
+                row_i = positions[:, 0]
+
+                def _write(bank, val):
+                    return jax.vmap(
+                        lambda bank_r, val_r, i_r: jax.lax.dynamic_update_slice(
+                            bank_r, val_r.astype(bank_r.dtype),
+                            (i_r,) + (0,) * (val_r.ndim - 1),
+                        )
+                    )(bank, val, row_i)
+            else:
+                i = positions[0, 0]
+
+                def _write(bank, val):
+                    return jax.lax.dynamic_update_slice(
+                        bank, val.astype(bank.dtype),
+                        (0, i) + (0,) * (val.ndim - 2),
+                    )
             if int8_cache:
                 cks = self.variable(
                     "cache", "cached_key_scale", jnp.zeros,
@@ -186,29 +209,54 @@ class Attention(nn.Module):
 
                 kq, ks = qz.quantize_leaf(k, reduce_axes=(3,))
                 vq, vs = qz.quantize_leaf(v, reduce_axes=(3,))
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, kq, (0, i, 0, 0)
-                )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, vq, (0, i, 0, 0)
-                )
-                cks.value = jax.lax.dynamic_update_slice(
-                    cks.value, ks, (0, i, 0, 0)
-                )
-                cvs.value = jax.lax.dynamic_update_slice(
-                    cvs.value, vs, (0, i, 0, 0)
-                )
+                ck.value = _write(ck.value, kq)
+                cv.value = _write(cv.value, vq)
+                cks.value = _write(cks.value, ks)
+                cvs.value = _write(cvs.value, vs)
             else:
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(ck.value.dtype), (0, i, 0, 0)
-                )
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(cv.value.dtype), (0, i, 0, 0)
-                )
+                ck.value = _write(ck.value, k)
+                cv.value = _write(cv.value, v)
             kpos = jnp.arange(ck.value.shape[1])
             qpos = positions[0]
             from tensorflowonspark_tpu.ops.attention import dot_attention
 
+            if per_slot:
+                # per-row query positions: each slot sees its own
+                # causal horizon, window, and pad region.  Slots keep
+                # self-visibility (kpos == qpos) so a fully-masked idle
+                # slot's softmax stays finite (same NaN guard as the
+                # ragged pad-row case below).
+                qpos_r = positions  # [B, S]
+                vis = kpos[None, None, :] <= qpos_r[:, :, None]
+                if cfg.attention_window:
+                    vis = jnp.logical_and(
+                        vis,
+                        kpos[None, None, :]
+                        > qpos_r[:, :, None] - cfg.attention_window,
+                    )
+                ps = (
+                    pad_start if pad_start is not None
+                    else jnp.zeros((x.shape[0],), jnp.int32)
+                )
+                vis = jnp.logical_or(
+                    jnp.logical_and(
+                        vis, kpos[None, None, :] >= ps[:, None, None]
+                    ),
+                    kpos[None, None, :] == qpos_r[:, :, None],
+                )
+                mask = jnp.where(vis, 0.0, -jnp.inf)[:, None]
+                out = dot_attention(
+                    q, ck.value, cv.value, causal=False, mask=mask,
+                    k_scale=cks.value if int8_cache else None,
+                    v_scale=cvs.value if int8_cache else None,
+                )
+                return nn.DenseGeneral(
+                    cfg.embed_dim,
+                    axis=(-2, -1),
+                    use_bias=False,
+                    dtype=cfg.jdtype,
+                    name="out",
+                )(out)
             visible = kpos[None, :] <= qpos[:, None]
             if cfg.attention_window:
                 visible = jnp.logical_and(
@@ -280,11 +328,12 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, decode=False, pad_start=None):
+    def __call__(self, x, positions, decode=False, pad_start=None,
+                 per_slot=False):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(name="ln1")(x), positions, decode=decode,
-            pad_start=pad_start,
+            pad_start=pad_start, per_slot=per_slot,
         )
         h = RMSNorm(name="ln2")(x)
         if cfg.num_experts > 0:
@@ -324,12 +373,18 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, decode=False, pad_start=None):
+    def __call__(self, tokens, decode=False, pad_start=None,
+                 slot_positions=None):
         cfg = self.cfg
         if pad_start is not None and not decode:
             raise ValueError(
                 "pad_start (ragged left-padded batches) is a decode-"
                 "path feature; the training path has no pad masking"
+            )
+        if slot_positions is not None and not decode:
+            raise ValueError(
+                "slot_positions (continuous-batching slot decode) is a "
+                "decode-path feature"
             )
         emb = self.param(
             "embedding",
@@ -340,15 +395,24 @@ class Transformer(nn.Module):
         if decode:
             # absolute positions continue from the cache write pointer
             # (one shared counter; the per-layer Attention counters
-            # advance in lockstep with it)
+            # advance in lockstep with it).  In slot mode every batch
+            # lane is an independent request: the caller owns per-slot
+            # write pointers and passes them as ``slot_positions`` —
+            # the shared counter is left untouched.
             pos_var = self.variable(
                 "cache", "position", lambda: jnp.zeros((), jnp.int32)
             )
-            start = pos_var.value
-            positions = jnp.broadcast_to(
-                start + jnp.arange(tokens.shape[1]), tokens.shape
-            )
-            pos_var.value = start + tokens.shape[1]
+            if slot_positions is None:
+                start = pos_var.value
+                positions = jnp.broadcast_to(
+                    start + jnp.arange(tokens.shape[1]), tokens.shape
+                )
+                pos_var.value = start + tokens.shape[1]
+            else:
+                positions = (
+                    slot_positions[:, None]
+                    + jnp.arange(tokens.shape[1])[None, :]
+                )
         else:
             positions = jnp.broadcast_to(
                 jnp.arange(tokens.shape[1]), tokens.shape
@@ -373,7 +437,8 @@ class Transformer(nn.Module):
         else:
             for i in range(cfg.num_layers):
                 x = Block(cfg, name="block_%d" % i)(
-                    x, positions, decode, pad_start=pad_start
+                    x, positions, decode, pad_start=pad_start,
+                    per_slot=slot_positions is not None,
                 )
         x = RMSNorm(name="ln_f")(x)
         # tied output head would shard awkwardly under TP; a separate
@@ -517,7 +582,13 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
         ``mode="generate"``).
       eos_id: optional stop token — once a row samples it, every later
         position emits ``eos_id`` again (per-row stop inside the one
-        compiled scan; the serving layer trims them).
+        compiled scan).  Rows are returned UNTRIMMED at the full
+        ``[B, max_new_tokens]`` shape — static shapes are the whole
+        point of the compiled scan; the serving predictor reports a
+        ``generated_len`` column (the first-eos position) alongside
+        the untrimmed rows and the CONSUMER trims
+        (``row[:generated_len]``).  Tested in
+        tests/test_models.py::test_generated_len_matches_first_eos.
     Returns ``[B, max_new_tokens]`` sampled tokens.
     """
     b, p = prompt.shape
@@ -737,6 +808,284 @@ def generate_speculative(model, params, prompt, max_new_tokens,
     return (tokens, rounds) if return_stats else tokens
 
 
+class SlotDecoder:
+    """Slot-level KV-cache engine for CONTINUOUS in-flight batching.
+
+    The static :func:`generate` path is batch-synchronous: every
+    request in a batch pays the max-length decode.  This engine treats
+    each batch lane as a SLOT — an independent request with its own
+    cache region, write pointer, pad region, and eos flag — so the
+    serving scheduler (:mod:`tensorflowonspark_tpu.serving`,
+    ``schedule="continuous"``) can evict a finished request and admit
+    a queued prompt into the freed lane *between* chunked decode
+    scans, without touching the other lanes and without recompiling.
+
+    Exactly TWO compiled programs run steady-state:
+
+    - ``prefill``: one program per prompt-length BUCKET (lengths round
+      up to ``pad_multiple``, the same bucketing the static path
+      uses).  It slices one lane out of every cache bank
+      (``dynamic_slice``), runs the ordinary batch-1 prefill forward
+      with ``pad_start`` masking into that lane, writes the lane back
+      (``dynamic_update_slice``), and samples the first token.  The
+      slot index is a TRACED argument — admitting into lane 0 vs lane
+      7 is the same program.
+    - ``decode_chunk``: a ``lax.scan`` of ``chunk_size`` single-token
+      steps over the whole slot batch with per-slot positions
+      (``slot_positions`` decode mode — per-row cache appends and
+      per-row causal/window/pad masks).  One program for the engine's
+      lifetime.
+
+    Numerics are identical to :func:`generate` per request (greedy):
+    the lane sees exactly the same prefill forward and the same
+    masked decode steps it would in a static batch — RoPE scores
+    depend only on position differences and pad slots are masked, the
+    invariant tests/test_models.py::test_ragged_generate_matches_per_row
+    already pins down.  Composes with GQA, sliding-window attention,
+    int8 weights (dequant-per-step under a barrier, as generate
+    does), and the int8 KV cache (per-row quantized appends).
+
+    Per-slot state (``positions`` — next write index, ``pad_start``,
+    ``last_tok``, ``done``) lives ON DEVICE and is updated by the two
+    compiled programs themselves, so ``admit`` is a single async
+    dispatch (no host sync — on a tunneled chip a sync is a full
+    RTT); the only synchronizing pull is the chunk's token block,
+    which the scheduler needs anyway to make evict decisions.  The
+    host keeps just the ``active`` scheduling mask.
+    """
+
+    def __init__(self, model, params, num_slots, max_new_tokens, *,
+                 cache_len=None, chunk_size=16, pad_multiple=64,
+                 temperature=0.0, top_k=0, top_p=0.0, eos_id=None,
+                 seed=0):
+        import numpy as np
+
+        from tensorflowonspark_tpu import quantize as qz
+
+        self.model = model
+        self.num_slots = int(num_slots)
+        self.max_new_tokens = int(max_new_tokens)
+        self.chunk_size = max(1, min(int(chunk_size), self.max_new_tokens))
+        self.pad_multiple = max(1, int(pad_multiple))
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.eos_id = None if eos_id is None else int(eos_id)
+        cap = model.cfg.max_seq_len if cache_len is None else int(cache_len)
+        self.cache_len = min(cap, model.cfg.max_seq_len)
+        if self.cache_len <= self.max_new_tokens:
+            raise ValueError(
+                "cache_len ({0}) must exceed max_new_tokens ({1}) to "
+                "hold any prompt at all".format(
+                    self.cache_len, self.max_new_tokens
+                )
+            )
+        self._np = np
+        self._qz = qz
+        self._rng = jax.random.PRNGKey(int(seed))
+        self._n_keys = 0  # admissions + chunks, folds the rng stream
+        self._quantized = qz.is_quantized(params)
+        self._qparams = jax.tree.map(jnp.asarray, params)
+        # prefill is compute-bound: dequantize once, no barrier (the
+        # same trade generate() makes); the chunk path re-dequantizes
+        # per step under a barrier so weights cross HBM as int8
+        self._params = (
+            qz.dequantize_tree(self._qparams, model.cfg.jdtype,
+                               barrier=False)
+            if self._quantized else self._qparams
+        )
+        self.cache = init_cache(model, self.num_slots,
+                                cache_len=self.cache_len)
+        self.state = self._idle_state()
+        self.active = np.zeros((self.num_slots,), bool)
+        self._prefill_jit = jax.jit(self._prefill_impl)
+        self._chunk_jit = jax.jit(self._chunk_impl)
+
+    def _idle_state(self):
+        b = self.num_slots
+        return {
+            "positions": jnp.zeros((b,), jnp.int32),
+            # idle slots mask everything but self: pad_start=cache_len
+            "pad_start": jnp.full((b,), self.cache_len, jnp.int32),
+            "last_tok": jnp.zeros((b,), jnp.int32),
+            "done": jnp.ones((b,), jnp.bool_),
+        }
+
+    # -- compiled programs ---------------------------------------------
+
+    def _sample(self, logits, key):
+        return sample_logits(
+            logits, key, temperature=self.temperature,
+            top_k=self.top_k, top_p=self.top_p,
+        )
+
+    def _prefill_impl(self, params, cache, state, slot, tokens, pad, key):
+        """Slot-scoped prefill: lane ``slot`` of every cache bank gets
+        the bucketed prompt's KV, and the slot's state-vector entries
+        (position, pad region, first token, eos flag) are scattered in
+        place.  All shapes static per prompt bucket; ``slot`` is
+        traced (no recompilation on admit)."""
+        def _lane(leaf):
+            if getattr(leaf, "ndim", 0) == 4:  # [B, L, H, Dx] banks
+                return jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=0)
+            return jnp.zeros((), jnp.int32)  # position: prefill at 0
+
+        lane = jax.tree.map(_lane, cache)
+        logits, mut = self.model.apply(
+            {"params": params, "cache": lane}, tokens, decode=True,
+            mutable=["cache"], pad_start=pad,
+        )
+
+        def _merge(full, lane_leaf):
+            if getattr(full, "ndim", 0) == 4:
+                return jax.lax.dynamic_update_slice_in_dim(
+                    full, lane_leaf.astype(full.dtype), slot, axis=0
+                )
+            return full  # shared position counter: slot mode ignores it
+
+        cache = jax.tree.map(_merge, cache, mut["cache"])
+        first = self._sample(logits[:, -1], key)[0]
+        state = {
+            "positions": state["positions"].at[slot].set(tokens.shape[1]),
+            "pad_start": state["pad_start"].at[slot].set(pad[0]),
+            "last_tok": state["last_tok"].at[slot].set(first),
+            "done": state["done"].at[slot].set(
+                first == self.eos_id if self.eos_id is not None
+                else False
+            ),
+        }
+        return cache, state, first
+
+    def _chunk_impl(self, params, cache, state, active, keys):
+        """``chunk_size`` single-token decode steps over all slots with
+        per-slot positions; done rows keep emitting ``eos_id`` (the
+        static scan's contract), idle rows hold their pointer."""
+        def step(carry, key):
+            cache, pos, tok, done = carry
+            p = (
+                self._qz.dequantize_tree(
+                    params, self.model.cfg.jdtype, barrier=True
+                )
+                if self._quantized else params
+            )
+            logits, mut = self.model.apply(
+                {"params": p, "cache": cache}, tok[:, None], decode=True,
+                mutable=["cache"], pad_start=state["pad_start"],
+                slot_positions=pos,
+            )
+            nxt = self._sample(logits[:, 0], key)
+            if self.eos_id is not None:
+                nxt = jnp.where(done, jnp.int32(self.eos_id), nxt)
+                done = jnp.logical_or(done, nxt == self.eos_id)
+            # active rows advance (clamped: a completed-but-not-yet-
+            # evicted row must not run its pointer off the cache); idle
+            # rows hold still
+            pos = jnp.where(
+                active, jnp.minimum(pos + 1, self.cache_len - 1), pos
+            )
+            return (mut["cache"], pos, nxt, done), nxt
+
+        (cache, positions, last_tok, done), toks = jax.lax.scan(
+            step,
+            (cache, state["positions"], state["last_tok"], state["done"]),
+            keys,
+        )
+        state = dict(state, positions=positions, last_tok=last_tok,
+                     done=done)
+        return cache, state, jnp.swapaxes(toks, 0, 1)
+
+    # -- host-side slot operations -------------------------------------
+
+    def _next_key(self, n=None):
+        """One fresh key (``n=None``) or a ``[n, 2]`` stack (scan xs —
+        ``n=1`` still stacks, so chunk_size=1 scans one step, not two
+        key halves)."""
+        key = jax.random.fold_in(self._rng, self._n_keys)
+        self._n_keys += 1
+        return key if n is None else jax.random.split(key, n)
+
+    def bucket_len(self, prompt_len):
+        """Prompt-length bucket: round up to ``pad_multiple``, capped
+        so the bucket + max_new_tokens still fits the cache (the
+        static path's pad_cap rule)."""
+        m = self.pad_multiple
+        b = ((int(prompt_len) + m - 1) // m) * m
+        return max(int(prompt_len), min(b, self.cache_len
+                                        - self.max_new_tokens))
+
+    def free_slots(self):
+        return [i for i in range(self.num_slots) if not self.active[i]]
+
+    def admit(self, slot, prompt):
+        """Prefill ``prompt`` (1-D int tokens) into lane ``slot`` and
+        activate it.  Returns the first generated token as a DEVICE
+        scalar (the request's first output) without synchronizing —
+        the scheduler resolves it together with the next chunk's
+        block.  Raises when the prompt cannot fit
+        ``cache_len - max_new_tokens``."""
+        np = self._np
+        prompt = np.asarray(prompt, np.int32).ravel()
+        n = prompt.shape[0]
+        if n == 0:
+            raise ValueError("cannot admit an empty prompt")
+        if n + self.max_new_tokens > self.cache_len:
+            raise ValueError(
+                "prompt ({0}) + max_new_tokens ({1}) exceeds the "
+                "engine cache_len={2}".format(
+                    n, self.max_new_tokens, self.cache_len
+                )
+            )
+        if self.active[slot]:
+            raise ValueError("slot {0} is still active".format(slot))
+        b = self.bucket_len(n)
+        padded = np.zeros((1, b), np.int32)
+        padded[0, b - n:] = prompt
+        self.cache, self.state, first = self._prefill_jit(
+            self._params, self.cache, self.state, jnp.int32(slot),
+            jnp.asarray(padded), jnp.asarray([b - n], jnp.int32),
+            self._next_key(),
+        )
+        self.active[slot] = True
+        return first
+
+    def evict(self, slot):
+        """Free lane ``slot`` (between chunks) — host bookkeeping
+        only.  The lane's stale KV and state entries need no
+        scrubbing: a future request's causal mask only ever reaches
+        positions its own prefill/decode has re-written, and admit
+        rewrites the state entries."""
+        self.active[slot] = False
+
+    def reset(self):
+        """Return every slot to idle (between serving jobs).  The
+        cache banks stay as-is — stale KV is unreachable, see
+        :meth:`evict` — so a reused engine keeps its compiled
+        programs AND its device cache allocation."""
+        self.state = self._idle_state()
+        self.active[:] = False
+
+    def step_chunk(self):
+        """Run one compiled decode chunk over every slot.  Returns
+        ``[num_slots, chunk_size]`` int32 tokens (idle lanes emit
+        garbage — the scheduler only reads active lanes' rows).  The
+        ONLY synchronizing host pull in the engine."""
+        keys = self._next_key(self.chunk_size)
+        self.cache, self.state, toks = self._chunk_jit(
+            self._qparams if self._quantized else self._params,
+            self.cache, self.state, jnp.asarray(self.active), keys,
+        )
+        return self._np.asarray(toks)
+
+    def compile_counts(self):
+        """Compiled-program census: {"prefill": one per prompt bucket,
+        "chunk": 1}.  Admit/evict must never grow these (asserted in
+        tests/test_serving.py)."""
+        return {
+            "prefill": int(self._prefill_jit._cache_size()),
+            "chunk": int(self._chunk_jit._cache_size()),
+        }
+
+
 def serving_builder(params, config):
     """``model_ref`` target for serving exports: next-token logits for
     a ``tokens`` batch (see :mod:`tensorflowonspark_tpu.serving`).
@@ -839,6 +1188,52 @@ def serving_builder(params, config):
         # generate program is reused across batches (config:
         # pad_multiple)
         predict.pad_multiple = int(config.get("pad_multiple", 64))
+        # bucketing must never push a fitting prompt past the cache:
+        # cap the bucketed length at max_seq_len - max_new (ADVICE;
+        # predict_rows honors this when left-padding)
+        predict.pad_cap = max(1, cfg.max_seq_len - max_new)
+        # continuous in-flight batching (predict_rows
+        # schedule="continuous"): the scheduler builds a SlotDecoder
+        # per job.  config keys: chunk_size (decode steps between
+        # admit/evict points, default 16) and max_prompt_len (sizes
+        # the slot cache to bucket(max_prompt_len) + max_new instead
+        # of max_seq_len — decode re-reads the whole cache every
+        # step, so a right-sized cache is pure bandwidth savings).
+        chunk_size = int(config.get("chunk_size", 16))
+        max_prompt = config.get("max_prompt_len")
+        slot_decoders = {}
+
+        def make_slot_decoder(num_slots, chunk=None):
+            # memoized per (slots, chunk): a SlotDecoder owns its
+            # jitted programs, so a fresh instance per job would
+            # recompile prefill+chunk every predict_rows call; a
+            # reused one only resets its (host-side) slot table
+            key = (
+                int(num_slots),
+                int(chunk) if chunk is not None else chunk_size,
+            )
+            dec = slot_decoders.get(key)
+            if dec is not None:
+                dec.reset()
+                return dec
+            cache_len = cfg.max_seq_len
+            if max_prompt is not None:
+                m = predict.pad_multiple
+                b = ((int(max_prompt) + m - 1) // m) * m
+                cache_len = min(cfg.max_seq_len, b + max_new)
+            dec = SlotDecoder(
+                model, variables["params"], key[0], max_new,
+                cache_len=cache_len, chunk_size=key[1],
+                pad_multiple=predict.pad_multiple,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id, seed=int(config.get("seed", 0)),
+            )
+            slot_decoders[key] = dec
+            return dec
+
+        predict.make_slot_decoder = make_slot_decoder
+        predict.max_new_tokens = max_new
+        predict.eos_id = eos_id
         return predict
     return base.make_serving_predict(
         base.as_variables(params),

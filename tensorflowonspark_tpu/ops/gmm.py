@@ -152,18 +152,21 @@ def _gmm_dxt_kernel(te_ref, dy_ref, w_ref, dx_ref):
     ).astype(dx_ref.dtype)
 
 
-def _pick_bd(bm, d, f, bd):
+def _pick_bd(bm, d, f, bd, itemsize=2):
     """Output-dim block for the dx kernel: largest 128·2^k divisor of
     ``d`` (or full ``d``) whose double-buffered working set with a
     FULL-``f`` block fits the scoped-VMEM budget.  Full-width f blocks
     mean no stripe loop, so a group's weight block stays resident
     across its consecutive row tiles exactly like the forward.  Returns
     0 when ``f`` is too wide for any resident block (caller falls back
-    to the transposed-copy path)."""
+    to the transposed-copy path).  ``itemsize`` is the operand byte
+    width (ADVICE: the old hardcoded 2 undercounted float32 working
+    sets 2x, so a near-budget block could fail Mosaic VMEM
+    allocation)."""
     budget = 14 * 1024 * 1024
 
     def fits(c):
-        return 2 * 2 * (bm * f + c * f + bm * c) <= budget
+        return 2 * itemsize * (bm * f + c * f + bm * c) <= budget
 
     if bd is not None and d % bd == 0 and fits(bd):
         return min(bd, d)
@@ -192,7 +195,7 @@ def gmm_dxt_call(dy, w, tile_expert, *, bm=256, bd=None, interpret=None):
     assert n % bm == 0, (n, bm)
     t = n // bm
     assert tile_expert.shape == (t,), (tile_expert.shape, t)
-    bd = _pick_bd(bm, d, f, bd)
+    bd = _pick_bd(bm, d, f, bd, itemsize=dy.dtype.itemsize)
     if not bd:
         return None
     grid_spec = _grid_spec(

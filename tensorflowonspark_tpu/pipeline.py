@@ -538,9 +538,15 @@ class TFModel(TFParams, *_MODEL_MIXINS):
         - the output schema comes from, in priority order:
           ``args.output_schema`` (interchange list or struct string),
           the export's ``metadata.json`` ``output_schema`` key (write
-          it via ``save_for_serving(extra_metadata=...)``), or an
-          executor-side one-row probe (a ``take(1)``-scale job — the
-          only evaluation transform itself triggers).
+          it at export time via ``save_for_serving(...,
+          output_schema=serving.infer_output_schema(...))``), or — for
+          LEGACY exports only — an executor-side one-row probe.  The
+          probe is a ``take(1)``-scale job, but ``take(1)`` still
+          evaluates the predictor over partition 0's first BATCH and
+          discards the results before the real job re-runs it: for a
+          generation predictor that is a full compiled decode paid
+          twice, which is why metadata is the production path (a
+          warning is logged when the probe fires).
         """
         import json as _json
         import os as _os
@@ -571,6 +577,15 @@ class TFModel(TFParams, *_MODEL_MIXINS):
                 with open(meta_path) as f:
                     schema = _json.load(f).get("output_schema")
         if not schema:
+            logger.warning(
+                "no output_schema in args or export metadata (%s): "
+                "deriving it with a one-row probe job — this "
+                "evaluates the predictor over partition 0's first "
+                "batch TWICE (probe + real job).  Export with "
+                "save_for_serving(..., output_schema=serving."
+                "infer_output_schema(...)) to skip the probe.",
+                args.export_dir,
+            )
             probe = out_rdd.take(1)
             if not probe:
                 raise ValueError(

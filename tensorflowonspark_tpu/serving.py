@@ -116,7 +116,7 @@ def _stack_column(values):
     return np.stack([np.asarray(v) for v in values])
 
 
-def _stack_ragged_left(values, pad_value, multiple=1):
+def _stack_ragged_left(values, pad_value, multiple=1, cap=None):
     """Stack ragged 1-D rows by LEFT-padding to the batch max length
     (rounded up to ``multiple`` — shape BUCKETING, so the jitted
     generate program retraces once per bucket instead of once per
@@ -124,15 +124,21 @@ def _stack_ragged_left(values, pad_value, multiple=1):
     pad_counts [n] int32)``.  Left-padding keeps every row's real
     tokens ending at the same position, so the compiled decode scan
     starts uniformly (the model masks the pad slots via
-    ``pad_start``)."""
+    ``pad_start``).  ``cap`` bounds the BUCKETED length (generation
+    predictors set it to ``max_seq_len - max_new_tokens``): rounding
+    up must never push prompts that fit past the cache capacity; a
+    row genuinely longer than ``cap`` still stacks at its own length
+    and fails downstream with the model's capacity error."""
     arrs = [np.asarray(v) for v in values]
     if any(a.ndim != 1 for a in arrs):
         raise ValueError(
             "ragged padding supports 1-D token rows; got shapes %s"
             % ([a.shape for a in arrs],)
         )
-    max_len = max(a.shape[0] for a in arrs)
-    max_len = ((max_len + multiple - 1) // multiple) * multiple
+    raw_max = max(a.shape[0] for a in arrs)
+    max_len = ((raw_max + multiple - 1) // multiple) * multiple
+    if cap is not None:
+        max_len = max(raw_max, min(max_len, int(cap)))
     pads = np.asarray([max_len - a.shape[0] for a in arrs], np.int32)
     out = np.full((len(arrs), max_len), pad_value, arrs[0].dtype)
     for i, a in enumerate(arrs):
@@ -148,9 +154,10 @@ def predict_rows(
     output_mapping=None,
     batch_size=128,
     pad_to_batch=True,
+    schedule="static",
+    stats=None,
 ):
-    """Run ``predict`` over dict-rows in fixed-size batches; yields
-    output dict-rows.
+    """Run ``predict`` over dict-rows; yields output dict-rows.
 
     Args:
       predict: ``fn(batch: dict) -> dict`` of batched arrays.
@@ -160,10 +167,35 @@ def predict_rows(
       output_mapping: ``{output_name: column}`` for the emitted rows;
         defaults to the predictor's own output names.
       batch_size: rows per predict call (reference default 128,
-        TFParams.scala:14-18).
+        TFParams.scala:14-18); in continuous mode, the number of
+        in-flight KV-cache SLOTS.
       pad_to_batch: zero-pad the final short batch so the jitted
         predict never sees a new shape (outputs are truncated back).
+      schedule: ``"static"`` (fixed-size batches — every row in a
+        batch pays the batch's full decode) or ``"continuous"``
+        (in-flight batching for GENERATION predictors: finished rows
+        are evicted and queued rows admitted into the freed KV-cache
+        slots between chunked decode scans; requires a predictor
+        exposing ``make_slot_decoder``, see
+        ``transformer.serving_builder(mode="generate")`` and
+        docs/serving.md).
+      stats: optional dict the continuous scheduler fills with
+        per-request latency accounting (``latency_sec`` in input
+        order, plus admitted/evicted counters) — the serving bench's
+        p50/p99 source.
     """
+    if schedule not in ("static", "continuous"):
+        raise ValueError(
+            "schedule must be 'static' or 'continuous', got %r"
+            % (schedule,)
+        )
+    if schedule == "continuous":
+        for r in _predict_rows_continuous(
+            predict, rows, input_mapping, output_mapping, batch_size,
+            stats,
+        ):
+            yield r
+        return
     cols = sorted(input_mapping)
     buf = []
     # generation predictors declare ragged columns (prompts of varying
@@ -182,6 +214,7 @@ def predict_rows(
                 batch[name], batch[name + "_pad"] = _stack_ragged_left(
                     values, column_padding[name],
                     getattr(predict, "pad_multiple", 1),
+                    cap=getattr(predict, "pad_cap", None),
                 )
             else:
                 batch[name] = _stack_column(values)
@@ -216,6 +249,200 @@ def predict_rows(
     if buf:
         for r in _flush(buf):
             yield r
+
+
+def _apply_output_mapping(out, output_mapping):
+    if not output_mapping:
+        return out
+    missing = [n for n in output_mapping if n not in out]
+    if missing:
+        raise KeyError(
+            "output_mapping names {0} not produced by the predictor "
+            "(outputs: {1})".format(missing, sorted(out))
+        )
+    return {col: out[name] for name, col in output_mapping.items()}
+
+
+#: reserved input name: a row column mapped to it carries that
+#: request's token budget (continuous schedule only) — the scheduler
+#: evicts the row after ``min(max_new, budget)`` tokens even when no
+#: eos arrives, freeing its slot for the next queued prompt
+BUDGET_INPUT = "max_new"
+
+
+def _predict_rows_continuous(predict, rows, input_mapping,
+                             output_mapping, num_slots, stats):
+    """Continuous in-flight batching over a generation predictor.
+
+    The scheduler role of the serving-side tentpole (see
+    docs/serving.md): a request queue feeds ``num_slots`` KV-cache
+    slots; decode runs in compiled chunks
+    (:class:`~tensorflowonspark_tpu.models.transformer.SlotDecoder`),
+    and BETWEEN chunks finished rows (first eos, or the row's budget)
+    are evicted and queued prompts admitted into the freed lanes — so
+    a short row never pays a long neighbor's decode.  Rows are
+    yielded in INPUT order (completion order is recorded in
+    ``stats``); outputs are token-identical to the static
+    ``generate`` path per request (parity-tested).
+    """
+    import time as _time
+
+    factory = getattr(predict, "make_slot_decoder", None)
+    if factory is None:
+        raise ValueError(
+            "schedule='continuous' requires a generation predictor "
+            "exposing make_slot_decoder (see transformer."
+            "serving_builder with mode='generate'); this predictor "
+            "has none"
+        )
+    column_padding = getattr(predict, "column_padding", None) or {}
+    prompt_cols = [
+        c for c in input_mapping if input_mapping[c] in column_padding
+    ]
+    if len(prompt_cols) != 1:
+        raise ValueError(
+            "continuous scheduling needs exactly one ragged prompt "
+            "column in input_mapping; got {0}".format(prompt_cols)
+        )
+    prompt_col = prompt_cols[0]
+    budget_cols = [
+        c for c in input_mapping if input_mapping[c] == BUDGET_INPUT
+    ]
+    budget_col = budget_cols[0] if budget_cols else None
+
+    decoder = factory(num_slots)
+    max_new = decoder.max_new_tokens
+    eos_id = decoder.eos_id
+    fill = eos_id if eos_id is not None else 0
+    now = _time.perf_counter
+
+    if stats is None:
+        stats = {}
+    stats["latency_sec"] = {}
+    stats["admitted"] = 0
+    stats["chunks"] = 0
+    stats["chunk_size"] = decoder.chunk_size
+
+    it = iter(rows)
+    pending = []
+    state = {"n_in": 0, "exhausted": False}
+    slot_req = {}   # slot -> in-flight request record
+    finished = {}   # input idx -> output row
+    emit_at = {"next": 0}
+
+    def _pull():
+        if state["exhausted"]:
+            return
+        try:
+            row = next(it)
+        except StopIteration:
+            state["exhausted"] = True
+            return
+        budget = max_new
+        if budget_col is not None:
+            budget = max(1, min(int(row[budget_col]), max_new))
+        pending.append({
+            "idx": state["n_in"],
+            "prompt": np.asarray(row[prompt_col]),
+            "budget": budget,
+            "eos_at": None,
+            "out": None,
+            "submit": now(),
+        })
+        state["n_in"] += 1
+
+    def _finalize(req, t_done):
+        arr = np.full((max_new,), fill, np.int32)
+        toks = req["out"][:max_new]
+        arr[: len(toks)] = toks
+        gen_len = (
+            req["eos_at"] if req["eos_at"] is not None else req["budget"]
+        )
+        out = {"generated": arr}
+        if eos_id is not None or budget_col is not None:
+            out["generated_len"] = np.int32(gen_len)
+        finished[req["idx"]] = _apply_output_mapping(out, output_mapping)
+        stats["latency_sec"][req["idx"]] = t_done - req["submit"]
+
+    def _admit_free():
+        for slot in decoder.free_slots():
+            if not pending:
+                _pull()
+            if not pending:
+                return
+            req = pending.pop(0)
+            # admit is a single ASYNC dispatch; the first token comes
+            # back as an unsynchronized device scalar, resolved at the
+            # next chunk boundary together with the token block
+            req["out"] = [decoder.admit(slot, req["prompt"])]
+            stats["admitted"] += 1
+            slot_req[slot] = req
+
+    def _consume(req, chunk_row):
+        """Fold a slot's chunk tokens into its request; True when the
+        request completed (first eos, or its budget)."""
+        if req["out"] and not isinstance(req["out"][0], int):
+            first = int(np.asarray(req["out"][0]))
+            req["out"][0] = first
+            if eos_id is not None and first == eos_id:
+                req["eos_at"] = 0
+        for t in (() if chunk_row is None else chunk_row):
+            if req["eos_at"] is not None or len(req["out"]) >= req["budget"]:
+                break
+            req["out"].append(int(t))
+            if eos_id is not None and int(t) == eos_id:
+                req["eos_at"] = len(req["out"]) - 1
+        return req["eos_at"] is not None or len(req["out"]) >= req["budget"]
+
+    while True:
+        _admit_free()
+        if not slot_req:
+            while emit_at["next"] in finished:
+                yield finished.pop(emit_at["next"])
+                emit_at["next"] += 1
+            if pending or not state["exhausted"]:
+                # only reachable when there are zero slots; guard
+                # against an impossible-progress spin
+                raise RuntimeError(
+                    "continuous scheduler cannot make progress "
+                    "(no slots available)"
+                )
+            return
+        toks = decoder.step_chunk()
+        stats["chunks"] += 1
+        t_chunk = now()
+        for slot, req in list(slot_req.items()):
+            if _consume(req, toks[slot]):
+                _finalize(req, t_chunk)
+                decoder.evict(slot)
+                del slot_req[slot]
+        # stream completed rows in input order as soon as the head of
+        # the reorder buffer is ready
+        while emit_at["next"] in finished:
+            yield finished.pop(emit_at["next"])
+            emit_at["next"] += 1
+
+
+def infer_output_schema(predict, sample_row, input_mapping,
+                        output_mapping=None):
+    """Derive the output DataFrame schema of ``predict`` by running ONE
+    row through :func:`predict_rows` — at EXPORT time, so the schema
+    can be written into the serving metadata
+    (``save_for_serving(..., output_schema=...)``) and the
+    distributed transform never has to run its legacy one-row probe
+    job (which evaluates the predictor over a whole partition-0 batch
+    and throws the results away — a full compiled decode, twice, for
+    generation exports; see pipeline.TFModel._transform_native).
+
+    Returns an interchange field list ``[(column, type_str), ...]``.
+    """
+    from tensorflowonspark_tpu.pipeline import _infer_output_type
+
+    out = next(iter(predict_rows(
+        predict, [sample_row], input_mapping, output_mapping,
+        batch_size=1,
+    )))
+    return [(name, _infer_output_type(out[name])) for name in sorted(out)]
 
 
 # ----------------------------------------------------------------------
@@ -271,6 +498,13 @@ def main(argv=None):
     p.add_argument("--output", required=True,
                    help="output directory for JSON-line part files")
     p.add_argument("--batch_size", type=int, default=128)
+    p.add_argument("--schedule", choices=("static", "continuous"),
+                   default="static",
+                   help="batching schedule: 'static' fixed-size "
+                        "batches, or 'continuous' in-flight batching "
+                        "for generation exports (slot-level KV-cache "
+                        "scheduler; batch_size = in-flight slots — "
+                        "see docs/serving.md)")
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu.data import interchange
@@ -291,12 +525,23 @@ def main(argv=None):
     fs_utils.makedirs(args.output)
     out_path = fs_utils.join(args.output, "part-00000.jsonl")
     count = 0
+    sched_stats = {}
     with fs_utils.open_file(out_path, "w") as f:
         for out_row in predict_rows(
-            predict, rows, input_mapping, output_mapping, args.batch_size
+            predict, rows, input_mapping, output_mapping,
+            args.batch_size, schedule=args.schedule, stats=sched_stats,
         ):
             f.write(json.dumps(out_row, default=_json_default) + "\n")
             count += 1
+    if sched_stats.get("latency_sec"):
+        lat = sorted(sched_stats["latency_sec"].values())
+        logger.info(
+            "continuous schedule: %d admitted over %d chunks, "
+            "per-request latency p50=%.1fms p99=%.1fms",
+            sched_stats["admitted"], sched_stats["chunks"],
+            1e3 * lat[len(lat) // 2],
+            1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        )
     logger.info("wrote %d predictions to %s", count, out_path)
     return count
 

@@ -280,6 +280,56 @@ class TestTransformer:
                 err_msg="row %d (len %d)" % (i, len(p)),
             )
 
+    def test_generated_len_matches_first_eos(self):
+        # the eos contract (generate() docstring): serving emits rows
+        # UNTRIMMED at [B, max_new] plus a generated_len column equal
+        # to the FIRST eos position (max_new when no eos); the consumer
+        # trims row[:generated_len]
+        from tensorflowonspark_tpu import serving
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(max_seq_len=64)
+        prompts = [
+            np.asarray(p, np.int32)
+            for p in (
+                jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0, 64)
+            )
+        ]
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 6), jnp.int32)
+        )["params"]
+        free = np.asarray(
+            tr.generate(model, params, jnp.asarray(prompts[0][None]), 10)
+        )
+        eos = int(free[0, 2])
+        predict = tr.serving_builder(
+            jax.tree.map(np.asarray, params),
+            {
+                "vocab_size": 64, "num_layers": 2, "num_heads": 2,
+                "head_dim": 8, "embed_dim": 16, "mlp_dim": 32,
+                "max_seq_len": 64, "dtype": "float32",
+                "mode": "generate", "max_new_tokens": 10,
+                "pad_multiple": 8, "eos_id": eos,
+            },
+        )
+        out = list(serving.predict_rows(
+            predict, [{"prompt": p} for p in prompts],
+            {"prompt": "tokens"}, batch_size=2,
+        ))
+        for r in out:
+            gen = np.asarray(r["generated"])
+            n = int(r["generated_len"])
+            assert gen.shape == (10,)  # untrimmed: static scan shape
+            hits = np.where(gen == eos)[0]
+            assert n == (int(hits[0]) if hits.size else 10)
+            # everything from the first eos on is eos (consumer trims)
+            if hits.size:
+                assert (gen[n:] == eos).all()
+        # row 0 stops where the free run first emitted the eos value
+        assert int(out[0]["generated_len"]) == int(
+            np.where(free[0] == eos)[0][0]
+        )
+
     def test_speculative_input_validation(self):
         # ADVICE r4: max_new_tokens<=0 early-returns [B, 0] without
         # allocating a cache; ngram<1 raises (ngram=0 made every

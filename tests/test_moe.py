@@ -179,6 +179,26 @@ class TestGroupedMatmul:
         assert gmm_ops._pick_bd(256, 1024, 4096, None) > 0
         assert gmm_ops._pick_bd(256, 1024, 1 << 22, None) == 0
 
+    def test_pick_bd_scales_with_itemsize(self):
+        # ADVICE: the VMEM fit estimate must use the operand byte
+        # width — float32 working sets are 2x bf16, so a block that
+        # just fits at itemsize=2 must shrink (or vanish) at 4, and
+        # every accepted block's double-buffered working set must
+        # stay under the 14MB scoped-VMEM budget
+        budget = 14 * 1024 * 1024
+        for bm, d, f in ((256, 1024, 4096), (256, 2048, 8192),
+                         (512, 1024, 2048)):
+            b2 = gmm_ops._pick_bd(bm, d, f, None, itemsize=2)
+            b4 = gmm_ops._pick_bd(bm, d, f, None, itemsize=4)
+            assert b4 <= b2
+            for itemsize, b in ((2, b2), (4, b4)):
+                if b:
+                    ws = 2 * itemsize * (bm * f + b * f + bm * b)
+                    assert ws <= budget, (itemsize, b, ws)
+        # a shape where the f32 working set cannot fit but bf16 can
+        assert gmm_ops._pick_bd(256, 1024, 8192, None, itemsize=2) > 0
+        assert gmm_ops._pick_bd(256, 1024, 8192, None, itemsize=4) == 0
+
     def test_absent_expert_gets_zero_grad(self):
         # expert never referenced by any tile -> dw exactly 0 there
         x, w, _ = self._case(seed=2)

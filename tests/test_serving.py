@@ -1,8 +1,11 @@
 """Serving-path tests (reference: Inference.scala / TFModel.scala roles).
 
 Covers the predictor-builder contract, batched row prediction with
-padding, and the CLI end-to-end: TFRecords in → JSON-line predictions
-out (reference: src/test/scala + Inference.scala:52-79).
+padding, the CLI end-to-end (TFRecords in → JSON-line predictions out,
+reference: src/test/scala + Inference.scala:52-79), and the CONTINUOUS
+in-flight batching schedule (slot-level KV-cache scheduler — parity vs
+the static path, eviction on eos / per-request budget, and the
+no-recompilation-on-admit contract).
 """
 
 import json
@@ -87,6 +90,259 @@ def test_parse_mapping_forms():
     assert serving._parse_mapping("a=x, b=y") == {"a": "x", "b": "y"}
     with pytest.raises(ValueError):
         serving._parse_mapping("missing_equals")
+
+
+def test_stack_ragged_left_caps_bucket_at_cap():
+    rows = [np.arange(10, dtype=np.int32), np.arange(3, dtype=np.int32)]
+    # no cap: 10 rounds up to 16
+    stacked, pads = serving._stack_ragged_left(rows, 0, multiple=16)
+    assert stacked.shape == (2, 16) and list(pads) == [6, 13]
+    # cap 12: the BUCKET clamps to 12 (>= the raw max, so data fits)
+    stacked, pads = serving._stack_ragged_left(rows, 0, multiple=16, cap=12)
+    assert stacked.shape == (2, 12) and list(pads) == [2, 9]
+    # cap below the raw max: stack at the raw max (downstream raises
+    # the model's capacity error for genuinely-too-long prompts)
+    stacked, _ = serving._stack_ragged_left(rows, 0, multiple=16, cap=8)
+    assert stacked.shape == (2, 10)
+
+
+# ----------------------------------------------------------------------
+# generation schedules: static bucketing cap + continuous batching
+# ----------------------------------------------------------------------
+
+TINY = {
+    "vocab_size": 64, "num_layers": 2, "num_heads": 2, "head_dim": 8,
+    "embed_dim": 16, "mlp_dim": 32, "max_seq_len": 96, "dtype": "float32",
+}
+
+
+def _gen_predict(max_new=6, extra=None, tiny=None):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    tiny = dict(tiny or TINY)
+    model = tr.Transformer(
+        tr.TransformerConfig(
+            **{k: v for k, v in tiny.items()}
+        )
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cfg = dict(tiny, mode="generate", max_new_tokens=max_new,
+               pad_multiple=16, **(extra or {}))
+    predict = tr.serving_builder(
+        jax.tree.map(np.asarray, params), cfg
+    )
+    return model, params, predict
+
+
+def _prompts(lens, vocab=64, seed=13):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+def test_generate_bucket_cap_regression():
+    # ADVICE (transformer.py:841): pad_multiple bucketing used to round
+    # a fitting prompt PAST max_seq_len - max_new and raise "exceeds"
+    # from generate(); the bucketed length now caps at the cache
+    # capacity.  max_seq_len=24, max_new=6 -> cap 18; a 17-token prompt
+    # would bucket to 32 without the cap.
+    import jax
+
+    _, _, predict = _gen_predict(
+        max_new=6, tiny=dict(TINY, max_seq_len=24)
+    )
+    assert predict.pad_cap == 18
+    rows = [{"prompt": p} for p in _prompts([17, 11])]
+    out = list(serving.predict_rows(
+        predict, rows, {"prompt": "tokens"}, batch_size=2
+    ))
+    assert len(out) == 2
+    assert all(r["generated"].shape == (6,) for r in out)
+
+
+class TestContinuous:
+    def _rows(self, lens, **extra_cols):
+        prompts = _prompts(lens)
+        rows = [{"prompt": p} for p in prompts]
+        for k, vals in extra_cols.items():
+            for r, v in zip(rows, vals):
+                r[k] = v
+        return prompts, rows
+
+    def test_matches_static_generate_per_request(self):
+        import jax.numpy as jnp
+
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, params, predict = _gen_predict(max_new=6)
+        prompts, rows = self._rows([4, 7, 11, 2, 9, 14, 5, 6])
+        out = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=3,
+            schedule="continuous",
+        ))
+        assert len(out) == len(prompts)
+        for i, p in enumerate(prompts):
+            want = tr.generate(model, params, jnp.asarray(p[None]), 6)
+            np.testing.assert_array_equal(
+                np.asarray(out[i]["generated"]), np.asarray(want[0]),
+                err_msg="row %d (len %d)" % (i, len(p)),
+            )
+
+    def test_eos_eviction_matches_static_and_generated_len(self):
+        # eviction on first eos: outputs and generated_len must match
+        # the static path at the SAME per-row bucketing (batch_size=1
+        # — both schedules then left-pad identically, so parity is
+        # exact, not just up-to-rounding; see docs/serving.md)
+        model, params, predict0 = _gen_predict(max_new=8)
+        prompts, rows = self._rows([4, 7, 11, 2, 9])
+        free = list(serving.predict_rows(
+            predict0, rows, {"prompt": "tokens"}, batch_size=1
+        ))
+        eos = int(np.asarray(free[0]["generated"])[2])
+        _, _, predict = _gen_predict(max_new=8, extra={"eos_id": eos})
+        ref = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=1
+        ))
+        stats = {}
+        got = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", stats=stats,
+        ))
+        for i in range(len(rows)):
+            np.testing.assert_array_equal(
+                np.asarray(got[i]["generated"]),
+                np.asarray(ref[i]["generated"]), err_msg=str(i),
+            )
+            assert int(got[i]["generated_len"]) == int(
+                ref[i]["generated_len"]
+            )
+        assert stats["admitted"] == len(rows)
+        assert len(stats["latency_sec"]) == len(rows)
+
+    def test_budget_eviction_serves_prefixes(self):
+        # per-request token budgets (reserved input name "max_new"):
+        # each row is evicted at its budget and its tokens match the
+        # static path's prefix
+        budgets = [2, 6, 1, 4, 3]
+        model, params, predict = _gen_predict(max_new=6)
+        prompts, rows = self._rows([4, 7, 11, 2, 9], max_new=budgets)
+        ref = list(serving.predict_rows(
+            predict, [{"prompt": p} for p in prompts],
+            {"prompt": "tokens"}, batch_size=1,
+        ))
+        got = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens", "max_new": "max_new"},
+            batch_size=2, schedule="continuous",
+        ))
+        for i, b in enumerate(budgets):
+            np.testing.assert_array_equal(
+                np.asarray(got[i]["generated"])[:b],
+                np.asarray(ref[i]["generated"])[:b], err_msg=str(i),
+            )
+            assert int(got[i]["generated_len"]) == b
+
+    def test_flagship_feature_composition_parity(self):
+        # the recorded serving config's feature stack at test scale:
+        # GQA (Hkv < H) + sliding-window attention + int8 WEIGHTS +
+        # int8 KV cache, through admit/evict slot reuse — exact token
+        # parity vs the static path at the same bucketing
+        tiny = dict(
+            TINY, num_heads=4, num_kv_heads=2, attention_window=8,
+            cache_dtype="int8",
+        )
+        _, _, predict = _gen_predict(
+            max_new=5, tiny=tiny, extra={"quantize": "int8"}
+        )
+        prompts, rows = self._rows([4, 7, 11, 2, 9, 13, 3])
+        ref = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=1
+        ))
+        got = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous",
+        ))
+        for i in range(len(rows)):
+            np.testing.assert_array_equal(
+                np.asarray(got[i]["generated"]),
+                np.asarray(ref[i]["generated"]), err_msg=str(i),
+            )
+
+    def test_no_recompilation_on_admit_evict(self):
+        # the compiled-program census must not grow with admissions,
+        # evictions, slot choice, or a SECOND predict_rows job: one
+        # prefill per prompt-length bucket + one chunk program, ever
+        model, params, predict = _gen_predict(max_new=4)
+        decoder = predict.make_slot_decoder(3)
+        prompts, rows = self._rows([4, 7, 11, 2, 9, 14, 5, 6, 3, 12])
+        list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=3,
+            schedule="continuous",
+        ))
+        counts = decoder.compile_counts()
+        buckets = {decoder.bucket_len(len(p)) for p in prompts}
+        assert counts == {"prefill": len(buckets), "chunk": 1}
+        # a second job over MORE rows (same buckets), different slots,
+        # reuses the same decoder and the same compiled programs
+        prompts2, rows2 = self._rows([6, 5, 9, 2, 13, 4, 7, 8])
+        list(serving.predict_rows(
+            predict, rows2, {"prompt": "tokens"}, batch_size=3,
+            schedule="continuous",
+        ))
+        assert predict.make_slot_decoder(3) is decoder
+        assert decoder.compile_counts() == counts
+
+    def test_requires_generation_predictor(self, tmp_path):
+        export_dir = _export(tmp_path)
+        predict = serving.load_predictor(export_dir, use_cache=False)
+        with pytest.raises(ValueError, match="make_slot_decoder"):
+            list(serving.predict_rows(
+                predict, [{"col": [1.0, 2.0]}],
+                {"col": "features"}, batch_size=2,
+                schedule="continuous",
+            ))
+        with pytest.raises(ValueError, match="schedule"):
+            list(serving.predict_rows(
+                predict, [], {"col": "features"}, schedule="nope"
+            ))
+
+    def test_admit_rejects_oversized_prompt(self):
+        _, _, predict = _gen_predict(
+            max_new=6, tiny=dict(TINY, max_seq_len=24)
+        )
+        with pytest.raises(ValueError, match="exceeds"):
+            list(serving.predict_rows(
+                predict, [{"prompt": np.arange(20, dtype=np.int32)}],
+                {"prompt": "tokens"}, batch_size=2,
+                schedule="continuous",
+            ))
+
+
+def test_infer_output_schema_and_export_metadata(tmp_path):
+    # export-time schema derivation (satellite of the probe-waste fix:
+    # pipeline's native transform reads output_schema from metadata
+    # instead of double-evaluating partition 0)
+    from tensorflowonspark_tpu.checkpoint import save_for_serving
+    from tensorflowonspark_tpu.models.linear import serving_builder
+
+    predict = serving_builder({"w": W, "b": np.float32(0.5)},
+                              {"input_name": "features"})
+    schema = serving.infer_output_schema(
+        predict, {"col": np.zeros(2, np.float32)}, {"col": "features"}
+    )
+    assert schema == [("prediction", "float")]
+    export_dir = str(tmp_path / "schema_export")
+    save_for_serving(
+        export_dir, {"w": W, "b": np.float32(0.5)},
+        extra_metadata={"model_config": {"input_name": "features"}},
+        output_schema=schema,
+    )
+    with open(os.path.join(export_dir, "metadata.json")) as f:
+        meta = json.load(f)
+    assert meta["output_schema"] == [["prediction", "float"]]
 
 
 def test_cli_end_to_end(tmp_path):
