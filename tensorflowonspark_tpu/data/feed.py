@@ -472,9 +472,21 @@ def _batch_len(batch):
 
 
 def _stack_batch(batch):
-    """Rows → columnar numpy arrays (host-side, ready for device_put)."""
+    """Rows → columnar numpy arrays (host-side, ready for device_put).
+
+    Fast path: homogeneous row lists stack in ONE ``np.asarray`` —
+    the old ``np.stack([np.asarray(r) for r in batch])`` materialized
+    every row twice (per-row array + the stacked copy).  Ragged or
+    object rows fall back to the per-row path (whose ``np.stack``
+    raises the same shape error it always did)."""
     if isinstance(batch, dict):
         return {k: np.asarray(v) for k, v in batch.items()}
+    try:
+        arr = np.asarray(batch)
+    except ValueError:
+        arr = None  # ragged rows: modern numpy refuses the single pass
+    if arr is not None and arr.dtype != object:
+        return arr
     rows = [np.asarray(r) for r in batch]
     return np.stack(rows)
 
@@ -501,8 +513,12 @@ def prefetch_to_device(iterator, size=2, sharding=None):
     (SURVEY.md §7 'Hard parts: feed-path throughput').
 
     Args:
-      iterator: yields pytrees of numpy arrays (or ``(batch, n)`` tuples).
-      size: number of in-flight device batches.
+      iterator: yields pytrees of numpy arrays (or ``(batch, n)`` tuples
+        from ``batches(pad_to_batch=True)`` — the batch is device-put,
+        the valid-row count ``n`` STAYS a host int: shipping it to HBM
+        made every consumer that reads the count pay a device→host sync
+        per batch).
+      size: number of in-flight device batches (>= 1).
       sharding: optional ``jax.sharding.Sharding`` for multi-chip
         placement of each batch (data-parallel feeding).
     """
@@ -510,14 +526,30 @@ def prefetch_to_device(iterator, size=2, sharding=None):
 
     import jax
 
+    if size < 1:
+        raise ValueError(
+            "prefetch_to_device size must be >= 1, got {0}".format(size)
+        )
+
     q = collections.deque()
 
-    def put(item):
+    def put_tree(tree):
         if sharding is not None:
             return jax.tree_util.tree_map(
-                lambda x: jax.device_put(x, sharding), item
+                lambda x: jax.device_put(x, sharding), tree
             )
-        return jax.tree_util.tree_map(jax.device_put, item)
+        return jax.tree_util.tree_map(jax.device_put, tree)
+
+    def put(item):
+        # (batch, n) from pad_to_batch: only the batch goes to device;
+        # the host-side row count must never become a device scalar
+        if (
+            isinstance(item, tuple)
+            and len(item) == 2
+            and isinstance(item[1], (int, np.integer))
+        ):
+            return (put_tree(item[0]), int(item[1]))
+        return put_tree(item)
 
     for item in iterator:
         q.append(put(item))

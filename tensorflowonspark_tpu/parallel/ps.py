@@ -30,6 +30,20 @@ named specs (``("adam", {"learning_rate": 1e-3})``) resolved against
 the server's own numpy implementations, never deserialized code.
 Leafwise optimizers only (sgd/momentum/adagrad/adam): each shard
 updates its leaves independently, which is exact for these rules.
+
+Gradient-plane extensions (docs/communication.md):
+
+- **Codecs** — a tensor entry may carry a ``codec`` name plus per-part
+  metadata; payloads are the codec's encoded parts
+  (:mod:`tensorflowonspark_tpu.compress`: int8 quantization, top-k
+  sparsification) and ``recv_msg`` decodes back to dense arrays.  The
+  client compresses gradient pushes (with error feedback); the server,
+  once a connection negotiates a reply codec via the ``codec`` op,
+  compresses push/pull replies as **deltas** against that connection's
+  tracked client view instead of shipping ``dict(self._params)`` dense.
+- **Zero-copy sends** — frames go out via ``socket.sendmsg``
+  scatter-gather over memoryviews of the C-contiguous payloads; no
+  ``tobytes()``/``b"".join`` materialization of the concatenated frame.
 """
 
 import json
@@ -39,6 +53,8 @@ import struct
 import threading
 
 import numpy as np
+
+from tensorflowonspark_tpu import compress as compress_mod
 
 logger = logging.getLogger(__name__)
 
@@ -60,41 +76,134 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def send_msg(sock, header, tensors=None):
-    """Send ``header`` (JSON-able dict) plus named numpy ``tensors``."""
-    tensors = tensors or {}
+#: sendmsg iovec batch bound (Linux IOV_MAX is 1024; stay well under)
+_IOV_MAX = 512
+
+
+def _sendmsg_all(sock, views):
+    """Scatter-gather send of a list of memoryviews; returns total
+    bytes.  The zero-copy wire path: payload arrays are handed to the
+    kernel in place instead of being concatenated into one big
+    ``bytes`` (the old path copied every tensor per message).  Falls
+    back to ``sendall`` where ``sendmsg`` is unavailable."""
+    total = sum(v.nbytes for v in views)
+    if not hasattr(sock, "sendmsg"):
+        sock.sendall(b"".join(views))
+        return total
+    pending = [v for v in views if v.nbytes]
+    while pending:
+        sent = sock.sendmsg(pending[:_IOV_MAX])
+        while sent > 0 and pending:
+            v = pending[0]
+            if sent >= v.nbytes:
+                sent -= v.nbytes
+                pending.pop(0)
+            else:
+                pending[0] = v[sent:]
+                sent = 0
+    return total
+
+
+def _part_meta(p):
+    return {"dtype": p.dtype.str, "shape": list(p.shape),
+            "nbytes": int(p.nbytes)}
+
+
+def _send_frame(sock, header, entries):
+    """Lay one frame on the socket: ``entries`` is a list of
+    ``(tensor_meta, [payload arrays])``; returns bytes sent."""
     meta = []
     payloads = []
+    for m, parts in entries:
+        parts = [np.ascontiguousarray(p) for p in parts]
+        if m.get("codec"):
+            m = dict(m, parts=[_part_meta(p) for p in parts])
+        meta.append(m)
+        payloads.extend(parts)
+    hb = json.dumps(dict(header, tensors=meta)).encode("utf-8")
+    views = [memoryview(struct.pack(">I", len(hb))), memoryview(hb)]
+    views.extend(memoryview(p).cast("B") for p in payloads)
+    return _sendmsg_all(sock, views)
+
+
+def send_msg(sock, header, tensors=None, codec=None):
+    """Send ``header`` (JSON-able dict) plus named numpy ``tensors``.
+
+    With ``codec`` (a :class:`~tensorflowonspark_tpu.compress.Codec` or
+    :class:`~tensorflowonspark_tpu.compress.ErrorFeedback`), each
+    tensor ships as the codec's encoded parts and the per-tensor meta
+    gains the codec header ``recv_msg`` decodes by.  Returns the total
+    bytes laid on the wire (header + payloads) — the tunnel-traffic
+    accounting the wire tests and bench rows use.
+    """
+    tensors = tensors or {}
+    entries = []
     for name, arr in tensors.items():
-        arr = np.ascontiguousarray(arr)
-        meta.append(
-            {
-                "name": name,
-                "dtype": arr.dtype.str,
-                "shape": list(arr.shape),
-                "nbytes": int(arr.nbytes),
-            }
+        if codec is not None and not isinstance(codec, compress_mod.NoneCodec):
+            if hasattr(codec, "encode_named"):  # error-feedback wrapper
+                parts, cmeta = codec.encode_named(name, arr)
+            else:
+                parts, cmeta = codec.encode(np.asarray(arr))
+            entries.append(
+                ({"name": name, "codec": codec.name, "meta": cmeta}, parts)
+            )
+        else:
+            arr = np.ascontiguousarray(arr)
+            entries.append((dict(_part_meta(arr), name=name), [arr]))
+    return _send_frame(sock, header, entries)
+
+
+def _recv_part(sock, m):
+    """Receive one payload described by part-meta ``m``; malformed meta
+    (nbytes disagreeing with dtype x shape — a corrupt or hostile
+    frame) is rejected as ConnectionError before any allocation, the
+    same posture as the tfrecord codec's corruption checks."""
+    try:
+        dtype = np.dtype(str(m["dtype"]))
+        shape = tuple(int(s) for s in m["shape"])
+        nbytes = int(m["nbytes"])
+    except (KeyError, TypeError, ValueError) as e:
+        raise ConnectionError("bad tensor meta: {0}".format(e))
+    expect = int(dtype.itemsize * int(np.prod(shape, dtype=np.int64)))
+    if nbytes != expect or nbytes < 0 or any(s < 0 for s in shape):
+        raise ConnectionError(
+            "tensor meta nbytes {0} inconsistent with dtype/shape "
+            "({1} expected)".format(nbytes, expect)
         )
-        payloads.append(arr)
-    header = dict(header, tensors=meta)
-    hb = json.dumps(header).encode("utf-8")
-    parts = [struct.pack(">I", len(hb)), hb]
-    parts.extend(memoryview(p).cast("B") for p in payloads)
-    sock.sendall(b"".join(parts))
+    raw = _recv_exact(sock, nbytes)
+    return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
 
 def recv_msg(sock):
-    """Receive one message → ``(header, {name: np.ndarray})``."""
+    """Receive one message → ``(header, {name: np.ndarray})``.
+
+    Codec-carrying tensors are decoded to dense arrays here, so every
+    consumer (the shard's ``update()``, the client's unshard) sees
+    plain numpy regardless of what crossed the wire.  Undecodable or
+    inconsistent frames raise ``ConnectionError``.
+    """
     (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
     if hlen > _MAX_HEADER:
         raise ConnectionError("header length {0} exceeds limit".format(hlen))
-    header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    try:
+        header = json.loads(_recv_exact(sock, hlen).decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise ConnectionError("undecodable frame header: {0}".format(e))
+    if not isinstance(header, dict):
+        raise ConnectionError("frame header is not an object")
     tensors = {}
     for m in header.get("tensors", ()):
-        raw = _recv_exact(sock, m["nbytes"])
-        tensors[m["name"]] = np.frombuffer(raw, dtype=np.dtype(m["dtype"])).reshape(
-            m["shape"]
-        )
+        if m.get("codec"):
+            codec = compress_mod.get_codec(str(m["codec"]))
+            parts = [_recv_part(sock, pm) for pm in m.get("parts", ())]
+            try:
+                tensors[m["name"]] = codec.decode(parts, m.get("meta") or {})
+            except (KeyError, TypeError, ValueError, IndexError) as e:
+                raise ConnectionError(
+                    "codec {0} decode failed: {1}".format(m["codec"], e)
+                )
+        else:
+            tensors[m["name"]] = _recv_part(sock, m)
     return header, tensors
 
 
@@ -165,6 +274,66 @@ def _build_optimizer(spec):
 # ----------------------------------------------------------------------
 # server
 # ----------------------------------------------------------------------
+
+
+class _ReplyCompressor(object):
+    """Per-connection compressed-delta reply state.
+
+    Once a connection negotiates a reply codec (the ``codec`` wire op),
+    params replies stop shipping ``dict(self._params)`` dense: for each
+    tensor the server tracks the *client view* — exactly what the
+    client has reconstructed so far — and sends the lossy-encoded delta
+    against it.  The view advances by the server's own decode of the
+    encoded delta (bit-identical to the client's decode of the same
+    bytes), so encoding error never drifts the two sides apart: any
+    residual stays inside the next ``params - view`` delta — the
+    downlink twin of client-side error feedback.
+
+    First sight of a tensor name (or a shape change after an elastic
+    restart) ships dense, establishing the base.
+    """
+
+    def __init__(self):
+        self.codec = None
+        self._view = {}
+
+    def negotiate(self, spec):
+        codec = compress_mod.get_codec(spec)
+        if codec is not None and isinstance(codec, compress_mod.NoneCodec):
+            codec = None
+        self.codec = codec
+        self._view.clear()
+
+    def entries(self, tensors):
+        """Frame entries for a params reply (see ``_send_frame``)."""
+        entries = []
+        for name, arr in tensors.items():
+            arr = np.asarray(arr)
+            view = self._view.get(name)
+            if view is None or view.shape != arr.shape:
+                self._view[name] = arr.astype(np.float32, copy=True)
+                dense = np.ascontiguousarray(arr)
+                entries.append((dict(_part_meta(dense), name=name), [dense]))
+                continue
+            delta = arr.astype(np.float32, copy=False) - view
+            parts, meta = self.codec.encode(delta)
+            approx = self.codec.decode(
+                [p.copy() for p in parts], meta
+            ).astype(np.float32, copy=False)
+            self._view[name] = view + approx
+            entries.append(
+                (
+                    {
+                        "name": name,
+                        "codec": self.codec.name,
+                        "meta": meta,
+                        "delta": True,
+                        "pdtype": arr.dtype.str,
+                    },
+                    parts,
+                )
+            )
+        return entries
 
 
 class ParamServerShard(object):
@@ -243,6 +412,7 @@ class ParamServerShard(object):
 
     def _serve_conn(self, conn):
         ops = {"init": self._op_init, "pull": self._op_pull, "push": self._op_push}
+        reply = _ReplyCompressor()
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             while not self._stop.is_set():
@@ -255,12 +425,32 @@ class ParamServerShard(object):
                     send_msg(conn, {"op": "stop_ok"})
                     self.stop()
                     return
+                if op == "codec":
+                    # per-connection negotiation: subsequent params
+                    # replies ship as compressed deltas vs this
+                    # connection's tracked client view
+                    try:
+                        reply.negotiate(header.get("reply"))
+                    except (ValueError, TypeError) as e:
+                        send_msg(conn, {"op": "error", "error": str(e)})
+                        continue
+                    send_msg(
+                        conn,
+                        {
+                            "op": "codec_ok",
+                            "reply": reply.codec.name if reply.codec else None,
+                        },
+                    )
+                    continue
                 handler = ops.get(op)
                 if handler is None:
                     send_msg(conn, {"op": "error", "error": "bad op " + repr(op)})
                     continue
                 out_header, out_tensors = handler(header, tensors)
-                send_msg(conn, out_header, out_tensors)
+                if reply.codec is not None and out_tensors:
+                    _send_frame(conn, out_header, reply.entries(out_tensors))
+                else:
+                    send_msg(conn, out_header, out_tensors)
         finally:
             conn.close()
 
@@ -328,9 +518,24 @@ class PSClient(object):
     Args:
       addresses: list of ``"host:port"`` (``ctx.cluster_spec['ps']``).
       timeout: per-socket timeout (secs).
+      codec: optional gradient-push codec spec (``"int8"``,
+        ``("topk", {"ratio": 0.01})``, or a
+        :class:`~tensorflowonspark_tpu.compress.Codec`) — pushes ship
+        compressed; ``init`` params always ship exact.
+      error_feedback: wrap a lossy push codec in client-side
+        :class:`~tensorflowonspark_tpu.compress.ErrorFeedback`
+        (residual accumulation; keep the default unless measuring the
+        uncompensated codec).
+      reply_codec: optional reply codec spec negotiated with every
+        shard (the ``codec`` wire op): params replies then arrive as
+        compressed deltas against this client's last-known view instead
+        of dense ``dict(params)``.  ``"same"`` reuses ``codec``'s spec.
+        Old servers that reject the negotiation fall back to dense
+        replies (logged).
     """
 
-    def __init__(self, addresses, timeout=60):
+    def __init__(self, addresses, timeout=60, codec=None,
+                 error_feedback=True, reply_codec=None):
         from tensorflowonspark_tpu.utils.retry import retry_call
 
         self.addresses = list(addresses)
@@ -357,6 +562,26 @@ class PSClient(object):
         self._treedef = None
         self._assignment = None  # leaf index -> shard index
         self._shapes = None
+        # gradient-push codec (client->server), optionally under error
+        # feedback; and the negotiated reply codec (server->client
+        # compressed deltas).  Residuals/views are keyed by wire tensor
+        # name; each name is only ever touched by its shard's worker
+        # thread, so no extra locking is needed.
+        push = compress_mod.get_codec(codec)
+        if push is not None and isinstance(push, compress_mod.NoneCodec):
+            push = None
+        if push is not None and error_feedback:
+            push = compress_mod.ErrorFeedback(push)
+        self._push_codec = push
+        if reply_codec == "same":
+            reply_codec = push.spec() if push is not None else None
+        self._reply_views = [dict() for _ in self._socks]
+        self._reply_active = False
+        if reply_codec is not None:
+            self._negotiate_reply(reply_codec)
+        #: wire bytes this client laid on each shard connection
+        #: (send-side tunnel accounting; one writer per index)
+        self._sent_bytes = [0] * len(self._socks)
         # persistent per-shard request workers: a round trip costs two
         # queue handoffs instead of a thread spawn per shard per step
         # (measured: thread creation dominated small-model step time)
@@ -364,12 +589,68 @@ class PSClient(object):
 
         self._reqs = [_queue.Queue() for _ in self._socks]
         self._workers = []
+        self._closed = False
         for i in range(len(self._socks)):
             t = threading.Thread(
                 target=self._shard_worker, args=(i,), daemon=True
             )
             t.start()
             self._workers.append(t)
+
+    def _negotiate_reply(self, spec):
+        """Negotiate compressed-delta replies on every shard connection
+        (runs before the workers start, so the sockets are free)."""
+        spec = compress_mod.get_codec(spec).spec()
+        ok = True
+        for s in self._socks:
+            send_msg(s, {"op": "codec", "reply": spec})
+            h, _ = recv_msg(s)
+            if h.get("op") != "codec_ok":
+                ok = False
+        if not ok:
+            # mixed/old ensemble: stay on dense replies everywhere
+            # rather than tracking per-shard reply formats
+            logger.warning(
+                "reply codec %s rejected by a shard; dense replies", spec
+            )
+            for s in self._socks:
+                send_msg(s, {"op": "codec", "reply": None})
+                recv_msg(s)
+        self._reply_active = ok
+
+    @property
+    def bytes_sent(self):
+        """Total wire bytes laid on the shard connections by the worker
+        round trips (headers + payloads, send side)."""
+        return sum(self._sent_bytes)
+
+    def _apply_reply(self, i, header, tensors):
+        """Post-process one shard reply: delta-coded tensors are folded
+        into this client's tracked view (float32, the same arithmetic
+        the server's ``_ReplyCompressor`` ran on its copy — the two
+        stay bit-identical); dense tensors refresh the view."""
+        if not self._reply_active:
+            return tensors
+        view = self._reply_views[i]
+        for m in header.get("tensors", ()):
+            name = m.get("name")
+            if name is None:
+                continue
+            if m.get("delta"):
+                base = view.get(name)
+                if base is None:
+                    raise RuntimeError(
+                        "shard {0} sent a delta for {1} without a dense "
+                        "base".format(i, name)
+                    )
+                fresh = base + tensors[name].astype(np.float32, copy=False)
+                view[name] = fresh
+                tensors[name] = fresh.astype(
+                    np.dtype(str(m.get("pdtype", "<f4"))), copy=False
+                )
+            else:
+                view[name] = tensors[name].astype(np.float32, copy=True)
+        return tensors
 
     def _shard_worker(self, i):
         sock = self._socks[i]
@@ -378,16 +659,18 @@ class PSClient(object):
             item = q.get()
             if item is None:
                 return
-            header, tensors, box, ev = item
+            header, tensors, box, ev, codec = item
             try:
-                send_msg(sock, header, tensors)
+                self._sent_bytes[i] += send_msg(
+                    sock, header, tensors, codec=codec
+                )
                 h, t = recv_msg(sock)
                 if h.get("op") == "error":
                     box[1] = RuntimeError(
                         "ps shard {0}: {1}".format(i, h["error"])
                     )
                 else:
-                    box[0] = t
+                    box[0] = self._apply_reply(i, h, t)
             except Exception as e:  # noqa: BLE001 - delivered to caller
                 box[1] = e
             ev.set()
@@ -480,9 +763,13 @@ class PSClient(object):
 
     # -- round trips ---------------------------------------------------
 
-    def _enqueue_all(self, headers, per_shard_tensors):
+    def _enqueue_all(self, headers, per_shard_tensors, codec=None):
         """Hand one request per shard to the persistent workers (all
         shards in flight concurrently); returns (boxes, events)."""
+        if self._closed:
+            # a request enqueued after close() would wait forever (the
+            # workers are gone); fail fast instead
+            raise RuntimeError("PSClient is closed")
         boxes = []
         events = []
         for i in range(len(self._socks)):
@@ -490,7 +777,9 @@ class PSClient(object):
             ev = threading.Event()
             boxes.append(box)
             events.append(ev)
-            self._reqs[i].put((headers[i], per_shard_tensors[i], box, ev))
+            self._reqs[i].put(
+                (headers[i], per_shard_tensors[i], box, ev, codec)
+            )
         return boxes, events
 
     @staticmethod
@@ -562,9 +851,13 @@ class PSClient(object):
         leaves, _ = _flatten(grads)
         per_shard = self._shard_tensors(leaves)
         headers = [{"op": "push"} for _ in self._socks]
-        return _PushHandle(self, *self._enqueue_all(headers, per_shard))
+        return _PushHandle(
+            self,
+            *self._enqueue_all(headers, per_shard, codec=self._push_codec)
+        )
 
     def _join_workers(self):
+        self._closed = True
         for q in self._reqs:
             q.put(None)
         for t in self._workers:
@@ -598,6 +891,120 @@ class PSClient(object):
 # ----------------------------------------------------------------------
 
 
+class _GradDrain(object):
+    """Background device→host gradient drain feeding the
+    :class:`_PushHandle` pipeline.
+
+    The dispatch thread hands over *device* gradient trees and keeps
+    dispatching; this thread performs the device→host readback (the
+    blocking ``device_get`` that used to sit on the training loop's
+    critical path — the measured async-PS bottleneck) and enqueues the
+    push on the shard workers.  Double-buffered: readback of window
+    N+1 overlaps the wire round trip of window N (the previous handle
+    is collected only after the next push is in flight).
+
+    ``max_inflight`` is the bounded-staleness window: at most that many
+    gradient windows may be queued-or-flying before ``submit`` blocks
+    the dispatch thread, so a slow tunnel backpressures training
+    instead of accumulating unbounded staleness.
+    """
+
+    _STOP = object()
+
+    def __init__(self, client, max_inflight=2):
+        import queue as _queue
+
+        self._client = client
+        self._slots = threading.Semaphore(max(1, int(max_inflight)))
+        self._q = _queue.Queue()
+        self._fresh_lock = threading.Lock()
+        self._fresh = None
+        self._error = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="ps-grad-drain"
+        )
+        self._thread.start()
+
+    # test hook: tests assert every readback happens on THIS thread,
+    # never on the dispatch thread (the non-blocking contract)
+    def _to_host(self, tree):
+        import jax
+
+        return jax.device_get(tree)
+
+    def submit(self, device_grads):
+        """Hand a device gradient tree to the drain; blocks only when
+        the staleness window is full.  Raises any error a previous
+        window hit (once)."""
+        self._raise_pending()
+        self._slots.acquire()
+        self._q.put(device_grads)
+
+    def freshest(self):
+        """Latest params any landed round trip returned (or None)."""
+        self._raise_pending()
+        with self._fresh_lock:
+            return self._fresh
+
+    def _raise_pending(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _land(self, handle):
+        try:
+            fresh = handle.result()
+            with self._fresh_lock:
+                self._fresh = fresh
+        except Exception as e:  # noqa: BLE001 - surfaced on next submit
+            if self._error is None:
+                self._error = e
+        finally:
+            self._slots.release()
+
+    def _loop(self):
+        prev = None
+        while True:
+            item = self._q.get()
+            if item is self._STOP:
+                break
+            if isinstance(item, threading.Event):  # flush marker
+                if prev is not None:
+                    self._land(prev)
+                    prev = None
+                item.set()
+                continue
+            try:
+                host = self._to_host(item)
+                handle = self._client.push_pull_async(host)
+            except Exception as e:  # noqa: BLE001 - surfaced on submit
+                if self._error is None:
+                    self._error = e
+                self._slots.release()
+                continue
+            # collect the PREVIOUS round trip only now: its wire time
+            # overlapped this window's device→host readback
+            if prev is not None:
+                self._land(prev)
+            prev = handle
+        if prev is not None:
+            self._land(prev)
+
+    def flush(self):
+        """Block until every submitted window has landed; returns the
+        freshest params (or None if nothing ever landed)."""
+        ev = threading.Event()
+        self._q.put(ev)
+        ev.wait()
+        self._raise_pending()
+        with self._fresh_lock:
+            return self._fresh
+
+    def stop(self):
+        self._q.put(self._STOP)
+        self._thread.join(timeout=10)
+
+
 class AsyncTrainer(object):
     """Async-PS worker loop: local grads on this node's chips, updates on
     the parameter hosts.
@@ -613,28 +1020,102 @@ class AsyncTrainer(object):
         deeper — in exchange for hiding the TCP latency behind compute.
         The reference's between-graph PS mode had the same overlap
         implicitly (TF queued send ops against the next session.run).
+      overlap: move the device→host gradient readback off the training
+        loop entirely (:class:`_GradDrain`): ``step`` dispatches the
+        next gradient computation while a background thread drains the
+        previous window's grads and runs the push — the fix for the
+        measured "per-step device->host grad transfer" bottleneck.
+        Staleness is bounded by ``max_inflight`` windows.
+      push_every: accumulate this many steps' gradients ON DEVICE
+        (mean) per push — the tunnel sees 1/k the traffic and the PS
+        applies the averaged gradient (local accumulation; exact for
+        the leafwise optimizers up to the usual async staleness).
+      max_inflight: bounded-staleness cap for ``overlap`` mode.
+      codec / reply_codec / error_feedback: gradient-plane compression,
+        forwarded to :class:`PSClient` (docs/communication.md).
     """
 
     def __init__(self, loss_fn, ps_addresses,
                  optimizer=("sgd", {"learning_rate": 0.01}),
-                 pipeline=True):
+                 pipeline=True, overlap=False, push_every=1,
+                 max_inflight=2, codec=None, reply_codec=None,
+                 error_feedback=True):
         import jax
 
-        self.client = PSClient(ps_addresses)
+        if push_every < 1:
+            raise ValueError(
+                "push_every must be >= 1, got {0}".format(push_every)
+            )
+        self.client = PSClient(
+            ps_addresses, codec=codec, reply_codec=reply_codec,
+            error_feedback=error_feedback,
+        )
         self.optimizer = optimizer
         self.pipeline = pipeline
+        self.overlap = bool(overlap)
+        self.push_every = int(push_every)
         self._grad_fn = jax.jit(jax.grad(loss_fn))
+        self._acc_fn = jax.jit(
+            lambda a, b: jax.tree.map(lambda x, y: x + y, a, b)
+        )
         self._inflight = None
+        self._accum = None
+        self._accum_n = 0
+        self._drain = (
+            _GradDrain(self.client, max_inflight=max_inflight)
+            if self.overlap else None
+        )
 
     def init(self, params):
         return self.client.init(params, self.optimizer)
+
+    _mean_cache = None
+
+    def _mean_fn(self, n):
+        # cached per window size: a fresh lambda per call would re-jit
+        # every accumulation window
+        import jax
+
+        if self._mean_cache is None:
+            self._mean_cache = {}
+        fn = self._mean_cache.get(n)
+        if fn is None:
+            inv = 1.0 / float(n)
+            fn = jax.jit(lambda t: jax.tree.map(lambda x: x * inv, t))
+            self._mean_cache[n] = fn
+        return fn
+
+    def _accumulate(self, grads):
+        """Fold one step's device grads into the local window; returns
+        the (mean) window to ship, or None while the window fills.  All
+        arithmetic is jitted on device — nothing crosses to host here."""
+        if self.push_every == 1:
+            return grads
+        self._accum = (
+            grads if self._accum is None
+            else self._acc_fn(self._accum, grads)
+        )
+        self._accum_n += 1
+        if self._accum_n < self.push_every:
+            return None
+        out = self._mean_fn(self._accum_n)(self._accum)
+        self._accum, self._accum_n = None, 0
+        return out
 
     def step(self, params, batch):
         """One async step; returns fresh params (stale-gradient model:
         grads computed at ``params`` may land after other workers')."""
         grads = self._grad_fn(params, batch)
+        window = self._accumulate(grads)
+        if window is None:
+            return self._freshest(params)
+        if self.overlap:
+            # hand the DEVICE tree to the drain: the readback happens on
+            # its thread, this one goes straight back to dispatching
+            self._drain.submit(window)
+            return self._freshest(params)
         if not self.pipeline:
-            return self.client.push_pull(grads)
+            return self.client.push_pull(window)
         # enqueue this step's push directly on the shard workers, then
         # collect the PREVIOUS round trip — its wire time overlapped
         # this step's gradient computation.  The new handle replaces
@@ -642,14 +1123,31 @@ class AsyncTrainer(object):
         # failed, the error surfaces once and the next step collects
         # the fresh handle instead of re-raising a stale failure
         prev, self._inflight = self._inflight, self.client.push_pull_async(
-            grads
+            window
         )
         return prev.result() if prev is not None else params
 
+    def _freshest(self, params):
+        fresh = self._drain.freshest() if self._drain is not None else None
+        return fresh if fresh is not None else params
+
     def drain(self):
-        """Block until the in-flight round trip (if any) lands; returns
-        the freshest params or None.  Call at epoch/export boundaries so
-        checkpoints see every shipped gradient."""
+        """Block until every in-flight round trip lands; returns the
+        freshest params or None.  Call at epoch/export boundaries so
+        checkpoints see every shipped gradient.  A partially-filled
+        accumulation window is shipped (mean over its actual count)."""
+        if self._accum is not None:
+            window = self._mean_fn(self._accum_n)(self._accum)
+            self._accum, self._accum_n = None, 0
+            if self._drain is not None:
+                self._drain.submit(window)
+            else:
+                prev, self._inflight = self._inflight, None
+                if prev is not None:
+                    prev.result()
+                return self.client.push_pull(window)
+        if self._drain is not None:
+            return self._drain.flush()
         if self._inflight is None:
             return None
         fresh = self._inflight.result()
@@ -661,6 +1159,8 @@ class AsyncTrainer(object):
             self.drain()
         except Exception:  # noqa: BLE001 - teardown must proceed
             pass
+        if self._drain is not None:
+            self._drain.stop()
         if stop_servers:
             self.client.stop()
         else:

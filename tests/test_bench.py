@@ -67,6 +67,12 @@ def _full_record():
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
                         "resnet50": {"rows_per_sec": 51.5}},
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
+                         "async_compressed_steps_per_sec": 61.7,
+                         "async_compressed_wire_kb_per_step": 812.4,
+                         "async_compressed_topk_pe4_steps_per_sec": 84.2,
+                         "compression_gain": 6.56,
+                         "async_vs_sync": 0.599,
+                         "async_vs_sync_uncompressed": 0.091,
                          "sync_steps_per_sec": 103.0},
         "serving_cpu": {"rows_per_sec": 34395.2},
         "async_ps": {"async_steps_per_sec": 1135.2},
@@ -89,6 +95,8 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["moe_tok_s"] is None  # not in the default record
     assert parsed["serving_generate_rows_s"] == 59.77
     assert parsed["serving_continuous_rows_s"] == 78.41
+    assert parsed["async_ps_compressed_steps_s"] == 61.7
+    assert parsed["async_vs_sync"] == 0.599
     assert parsed["wall_sec"] == 741.2
 
 
@@ -99,7 +107,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
     assert sorted(json.loads(line)) == sorted([
         "resnet50_img_s", "vs_baseline", "lm_tok_s", "lm_mfu",
         "spark_feed_steps_s", "moe_tok_s", "serving_generate_rows_s",
-        "serving_continuous_rows_s", "wall_sec", "full_record",
+        "serving_continuous_rows_s", "async_ps_compressed_steps_s",
+        "async_vs_sync", "wall_sec", "full_record",
     ])
 
 

@@ -117,6 +117,47 @@ def test_prefetch_to_device_preserves_order():
         np.testing.assert_array_equal(np.asarray(b["x"]), np.full((2, 2), i))
 
 
+def test_prefetch_keeps_pad_count_on_host():
+    # (batch, n) tuples from batches(pad_to_batch=True): the batch goes
+    # to HBM, the valid-row count must STAY a host int — device-putting
+    # it made every consumer that reads n pay a device sync per batch
+    import jax
+
+    items = [(np.full((4, 2), i, np.float32), 4 - i) for i in range(3)]
+    out = list(prefetch_to_device(iter(items), size=2))
+    assert len(out) == 3
+    for i, (batch, n) in enumerate(out):
+        assert isinstance(batch, jax.Array)
+        assert type(n) is int and n == 4 - i  # not a device scalar
+
+
+def test_prefetch_rejects_bad_size():
+    with pytest.raises(ValueError):
+        list(prefetch_to_device(iter([np.zeros(2)]), size=0))
+
+
+def test_stack_batch_fast_path_matches_row_path():
+    from tensorflowonspark_tpu.data.feed import _stack_batch
+
+    # homogeneous rows of every common flavor: the single-asarray fast
+    # path must equal the old per-row stack bit for bit
+    cases = [
+        [np.arange(4, dtype=np.float32) + i for i in range(6)],  # arrays
+        [[1, 2, 3], [4, 5, 6]],  # lists
+        [[1, 2.5], [3, 4.0]],  # mixed int/float rows (promote)
+        [np.uint8(7), np.uint8(9)],  # scalar rows
+    ]
+    for rows in cases:
+        fast = _stack_batch(list(rows))
+        slow = np.stack([np.asarray(r) for r in rows])
+        assert fast.dtype == slow.dtype
+        np.testing.assert_array_equal(fast, slow)
+
+    # ragged rows still raise (the old np.stack contract)
+    with pytest.raises(ValueError):
+        _stack_batch([np.zeros(3), np.zeros(4)])
+
+
 def test_train_on_feed_steps_per_execution_equivalence(mgr):
     # fused feed-driven training (multi_step groups) must match the
     # per-step path given identical data and rng chain
