@@ -766,6 +766,119 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
     return out
 
 
+def serving_overload_bench(rows_n=32, slots=4, max_new=24, chunk=8,
+                           queue_depth=12):
+    """Overload row (PR 4 robustness): the continuous engine under
+    offered load ~2x capacity, per admission policy.
+
+    Workload: ``rows_n`` requests all offered at t0 (an open-loop
+    burst) against ``slots`` KV slots and an admission queue of
+    ``queue_depth`` (defaults sized so queue + slots hold HALF the
+    burst — offered load 2x what admission control is willing to
+    hold).  Per-request latency is measured START-OF-BURST
+    to completion (``stats["done_at"]``), which is what a caller of
+    an overloaded service experiences:
+
+    - ``block``: classic backpressure — every request completes, but
+      tail latency grows linearly with the backlog (p99 ~ the whole
+      burst's wall: UNBOUNDED in the offered load);
+    - ``reject``: requests past the queue bound return typed shed
+      records immediately — goodput counts completions only, and p99
+      is bounded by (queue_depth + slots) / capacity;
+    - ``degrade``: everything is admitted but token budgets shrink
+      against the backlog (floor 1), trading tokens-per-request for
+      bounded tail latency at full request goodput.
+
+    Small model on purpose: the row measures the SCHEDULER's overload
+    behavior, not the chip (compare shapes across policies, not
+    absolute rows/s with serving_generate)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    cfg = dict(
+        vocab_size=512, num_layers=2, num_heads=2, head_dim=16,
+        embed_dim=32, mlp_dim=64, max_seq_len=160, dtype="float32",
+    )
+    over = json.loads(os.environ.get("TFOS_SERVING_OVERLOAD_CONFIG", "{}"))
+    rows_n = int(over.pop("rows_n", rows_n))
+    slots = int(over.pop("slots", slots))
+    max_new = int(over.pop("max_new", max_new))
+    chunk = int(over.pop("chunk", chunk))
+    queue_depth = int(over.pop("queue_depth", queue_depth))
+    cfg.update(over)
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    predict = tr.serving_builder(
+        params,
+        dict(cfg, mode="generate", max_new_tokens=max_new,
+             pad_multiple=32, chunk_size=chunk, max_prompt_len=64),
+    )
+    rng = np.random.RandomState(0)
+    lens = rng.randint(8, 49, size=rows_n)
+    budgets = rng.randint(8, max_new + 1, size=rows_n)
+    rows = [
+        {
+            "prompt": rng.randint(
+                0, cfg["vocab_size"], (n,)
+            ).astype(np.int32),
+            "max_new": int(b),
+        }
+        for n, b in zip(lens, budgets)
+    ]
+    mapping = {"prompt": "tokens", "max_new": "max_new"}
+
+    # warm the (memoized) slot engine's prefill buckets + chunk program
+    list(serving.predict_rows(
+        predict,
+        [{"prompt": r["prompt"], "max_new": 2} for r in rows[:slots]],
+        mapping, batch_size=slots, schedule="continuous",
+    ))
+
+    def _pct(vals, q):
+        return round(float(np.percentile(np.asarray(vals), q)), 1)
+
+    out = {
+        "rows": rows_n, "slots": slots, "queue_depth": queue_depth,
+        "max_new_tokens": max_new, "chunk_size": chunk,
+        "offered": "open-loop burst at t0; queue+slots hold half of "
+                   "it (offered load 2x admission capacity)",
+        "platform": __import__("jax").devices()[0].platform,
+    }
+    for policy in ("block", "reject", "degrade"):
+        stats = {}
+        t0 = time.perf_counter()
+        results = list(serving.predict_rows(
+            predict, rows, mapping, batch_size=slots,
+            schedule="continuous", policy=policy,
+            queue_depth=queue_depth, stats=stats,
+        ))
+        wall = time.perf_counter() - t0
+        assert len(results) == rows_n  # nothing dropped silently
+        lat_ms = [1e3 * v for v in stats["done_at"].values()]
+        out[policy] = {
+            "goodput_rows_s": round(stats["completed"] / wall, 2),
+            "completed": stats["completed"],
+            "shed": stats["shed"],
+            "expired": stats["expired"],
+            "degraded": stats["degraded"],
+            "delivered_tokens": int(sum(
+                int(r.get("generated_len", max_new))
+                for r in results if "error" not in r
+            )),
+            "latency_p50_ms": _pct(lat_ms, 50) if lat_ms else None,
+            "latency_p99_ms": _pct(lat_ms, 99) if lat_ms else None,
+            "wall_sec": round(wall, 3),
+        }
+    return out
+
+
 def _decode_step_ms(model, params, prompt, new_tokens):
     """Shared decode-timing harness: jit-compiled generate with
     scalar-pull sync; pure per-step cost by the slope method — an
@@ -1828,6 +1941,9 @@ def bench_summary(record):
         "serving_continuous_rows_s": _pluck(
             record, "serving_generate", "continuous", "rows_per_sec"
         ),
+        "serving_overload_goodput": _pluck(
+            record, "serving_overload", "reject", "goodput_rows_s"
+        ),
         "async_ps_compressed_steps_s": _pluck(
             record, "async_ps_tpu", "async_compressed_steps_per_sec"
         ),
@@ -1909,6 +2025,9 @@ def main(model_name="resnet50", with_feed=True):
             # static + continuous schedules (two extra compiled
             # programs: slot prefill x2 buckets + the chunk scan)
             ("serving_generate", serving_generate_bench, 220),
+            # overload behavior per admission policy (tiny model —
+            # measures the scheduler, not the chip)
+            ("serving_overload", serving_overload_bench, 60),
             ("decode_long", decode_long_bench, 160),
             ("async_ps_tpu", ps_tpu_bench, 100),
             ("serving_tpu", serving_tpu_bench, 120),
@@ -1960,6 +2079,8 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(serving_tpu_bench)))
     elif "serving_generate" in sys.argv:
         print(json.dumps(with_retry(serving_generate_bench)))
+    elif "serving_overload" in sys.argv:
+        print(json.dumps(with_retry(serving_overload_bench)))
     elif "serving" in sys.argv:
         print(json.dumps(with_retry(serving_bench)))
     elif "long_context" in sys.argv:

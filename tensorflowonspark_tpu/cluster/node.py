@@ -1050,6 +1050,10 @@ def _attach_feed_ring(mgr):
         for stale in list(_ATTACHED_RINGS):
             _ATTACHED_RINGS.pop(stale).close(unlink=False)
         _ATTACHED_RINGS[name] = shm_ring.ShmRing(name)
+    # announce this process as the ring's producer so a consumer
+    # waiting on the ring detects a feeder death instead of hanging
+    # (shm_ring.ProducerDiedError; the pid lands in the ring header)
+    _ATTACHED_RINGS[name].announce_producer()
     return _ATTACHED_RINGS[name]
 
 

@@ -94,6 +94,7 @@ class DataFeed(object):
         #: manager kv; None = queue-only feeding
         self._ring = None
         self._ring_checked = False
+        self._ring_producer_warned = False  # one log line per death
         #: which source produced the last item ("ring" | "queue") —
         #: next_batch blocks on the hot source, polls the other
         self._hot_source = "ring"
@@ -129,14 +130,14 @@ class DataFeed(object):
                     try:
                         return queue_in.get(block=True, timeout=0.05)
                     except queue_mod.Empty:
-                        rec = self._ring.pop(timeout=0)
+                        rec = self._ring_pop(0)
                         if rec is None:
                             continue
                         self._hot_source = "ring"
                         self._set_pending(_decode_ring_record(rec))
                         return self._RING_SENTINEL
                 else:
-                    rec = self._ring.pop(timeout=0.05)
+                    rec = self._ring_pop(0.05)
                     if rec is not None:
                         self._set_pending(_decode_ring_record(rec))
                         return self._RING_SENTINEL
@@ -159,6 +160,25 @@ class DataFeed(object):
                     return queue_in.get(block=True, timeout=1.0)
                 except queue_mod.Empty:
                     continue
+
+    def _ring_pop(self, timeout):
+        """Ring pop with producer-liveness handling: a dead feeder
+        (its pid is announced in the ring header, see
+        :class:`~tensorflowonspark_tpu.data.shm_ring.ShmRing`) turns
+        the would-be-infinite ring wait into a logged miss — the feed
+        drops to the queue path, where control sentinels and the
+        cluster's heartbeat/ledger recovery (PR 1) own the failure.
+        A NEW feeder for a later partition re-announces itself, which
+        re-arms the ring."""
+        from tensorflowonspark_tpu.data import shm_ring
+
+        try:
+            return self._ring.pop(timeout=timeout)
+        except shm_ring.ProducerDiedError as e:
+            if not self._ring_producer_warned:
+                self._ring_producer_warned = True
+                logger.warning("%s; falling back to the queue path", e)
+            return None
 
     def _set_pending(self, obj):
         """Install a ring/queue block as the pending element (a row list
@@ -403,7 +423,7 @@ class DataFeed(object):
             idle_end = _time.monotonic() + 2
             ring_count = 0
             while _time.monotonic() < min(hard_end, idle_end):
-                if self._ring.pop(timeout=0.05) is None:
+                if self._ring_pop(0.05) is None:
                     continue
                 ring_count += 1
                 idle_end = _time.monotonic() + 2

@@ -22,6 +22,8 @@ import atexit
 import ctypes
 import gc
 import logging
+import os
+import struct
 import time
 import weakref
 from multiprocessing import shared_memory
@@ -33,6 +35,24 @@ logger = logging.getLogger(__name__)
 _LIB_NAME = "libshm_ring.so"
 
 DEFAULT_CAPACITY = 64 * 1024 * 1024
+
+#: byte offset of the producer-pid slot inside the 64-byte native
+#: header: magic(8) + capacity(8) + head(8) + tail(8) = 32, then the
+#: header's reserved pad region — the native code never reads it, so
+#: the python layer owns it.  A zero pid means "no producer announced"
+#: (SharedMemory segments are created zero-filled).
+_PRODUCER_PID_OFFSET = 32
+
+#: seconds between producer-liveness probes while a pop waits on an
+#: empty ring (one os.kill(pid, 0) per interval — negligible)
+_LIVENESS_INTERVAL = 0.2
+
+
+class ProducerDiedError(RuntimeError):
+    """The ring's announced producer process died with the ring empty:
+    no more records are coming and a blocking consumer would otherwise
+    wait out its full feed timeout (or forever, in a retry loop).
+    Names the segment and the dead pid."""
 
 #: live rings; at interpreter exit their ctypes buffer pins are dropped
 #: BEFORE SharedMemory.__del__ runs, so its close() doesn't raise
@@ -110,9 +130,54 @@ class ShmRing(object):
                 self.close()
                 raise ValueError("segment too small: {0}".format(capacity))
         _INSTANCES.add(self)
+        self._next_liveness = 0.0  # next producer probe (monotonic)
 
     def _base(self):
         return self._cbase
+
+    # -- producer liveness ---------------------------------------------
+
+    def announce_producer(self, pid=None):
+        """Record the producer's pid in the ring header (the native
+        header's reserved pad bytes — the C++ side never reads them).
+        The pushing process calls this once after attaching; a new
+        producer for a later stream simply overwrites it (SPSC — one
+        live producer at a time)."""
+        struct.pack_into(
+            "<Q", self.shm.buf, _PRODUCER_PID_OFFSET,
+            int(os.getpid() if pid is None else pid),
+        )
+
+    def producer_pid(self):
+        """The announced producer pid, or 0 when none announced."""
+        return struct.unpack_from(
+            "<Q", self.shm.buf, _PRODUCER_PID_OFFSET
+        )[0]
+
+    @staticmethod
+    def _pid_alive(pid):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True  # exists, owned by someone else
+        return True
+
+    def _producer_dead(self):
+        """The announced producer's pid when that process is dead,
+        else None.  Called from pop's empty-wait path, rate-limited to
+        one probe per ``_LIVENESS_INTERVAL`` ACROSS calls (feed loops
+        issue many short-timeout pops; per-call probe state would
+        never reach the interval and miss the death)."""
+        now = time.monotonic()
+        if now < self._next_liveness:
+            return None
+        self._next_liveness = now + _LIVENESS_INTERVAL
+        pid = self.producer_pid()
+        if pid and not self._pid_alive(pid):
+            return pid
+        return None
 
     def push(self, record, timeout=None, error_check=None):
         """Append one byte record; blocks (spin+sleep) while full.
@@ -205,6 +270,7 @@ class ShmRing(object):
         deadline = time.monotonic() + timeout
         base = self._base()
         need = ctypes.c_uint64(0)
+        dead_pid = None
         while True:
             n = self._lib.shmring_pop(
                 base,
@@ -231,6 +297,19 @@ class ShmRing(object):
                 return buf
             if n == -3:
                 raise RuntimeError("corrupt ring segment")
+            if dead_pid is not None:
+                # the producer was dead on the PREVIOUS iteration and
+                # this re-probe still found the ring empty: nothing
+                # raced in between its last push and its death
+                raise ProducerDiedError(
+                    "shm ring {0!r}: producer pid {1} died with the "
+                    "ring empty — no more records are coming".format(
+                        self.name, dead_pid
+                    )
+                )
+            dead_pid = self._producer_dead()
+            if dead_pid is not None:
+                continue  # one confirming empty re-probe, then raise
             if time.monotonic() >= deadline:
                 return None
             time.sleep(0.0005)

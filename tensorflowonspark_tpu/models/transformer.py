@@ -1056,6 +1056,17 @@ class SlotDecoder:
         rewrites the state entries."""
         self.active[slot] = False
 
+    def cancel(self, slot):
+        """CANCEL an in-flight lane between chunks (deadline expiry,
+        client abort): identical to :meth:`evict` — the lane simply
+        stops being scheduled, its neighbors keep decoding
+        undisturbed, and nothing recompiles (the slot index was
+        traced at admit).  A distinct name so the serving engine's
+        cancellation contract is explicit and separately testable
+        (tests/test_serving_engine.py asserts the compiled-program
+        census is unchanged by cancellations)."""
+        self.evict(slot)
+
     def reset(self):
         """Return every slot to idle (between serving jobs).  The
         cache banks stay as-is — stale KV is unreachable, see
@@ -1064,17 +1075,35 @@ class SlotDecoder:
         self.state = self._idle_state()
         self.active[:] = False
 
-    def step_chunk(self):
-        """Run one compiled decode chunk over every slot.  Returns
-        ``[num_slots, chunk_size]`` int32 tokens (idle lanes emit
-        garbage — the scheduler only reads active lanes' rows).  The
-        ONLY synchronizing host pull in the engine."""
+    def dispatch_chunk(self):
+        """Dispatch one compiled decode chunk over every slot WITHOUT
+        synchronizing: the cache/state futures are installed
+        immediately and the ``[num_slots, chunk_size]`` token block
+        comes back as an unresolved device array.  Pair with
+        :meth:`resolve_chunk`; the split lets the serving engine do
+        host-side work (queue refill, deadline bookkeeping) while the
+        chunk runs, and lets its watchdog bound only the
+        synchronizing half."""
         keys = self._next_key(self.chunk_size)
         self.cache, self.state, toks = self._chunk_jit(
             self._qparams if self._quantized else self._params,
             self.cache, self.state, jnp.asarray(self.active), keys,
         )
+        return toks
+
+    def resolve_chunk(self, toks):
+        """Synchronize a :meth:`dispatch_chunk` token block to host
+        int32 (idle lanes hold garbage — the scheduler only reads
+        active lanes' rows).  The ONLY synchronizing host pull in the
+        engine — and therefore the call a wedged device dispatch
+        hangs, which is why the serving watchdog wraps exactly
+        this."""
         return self._np.asarray(toks)
+
+    def step_chunk(self):
+        """Dispatch + resolve one decode chunk (see
+        :meth:`dispatch_chunk` / :meth:`resolve_chunk`)."""
+        return self.resolve_chunk(self.dispatch_chunk())
 
     def compile_counts(self):
         """Compiled-program census: {"prefill": one per prompt bucket,

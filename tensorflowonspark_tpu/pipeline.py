@@ -121,6 +121,13 @@ HasMasterNode = _mixin(
 )
 HasModelDir = _mixin("model_dir", "directory for checkpoints/events")
 HasNumPS = _mixin("num_ps", "number of parameter-server nodes", 0, cap="NumPS")
+HasOnError = _mixin(
+    "on_error",
+    "per-request inference failure policy: 'raise' fails the job "
+    "naming the poisoned request; 'record' isolates it as a typed "
+    "error record (serving_engine.error_record) at its row position",
+    "raise",
+)
 HasOutputMapping = _mixin(
     "output_mapping", "mapping of predictor outputs to output columns"
 )
@@ -196,6 +203,7 @@ _MODEL_MIXINS = (
     HasExportDir,
     HasInputMapping,
     HasModelDir,
+    HasOnError,
     HasOutputMapping,
     HasSignatureDefKey,
     HasTagSet,
@@ -419,6 +427,11 @@ def _run_model_iter(rows, args, predictor_builder=None):
         input_mapping=args.input_mapping,
         output_mapping=args.output_mapping,
         batch_size=args.batch_size,
+        # poison isolation (setOnError("record")): a bad row becomes a
+        # typed error record at its position instead of failing the
+        # partition — when transforming to a typed DataFrame, include
+        # an "error" column in the output schema to surface them
+        on_error=getattr(args, "on_error", None) or "raise",
     )
 
 

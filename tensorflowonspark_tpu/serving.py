@@ -34,6 +34,18 @@ import os
 
 import numpy as np
 
+from tensorflowonspark_tpu import serving_engine
+# re-exported robustness surface (see serving_engine / docs/serving.md
+# "Robustness & overload")
+from tensorflowonspark_tpu.serving_engine import (  # noqa: F401
+    RequestError,
+    RequestValidationError,
+    ServingEngine,
+    ServingError,
+    WatchdogTimeout,
+    error_record,
+)
+
 logger = logging.getLogger(__name__)
 
 #: Per-process predictor cache keyed by (export_dir, builder digest) —
@@ -156,6 +168,11 @@ def predict_rows(
     pad_to_batch=True,
     schedule="static",
     stats=None,
+    on_error="raise",
+    queue_depth=None,
+    policy="block",
+    watchdog_timeout=None,
+    default_deadline=None,
 ):
     """Run ``predict`` over dict-rows; yields output dict-rows.
 
@@ -181,35 +198,61 @@ def predict_rows(
         docs/serving.md).
       stats: optional dict the continuous scheduler fills with
         per-request latency accounting (``latency_sec`` in input
-        order, plus admitted/evicted counters) — the serving bench's
-        p50/p99 source.
+        order, plus admitted/evicted and robustness counters) — the
+        serving bench's p50/p99 source.
+      on_error: ``"raise"`` (fail fast; admission errors name the
+        request index and offending column) or ``"record"`` (poison
+        isolation: a bad row yields a typed error record at its input
+        position instead of killing the batch — see
+        :func:`serving_engine.error_record` and docs/serving.md
+        "Robustness & overload").
+      queue_depth / policy / watchdog_timeout / default_deadline:
+        continuous-only overload knobs, forwarded to
+        :class:`~tensorflowonspark_tpu.serving_engine.ServingEngine`
+        (bounded admission queue with ``block | reject | degrade``
+        shedding, per-request deadlines, and the decode watchdog).
     """
     if schedule not in ("static", "continuous"):
         raise ValueError(
             "schedule must be 'static' or 'continuous', got %r"
             % (schedule,)
         )
+    if on_error not in serving_engine.ON_ERROR:
+        raise ValueError(
+            "on_error must be one of %s, got %r"
+            % (serving_engine.ON_ERROR, on_error)
+        )
     if schedule == "continuous":
         for r in _predict_rows_continuous(
             predict, rows, input_mapping, output_mapping, batch_size,
-            stats,
+            stats, on_error=on_error, queue_depth=queue_depth,
+            policy=policy, watchdog_timeout=watchdog_timeout,
+            default_deadline=default_deadline,
         ):
             yield r
         return
+    if (policy != "block" or queue_depth is not None
+            or watchdog_timeout is not None
+            or default_deadline is not None):
+        raise ValueError(
+            "queue_depth/policy/watchdog_timeout/default_deadline are "
+            "continuous-schedule knobs; the static schedule has no "
+            "admission queue (see docs/serving.md)"
+        )
     cols = sorted(input_mapping)
-    buf = []
+    buf = []  # ("ok", row) | ("rec", error_record) entries, input order
+    n_seen = 0
     # generation predictors declare ragged columns (prompts of varying
     # length) via ``predict.column_padding = {input_name: pad_value}``;
     # those stack left-padded and ship a ``<input>_pad`` count column
     # the model uses to mask the pad slots
     column_padding = getattr(predict, "column_padding", None) or {}
 
-    def _flush(chunk):
-        n = len(chunk)
+    def _assemble(chunk_rows, n_pad):
         batch = {}
         for c in cols:
             name = input_mapping[c]
-            values = [r[c] for r in chunk]
+            values = [r[c] for r in chunk_rows]
             if name in column_padding:
                 batch[name], batch[name + "_pad"] = _stack_ragged_left(
                     values, column_padding[name],
@@ -218,30 +261,82 @@ def predict_rows(
                 )
             else:
                 batch[name] = _stack_column(values)
-        if pad_to_batch and n < batch_size:
+        n = len(chunk_rows)
+        if pad_to_batch and n < n_pad:
             batch = {
                 k: np.concatenate(
-                    [v, np.zeros((batch_size - n,) + v.shape[1:], v.dtype)]
+                    [v, np.zeros((n_pad - n,) + v.shape[1:], v.dtype)]
                 )
                 for k, v in batch.items()
             }
-        out = predict(batch)
-        out = {k: np.asarray(v)[:n] for k, v in out.items()}
-        if output_mapping:
-            missing = [n_ for n_ in output_mapping if n_ not in out]
-            if missing:
-                # fail fast like the reference's signature lookup
-                # (pipeline.py:559-564), not silent empty rows
-                raise KeyError(
-                    "output_mapping names {0} not produced by the "
-                    "predictor (outputs: {1})".format(missing, sorted(out))
+        return batch
+
+    def _predict_batch(chunk_rows):
+        out = predict(_assemble(chunk_rows, batch_size))
+        return {
+            k: np.asarray(v)[:len(chunk_rows)] for k, v in out.items()
+        }
+
+    def _flush(chunk):
+        ok = [(i, row) for i, (tag, row, _) in enumerate(chunk)
+              if tag == "ok"]
+        per_row = {}
+        out = None
+        if ok:
+            try:
+                out = _predict_batch([row for _, row in ok])
+            except Exception as e:  # noqa: BLE001 - poison isolation
+                if on_error == "raise":
+                    raise
+                # a poisoned row can kill batch ASSEMBLY (ragged
+                # shapes) or the predict call itself; isolate it by
+                # re-running each row alone (same padded batch shape,
+                # so nothing recompiles) and record only the rows
+                # that individually fail
+                logger.warning(
+                    "batch of %d rows failed (%s); isolating "
+                    "per-row", len(ok), e,
                 )
-            out = {col: out[name] for name, col in output_mapping.items()}
-        for i in range(n):
-            yield {k: v[i] for k, v in out.items()}
+                for pos, row in ok:
+                    idx = chunk[pos][2]
+                    try:
+                        per_row[pos] = ("out", _predict_batch([row]))
+                    except Exception as re:  # noqa: BLE001
+                        per_row[pos] = ("rec", serving_engine.error_record(
+                            "predict", idx,
+                            "request {0} failed in predict: "
+                            "{1}".format(idx, re),
+                        ))
+        ok_pos = {p: i for i, (p, _) in enumerate(ok)}
+        for pos, (tag, payload, _idx) in enumerate(chunk):
+            if tag == "rec":
+                yield payload
+            elif out is not None:
+                i = ok_pos[pos]
+                yield _apply_output_mapping(
+                    {k: v[i] for k, v in out.items()}, output_mapping
+                )
+            else:
+                kind, o = per_row[pos]
+                if kind == "rec":
+                    yield o
+                else:
+                    yield _apply_output_mapping(
+                        {k: v[0] for k, v in o.items()}, output_mapping
+                    )
 
     for row in rows:
-        buf.append(row)
+        idx = n_seen
+        n_seen += 1
+        try:
+            _validate_static_row(row, idx, input_mapping)
+            buf.append(("ok", row, idx))
+        except serving_engine.RequestValidationError as e:
+            if on_error == "raise":
+                raise
+            buf.append((
+                "rec", serving_engine.error_record(e.kind, idx, e), idx
+            ))
         if len(buf) == batch_size:
             for r in _flush(buf):
                 yield r
@@ -249,6 +344,23 @@ def predict_rows(
     if buf:
         for r in _flush(buf):
             yield r
+
+
+def _validate_static_row(row, idx, input_mapping):
+    """Static-schedule admission validation: every mapped input column
+    must be present — a missing key used to surface as a bare
+    ``KeyError`` from deep inside the batch flush; now the error names
+    the request index and the missing column at admission."""
+    for col in sorted(input_mapping):
+        if col not in row:
+            raise serving_engine.RequestValidationError(
+                "request {0} is missing input column {1!r} (mapped to "
+                "predictor input {2!r}); present columns: {3}".format(
+                    idx, col, input_mapping[col],
+                    sorted(row) if isinstance(row, dict) else type(row),
+                ),
+                kind="missing_input", request_index=idx,
+            )
 
 
 def _apply_output_mapping(out, output_mapping):
@@ -263,164 +375,45 @@ def _apply_output_mapping(out, output_mapping):
     return {col: out[name] for name, col in output_mapping.items()}
 
 
-#: reserved input name: a row column mapped to it carries that
-#: request's token budget (continuous schedule only) — the scheduler
-#: evicts the row after ``min(max_new, budget)`` tokens even when no
-#: eos arrives, freeing its slot for the next queued prompt
-BUDGET_INPUT = "max_new"
+#: reserved input names (re-exported from serving_engine): a row
+#: column mapped to BUDGET_INPUT carries that request's token budget
+#: (evicted after ``min(max_new, budget)`` tokens even without eos);
+#: one mapped to DEADLINE_INPUT carries its deadline in seconds
+BUDGET_INPUT = serving_engine.BUDGET_INPUT
+DEADLINE_INPUT = serving_engine.DEADLINE_INPUT
 
 
 def _predict_rows_continuous(predict, rows, input_mapping,
-                             output_mapping, num_slots, stats):
+                             output_mapping, num_slots, stats,
+                             on_error="raise", queue_depth=None,
+                             policy="block", watchdog_timeout=None,
+                             default_deadline=None):
     """Continuous in-flight batching over a generation predictor.
 
-    The scheduler role of the serving-side tentpole (see
-    docs/serving.md): a request queue feeds ``num_slots`` KV-cache
-    slots; decode runs in compiled chunks
+    The scheduling loop lives in
+    :class:`~tensorflowonspark_tpu.serving_engine.ServingEngine` (the
+    overload-safe serving layer: bounded admission queue with
+    ``block | reject | degrade`` shedding, per-request deadlines with
+    slot-level cancellation, poison isolation via ``on_error``, and a
+    decode watchdog with in-flight recovery — see docs/serving.md
+    "Robustness & overload").  A request queue feeds ``num_slots``
+    KV-cache slots; decode runs in compiled chunks
     (:class:`~tensorflowonspark_tpu.models.transformer.SlotDecoder`),
-    and BETWEEN chunks finished rows (first eos, or the row's budget)
-    are evicted and queued prompts admitted into the freed lanes — so
-    a short row never pays a long neighbor's decode.  Rows are
-    yielded in INPUT order (completion order is recorded in
-    ``stats``); outputs are token-identical to the static
-    ``generate`` path per request (parity-tested).
-    """
-    import time as _time
-
-    factory = getattr(predict, "make_slot_decoder", None)
-    if factory is None:
-        raise ValueError(
-            "schedule='continuous' requires a generation predictor "
-            "exposing make_slot_decoder (see transformer."
-            "serving_builder with mode='generate'); this predictor "
-            "has none"
-        )
-    column_padding = getattr(predict, "column_padding", None) or {}
-    prompt_cols = [
-        c for c in input_mapping if input_mapping[c] in column_padding
-    ]
-    if len(prompt_cols) != 1:
-        raise ValueError(
-            "continuous scheduling needs exactly one ragged prompt "
-            "column in input_mapping; got {0}".format(prompt_cols)
-        )
-    prompt_col = prompt_cols[0]
-    budget_cols = [
-        c for c in input_mapping if input_mapping[c] == BUDGET_INPUT
-    ]
-    budget_col = budget_cols[0] if budget_cols else None
-
-    decoder = factory(num_slots)
-    max_new = decoder.max_new_tokens
-    eos_id = decoder.eos_id
-    fill = eos_id if eos_id is not None else 0
-    now = _time.perf_counter
-
-    if stats is None:
-        stats = {}
-    stats["latency_sec"] = {}
-    stats["admitted"] = 0
-    stats["chunks"] = 0
-    stats["chunk_size"] = decoder.chunk_size
-
-    it = iter(rows)
-    pending = []
-    state = {"n_in": 0, "exhausted": False}
-    slot_req = {}   # slot -> in-flight request record
-    finished = {}   # input idx -> output row
-    emit_at = {"next": 0}
-
-    def _pull():
-        if state["exhausted"]:
-            return
-        try:
-            row = next(it)
-        except StopIteration:
-            state["exhausted"] = True
-            return
-        budget = max_new
-        if budget_col is not None:
-            budget = max(1, min(int(row[budget_col]), max_new))
-        pending.append({
-            "idx": state["n_in"],
-            "prompt": np.asarray(row[prompt_col]),
-            "budget": budget,
-            "eos_at": None,
-            "out": None,
-            "submit": now(),
-        })
-        state["n_in"] += 1
-
-    def _finalize(req, t_done):
-        arr = np.full((max_new,), fill, np.int32)
-        toks = req["out"][:max_new]
-        arr[: len(toks)] = toks
-        gen_len = (
-            req["eos_at"] if req["eos_at"] is not None else req["budget"]
-        )
-        out = {"generated": arr}
-        if eos_id is not None or budget_col is not None:
-            out["generated_len"] = np.int32(gen_len)
-        finished[req["idx"]] = _apply_output_mapping(out, output_mapping)
-        stats["latency_sec"][req["idx"]] = t_done - req["submit"]
-
-    def _admit_free():
-        for slot in decoder.free_slots():
-            if not pending:
-                _pull()
-            if not pending:
-                return
-            req = pending.pop(0)
-            # admit is a single ASYNC dispatch; the first token comes
-            # back as an unsynchronized device scalar, resolved at the
-            # next chunk boundary together with the token block
-            req["out"] = [decoder.admit(slot, req["prompt"])]
-            stats["admitted"] += 1
-            slot_req[slot] = req
-
-    def _consume(req, chunk_row):
-        """Fold a slot's chunk tokens into its request; True when the
-        request completed (first eos, or its budget)."""
-        if req["out"] and not isinstance(req["out"][0], int):
-            first = int(np.asarray(req["out"][0]))
-            req["out"][0] = first
-            if eos_id is not None and first == eos_id:
-                req["eos_at"] = 0
-        for t in (() if chunk_row is None else chunk_row):
-            if req["eos_at"] is not None or len(req["out"]) >= req["budget"]:
-                break
-            req["out"].append(int(t))
-            if eos_id is not None and int(t) == eos_id:
-                req["eos_at"] = len(req["out"]) - 1
-        return req["eos_at"] is not None or len(req["out"]) >= req["budget"]
-
-    while True:
-        _admit_free()
-        if not slot_req:
-            while emit_at["next"] in finished:
-                yield finished.pop(emit_at["next"])
-                emit_at["next"] += 1
-            if pending or not state["exhausted"]:
-                # only reachable when there are zero slots; guard
-                # against an impossible-progress spin
-                raise RuntimeError(
-                    "continuous scheduler cannot make progress "
-                    "(no slots available)"
-                )
-            return
-        toks = decoder.step_chunk()
-        stats["chunks"] += 1
-        t_chunk = now()
-        for slot, req in list(slot_req.items()):
-            if _consume(req, toks[slot]):
-                _finalize(req, t_chunk)
-                decoder.evict(slot)
-                del slot_req[slot]
-        # stream completed rows in input order as soon as the head of
-        # the reorder buffer is ready
-        while emit_at["next"] in finished:
-            yield finished.pop(emit_at["next"])
-            emit_at["next"] += 1
+    and BETWEEN chunks finished rows (first eos, the row's budget, or
+    an expired deadline) are evicted and queued prompts admitted into
+    the freed lanes — so a short row never pays a long neighbor's
+    decode.  Rows are yielded in INPUT order (completion order is
+    recorded in ``stats``); outputs are token-identical to the static
+    ``generate`` path per request (parity-tested)."""
+    engine = serving_engine.ServingEngine(
+        predict, input_mapping, output_mapping, num_slots,
+        queue_depth=queue_depth, policy=policy,
+        default_deadline=default_deadline,
+        watchdog_timeout=watchdog_timeout, on_error=on_error,
+        stats=stats,
+    )
+    for r in engine.serve(rows):
+        yield r
 
 
 def infer_output_schema(predict, sample_row, input_mapping,
@@ -505,6 +498,28 @@ def main(argv=None):
                         "for generation exports (slot-level KV-cache "
                         "scheduler; batch_size = in-flight slots — "
                         "see docs/serving.md)")
+    p.add_argument("--on_error", choices=serving_engine.ON_ERROR,
+                   default="raise",
+                   help="per-request failure policy: 'raise' fails "
+                        "fast naming the request, 'record' isolates "
+                        "poison rows as typed error records")
+    p.add_argument("--policy", choices=serving_engine.POLICIES,
+                   default="block",
+                   help="continuous admission policy under overload: "
+                        "block (backpressure), reject (shed past the "
+                        "queue bound), degrade (shrink token budgets "
+                        "against the backlog)")
+    p.add_argument("--queue_depth", type=int, default=None,
+                   help="continuous admission-queue bound "
+                        "(default 2x slots)")
+    p.add_argument("--watchdog_timeout", type=float, default=None,
+                   help="seconds before a wedged decode chunk is "
+                        "abandoned and in-flight requests are "
+                        "re-admitted from their committed tokens")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="default per-request deadline in seconds "
+                        "(expired requests return a typed record "
+                        "with their partial tokens)")
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu.data import interchange
@@ -527,12 +542,28 @@ def main(argv=None):
     count = 0
     sched_stats = {}
     with fs_utils.open_file(out_path, "w") as f:
+        kwargs = {}
+        if args.schedule == "continuous":
+            kwargs = dict(
+                queue_depth=args.queue_depth, policy=args.policy,
+                watchdog_timeout=args.watchdog_timeout,
+                default_deadline=args.deadline,
+            )
         for out_row in predict_rows(
             predict, rows, input_mapping, output_mapping,
             args.batch_size, schedule=args.schedule, stats=sched_stats,
+            on_error=args.on_error, **kwargs
         ):
             f.write(json.dumps(out_row, default=_json_default) + "\n")
             count += 1
+    shed = sched_stats.get("shed", 0) + sched_stats.get("expired", 0)
+    if shed or sched_stats.get("errors"):
+        logger.warning(
+            "robustness: %d shed/expired, %d error record(s), "
+            "%d watchdog fire(s)", shed,
+            sched_stats.get("errors", 0),
+            sched_stats.get("watchdog_fires", 0),
+        )
     if sched_stats.get("latency_sec"):
         lat = sorted(sched_stats["latency_sec"].values())
         logger.info(

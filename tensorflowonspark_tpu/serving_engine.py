@@ -1,0 +1,740 @@
+"""Overload-safe online serving around the slot scheduler.
+
+The continuous-batching scheduler shipped with
+:mod:`tensorflowonspark_tpu.serving` was fail-stop: one malformed
+request raised out of the scheduling loop and killed every in-flight
+request, the request queue was unbounded, no request carried a
+deadline, and a wedged device dispatch hung the caller forever.  The
+reference stack leans on its runtime for exactly this class of
+recovery (TensorFlow §4.4 fault tolerance), and TF-Replicator's lesson
+— keep the failure-handling *policy* in the framework layer, not user
+code — is what PR 1 applied to training.  This module is the serving
+counterpart:
+
+- **admission control** — a bounded request queue with three
+  load-shedding policies: ``block`` (pull no faster than slots free —
+  classic backpressure on the row source), ``reject`` (requests past
+  the queue bound return a typed *shed record* immediately), and
+  ``degrade`` (every request is accepted but its token budget shrinks
+  proportionally to the backlog, down to ``degrade_floor``);
+- **poison isolation** — schema/shape/dtype validation at admission
+  plus per-request error capture around the slot prefill, so with
+  ``on_error="record"`` a bad row yields an *error record* at its
+  input position instead of killing the batch (``on_error="raise"``
+  keeps fail-fast semantics but names the request index and the
+  offending column);
+- **per-request deadlines** — a row column mapped to the reserved
+  input :data:`DEADLINE_INPUT` (or the engine-level
+  ``default_deadline``) bounds each request's submit→finish wall
+  time; an expired lane is *cancelled* between decode chunks
+  (:meth:`SlotDecoder.cancel` — neighbors are untouched, nothing
+  recompiles) and returns a ``deadline`` record carrying the tokens
+  it did complete;
+- **decode watchdog** — the chunk sync (the engine's only
+  synchronizing device call) runs on a watchdog thread under
+  ``watchdog_timeout``; a wedged dispatch is abandoned, the slot
+  table is torn down, and every in-flight request is re-admitted
+  from its already-committed tokens.  The committed prefix is
+  preserved and (greedy) recovered outputs are token-identical for
+  unaffected requests, because the re-admitted prompt+prefix prefill
+  recreates exactly the context the lost decode step saw.
+
+Every shed/expired/poisoned request is *accounted*: it occupies its
+input-order position in the output stream as a typed record (see
+:func:`error_record`), so the engine never drops a request silently
+and never deadlocks — the chaos e2e in tests/test_chaos_serving.py
+drives all three fault families at 2x offered load.
+
+Deterministic fault injection lives in
+:mod:`tensorflowonspark_tpu.testing.chaos` (``wedge_dispatch`` plans,
+``poison_row``, ``slow_consumer``); the engine picks a planned wedge
+up from the ``TFOS_CHAOS_PLAN`` env var exactly like the training-side
+heartbeat hooks do.
+"""
+
+import logging
+import queue as queue_mod
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: reserved input name: a row column mapped to it carries that
+#: request's token budget — the scheduler evicts the row after
+#: ``min(max_new, budget)`` tokens even when no eos arrives
+BUDGET_INPUT = "max_new"
+
+#: reserved input name: a row column mapped to it carries that
+#: request's deadline in SECONDS from submission; an expired request
+#: is cancelled between chunks and returns a ``deadline`` record
+DEADLINE_INPUT = "deadline_sec"
+
+#: admission policies (see module docstring)
+POLICIES = ("block", "reject", "degrade")
+
+#: per-request failure policies
+ON_ERROR = ("raise", "record")
+
+
+class ServingError(Exception):
+    """Base for serving-engine failures."""
+
+
+class RequestError(ServingError, ValueError):
+    """A problem scoped to ONE request.  Carries the failure ``kind``
+    (a short slug, see :func:`error_record`) and the request's input
+    index so callers can always name the poisoned row."""
+
+    def __init__(self, message, kind="request", request_index=None):
+        super(RequestError, self).__init__(message)
+        self.kind = kind
+        self.request_index = request_index
+
+
+class RequestValidationError(RequestError):
+    """Admission-time validation failure (missing column, bad
+    shape/dtype, oversized prompt, bad budget/deadline value)."""
+
+
+class WatchdogTimeout(ServingError):
+    """The decode watchdog gave up on a wedged chunk dispatch."""
+
+
+def error_record(kind, request_index, message, tokens_done=0,
+                 partial=None):
+    """The typed record a failed/shed/expired request yields at its
+    input-order position.  Consumers distinguish records from normal
+    rows by the single ``"error"`` key::
+
+        {"error": {"kind": "deadline", "request_index": 3,
+                   "message": "...", "tokens_done": 2,
+                   "partial": [17, 4]}}
+
+    ``kind`` is one of: ``missing_input`` / ``bad_dtype`` /
+    ``bad_shape`` / ``empty_prompt`` / ``too_long`` / ``bad_budget``
+    / ``bad_deadline`` (validation), ``admit`` / ``predict``
+    (per-request capture), ``shed`` (admission control), ``deadline``
+    (expiry — carries the committed ``partial`` tokens).
+    """
+    rec = {
+        "kind": str(kind),
+        "request_index": int(request_index),
+        "message": str(message),
+        "tokens_done": int(tokens_done),
+    }
+    if partial is not None:
+        rec["partial"] = [int(t) for t in partial]
+    return {"error": rec}
+
+
+def apply_output_mapping(out, output_mapping):
+    """Rename predictor outputs to row columns; unknown names fail
+    fast (a CALLER config error — never converted to a record)."""
+    if not output_mapping:
+        return out
+    missing = [n for n in output_mapping if n not in out]
+    if missing:
+        raise KeyError(
+            "output_mapping names {0} not produced by the predictor "
+            "(outputs: {1})".format(missing, sorted(out))
+        )
+    return {col: out[name] for name, col in output_mapping.items()}
+
+
+class _DispatchWatchdog(object):
+    """Runs the engine's synchronizing device call on a worker thread
+    so a wedged dispatch can be timed out instead of hanging the
+    scheduler forever.
+
+    On timeout the watchdog is *abandoned*: the dispatched callable is
+    expected to consult :attr:`abandoned` after any injected fault
+    gate and skip the real device call, so a stale thread never
+    touches the decoder concurrently with the replacement watchdog
+    (the chaos wedge does exactly this).  A dispatch wedged INSIDE the
+    runtime keeps its daemon thread parked — recovery of the python
+    scheduler still proceeds; freeing the device itself is the
+    supervisor layer's job (docs/fault_tolerance.md).
+    """
+
+    def __init__(self):
+        self._in = queue_mod.Queue()
+        self._out = queue_mod.Queue()
+        self.abandoned = False
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="serving-watchdog"
+        )
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            fn = self._in.get()
+            if fn is None:
+                return
+            try:
+                self._out.put(("ok", fn()))
+            except BaseException as e:  # noqa: BLE001 - relayed to caller
+                self._out.put(("err", e))
+
+    def call(self, fn, timeout):
+        """Run ``fn()`` on the worker; raise :class:`WatchdogTimeout`
+        (and abandon the worker) when no result lands in time."""
+        self._in.put(fn)
+        try:
+            kind, val = self._out.get(timeout=timeout)
+        except queue_mod.Empty:
+            self.abandoned = True
+            raise WatchdogTimeout(
+                "decode chunk dispatch produced no result within "
+                "{0:.1f}s; abandoning the dispatch".format(timeout)
+            )
+        if kind == "err":
+            raise val
+        return val
+
+    def close(self):
+        if not self.abandoned:
+            self._in.put(None)
+
+
+class ServingEngine(object):
+    """Overload-safe continuous serving over a generation predictor.
+
+    Wraps :class:`~tensorflowonspark_tpu.models.transformer.SlotDecoder`
+    (via the predictor's ``make_slot_decoder`` factory) with the
+    admission/deadline/poison/watchdog machinery described in the
+    module docstring.  :meth:`serve` is a generator: feed it an
+    iterable of dict rows, get output rows back in INPUT order, with
+    typed records occupying the positions of failed/shed/expired
+    requests.
+
+    Args:
+      predict: generation predictor exposing ``make_slot_decoder``
+        (``transformer.serving_builder(mode="generate")``).
+      input_mapping: ``{column: input_name}``; exactly one column must
+        map to a ragged prompt input, optionally one to
+        :data:`BUDGET_INPUT` and one to :data:`DEADLINE_INPUT`.
+      output_mapping: optional ``{output_name: column}`` rename.
+      num_slots: in-flight KV-cache slots.
+      chunk: decode steps per dispatch (None = predictor default).
+      queue_depth: bounded admission queue (default ``2 * num_slots``).
+      policy: ``"block" | "reject" | "degrade"``.
+      degrade_floor: minimum per-request budget under ``degrade``.
+      default_deadline: seconds; applied to rows without a mapped
+        deadline column (None = no deadline).
+      watchdog_timeout: seconds; bounds every chunk sync (None = no
+        watchdog — zero thread overhead).
+      on_error: ``"raise"`` (fail fast, error names the request) or
+        ``"record"`` (poison isolation — bad rows become records).
+      wedge_fn: test hook ``fn(chunk_index)`` invoked before every
+        chunk dispatch; defaults to the chaos plan's wedge
+        (:func:`tensorflowonspark_tpu.testing.chaos.serving_wedge_fn`),
+        which is None unless ``TFOS_CHAOS_PLAN`` orders one.
+      stats: optional dict filled with scheduling counters (see
+        :meth:`serve`).
+      clock: monotonic clock override (tests).
+    """
+
+    def __init__(self, predict, input_mapping, output_mapping=None,
+                 num_slots=8, *, chunk=None, queue_depth=None,
+                 policy="block", degrade_floor=1, default_deadline=None,
+                 watchdog_timeout=None, on_error="raise", wedge_fn=None,
+                 stats=None, clock=None):
+        if policy not in POLICIES:
+            raise ValueError(
+                "policy must be one of {0}, got {1!r}".format(
+                    POLICIES, policy
+                )
+            )
+        if on_error not in ON_ERROR:
+            raise ValueError(
+                "on_error must be one of {0}, got {1!r}".format(
+                    ON_ERROR, on_error
+                )
+            )
+        factory = getattr(predict, "make_slot_decoder", None)
+        if factory is None:
+            raise ValueError(
+                "continuous serving requires a generation predictor "
+                "exposing make_slot_decoder (see transformer."
+                "serving_builder with mode='generate'); this predictor "
+                "has none"
+            )
+        column_padding = getattr(predict, "column_padding", None) or {}
+        prompt_cols = [
+            c for c in input_mapping if input_mapping[c] in column_padding
+        ]
+        if len(prompt_cols) != 1:
+            raise ValueError(
+                "continuous scheduling needs exactly one ragged prompt "
+                "column in input_mapping; got {0}".format(prompt_cols)
+            )
+        self.predict = predict
+        self.input_mapping = dict(input_mapping)
+        self.output_mapping = output_mapping
+        self.prompt_col = prompt_cols[0]
+        self.budget_col = next(
+            (c for c in input_mapping
+             if input_mapping[c] == BUDGET_INPUT), None
+        )
+        self.deadline_col = next(
+            (c for c in input_mapping
+             if input_mapping[c] == DEADLINE_INPUT), None
+        )
+        self.policy = policy
+        self.on_error = on_error
+        self.degrade_floor = max(1, int(degrade_floor))
+        self.default_deadline = (
+            None if default_deadline is None else float(default_deadline)
+        )
+        self.watchdog_timeout = (
+            None if watchdog_timeout is None else float(watchdog_timeout)
+        )
+        self.num_slots = int(num_slots)
+        self.queue_depth = (
+            max(1, int(queue_depth)) if queue_depth is not None
+            else max(1, 2 * self.num_slots)
+        )
+        self.decoder = (
+            factory(self.num_slots) if chunk is None
+            else factory(self.num_slots, chunk)
+        )
+        self.max_new = self.decoder.max_new_tokens
+        self.eos_id = self.decoder.eos_id
+        self._fill = self.eos_id if self.eos_id is not None else 0
+        # generated_len is emitted whenever ANY truncation machinery is
+        # live (eos stops, budgets, degrade) — the static path's rule,
+        # extended by the degrade policy
+        self._emit_len = (
+            self.eos_id is not None or self.budget_col is not None
+            or policy == "degrade"
+        )
+        self._clock = clock if clock is not None else time.monotonic
+        if wedge_fn is None:
+            from tensorflowonspark_tpu.testing import chaos
+
+            wedge_fn = chaos.serving_wedge_fn()
+        self._wedge = wedge_fn
+        self._watchdog = (
+            _DispatchWatchdog() if self.watchdog_timeout is not None
+            else None
+        )
+        self.stats = stats if stats is not None else {}
+        self.stats.update({
+            "latency_sec": {}, "done_at": {}, "admitted": 0,
+            "chunks": 0, "chunk_size": self.decoder.chunk_size,
+            "completed": 0, "errors": 0, "shed": 0, "expired": 0,
+            "degraded": 0, "watchdog_fires": 0, "recovered": 0,
+        })
+        # scheduler state
+        self._pending = []      # validated, waiting for a slot
+        self._slot_req = {}     # slot -> in-flight request record
+        self._finished = {}     # input idx -> output row / record
+        self._emit_next = 0
+        self._n_in = 0
+        self._exhausted = False
+        self._chunk_index = 0
+        self._t0 = self._clock()
+
+    # -- admission ------------------------------------------------------
+
+    def _validate(self, row, idx):
+        """Admission-time request validation; returns the request
+        record or raises :class:`RequestValidationError` naming the
+        request index and the offending column."""
+        for col in sorted(self.input_mapping):
+            if col not in row:
+                raise RequestValidationError(
+                    "request {0} is missing input column {1!r} (mapped "
+                    "to predictor input {2!r}); present columns: "
+                    "{3}".format(
+                        idx, col, self.input_mapping[col],
+                        sorted(row) if isinstance(row, dict) else type(row),
+                    ),
+                    kind="missing_input", request_index=idx,
+                )
+        try:
+            prompt = np.asarray(row[self.prompt_col])
+        except Exception as e:  # noqa: BLE001 - anything non-arrayable
+            raise RequestValidationError(
+                "request {0}: prompt column {1!r} is not array-like: "
+                "{2}".format(idx, self.prompt_col, e),
+                kind="bad_dtype", request_index=idx,
+            )
+        if prompt.dtype.kind not in "iu":
+            raise RequestValidationError(
+                "request {0}: prompt column {1!r} must hold integer "
+                "token ids, got dtype {2}".format(
+                    idx, self.prompt_col, prompt.dtype
+                ),
+                kind="bad_dtype", request_index=idx,
+            )
+        if prompt.ndim != 1:
+            raise RequestValidationError(
+                "request {0}: prompt column {1!r} must be 1-D, got "
+                "shape {2}".format(idx, self.prompt_col, prompt.shape),
+                kind="bad_shape", request_index=idx,
+            )
+        if prompt.shape[0] == 0:
+            raise RequestValidationError(
+                "request {0}: prompt column {1!r} is empty".format(
+                    idx, self.prompt_col
+                ),
+                kind="empty_prompt", request_index=idx,
+            )
+        n = int(prompt.shape[0])
+        if n + self.max_new > self.decoder.cache_len:
+            raise RequestValidationError(
+                "request {0}: prompt ({1} tokens) + max_new_tokens "
+                "({2}) exceeds the engine cache_len={3}".format(
+                    idx, n, self.max_new, self.decoder.cache_len
+                ),
+                kind="too_long", request_index=idx,
+            )
+        budget = self.max_new
+        if self.budget_col is not None:
+            try:
+                budget = int(row[self.budget_col])
+            except (TypeError, ValueError) as e:
+                raise RequestValidationError(
+                    "request {0}: budget column {1!r} is not an "
+                    "integer: {2}".format(idx, self.budget_col, e),
+                    kind="bad_budget", request_index=idx,
+                )
+            budget = max(1, min(budget, self.max_new))
+        deadline = self.default_deadline
+        if self.deadline_col is not None:
+            try:
+                deadline = float(row[self.deadline_col])
+            except (TypeError, ValueError) as e:
+                raise RequestValidationError(
+                    "request {0}: deadline column {1!r} is not a "
+                    "number: {2}".format(idx, self.deadline_col, e),
+                    kind="bad_deadline", request_index=idx,
+                )
+        now = self._clock()
+        return {
+            "idx": idx,
+            "prompt": prompt.astype(np.int32, copy=False),
+            "budget": budget,
+            "eos_at": None,
+            "out": None,
+            "submit": now,
+            "deadline_at": None if deadline is None else now + deadline,
+        }
+
+    def _record(self, idx, kind, message, tokens_done=0, partial=None):
+        self._finished[idx] = error_record(
+            kind, idx, message, tokens_done=tokens_done, partial=partial
+        )
+
+    def _pull_one(self, it):
+        """Pull + validate ONE row from the source; returns a request,
+        or None when the source is exhausted.  Invalid rows become
+        records (``on_error="record"``) and pulling continues."""
+        while not self._exhausted:
+            try:
+                row = next(it)
+            except StopIteration:
+                self._exhausted = True
+                return None
+            idx = self._n_in
+            self._n_in += 1
+            try:
+                return self._validate(row, idx)
+            except RequestValidationError as e:
+                if self.on_error == "raise":
+                    raise
+                self.stats["errors"] += 1
+                self._record(idx, e.kind, e)
+        return None
+
+    def _refill(self, it):
+        """Policy-dependent queue refill.
+
+        ``block`` pulls nothing here — requests are pulled one per
+        free slot at admission time, so the source iterator itself is
+        the backpressure.  ``reject``/``degrade`` drain the source
+        eagerly (every available request has *arrived*): ``reject``
+        keeps ``queue_depth`` waiting and sheds the rest as typed
+        records; ``degrade`` accepts everything and lets admission
+        shrink budgets against the backlog."""
+        if self.policy == "block":
+            return
+        # a free slot is admission capacity too: the refill runs just
+        # before _admit_free, so counting only queue_depth would shed
+        # requests a slot was about to take
+        cap = self.queue_depth + len(self.decoder.free_slots())
+        while not self._exhausted:
+            if self.policy == "reject" and len(self._pending) >= cap:
+                req = self._pull_one(it)
+                if req is None:
+                    return
+                self.stats["shed"] += 1
+                self._record(
+                    req["idx"], "shed",
+                    "request {0} shed: admission queue full "
+                    "({1} waiting, depth {2}, policy 'reject')".format(
+                        req["idx"], len(self._pending), self.queue_depth
+                    ),
+                )
+                continue
+            req = self._pull_one(it)
+            if req is None:
+                return
+            self._pending.append(req)
+
+    def _expire_pending(self):
+        """Queued requests whose deadline passed before a slot freed
+        expire in place (typed record, nothing dispatched)."""
+        now = self._clock()
+        keep = []
+        for req in self._pending:
+            if req["deadline_at"] is not None and now > req["deadline_at"]:
+                self.stats["expired"] += 1
+                self._record(
+                    req["idx"], "deadline",
+                    "request {0} expired after {1:.3f}s waiting for a "
+                    "slot (deadline {2:.3f}s)".format(
+                        req["idx"], now - req["submit"],
+                        req["deadline_at"] - req["submit"],
+                    ),
+                    tokens_done=0, partial=[],
+                )
+            else:
+                keep.append(req)
+        self._pending = keep
+
+    def _admit_free(self, it):
+        """Admit into every free slot: queued requests first, then
+        (``block``) straight from the source.  A request whose slot
+        prefill raises becomes an ``admit`` record (``on_error=
+        "record"``) instead of killing the batch.  Returns True when
+        at least one request was consumed (admitted OR recorded) —
+        the scheduler's progress signal."""
+        progressed = False
+        for slot in self.decoder.free_slots():
+            req = self._pending.pop(0) if self._pending else (
+                self._pull_one(it) if self.policy == "block" else None
+            )
+            if req is None:
+                return progressed
+            progressed = True
+            if self.policy == "degrade" and "resume_prompt" not in req:
+                # never re-shrink a watchdog-recovered request: its
+                # committed prefix already counts against the budget
+                backlog = len(self._pending)
+                if backlog > self.queue_depth:
+                    shrunk = max(
+                        self.degrade_floor,
+                        (req["budget"] * self.queue_depth) // backlog,
+                    )
+                    if shrunk < req["budget"]:
+                        req["budget"] = shrunk
+                        self.stats["degraded"] += 1
+            prompt = req.get("resume_prompt", req["prompt"])
+            try:
+                # admit is a single ASYNC dispatch; the first token
+                # comes back as an unsynchronized device scalar,
+                # resolved at the next chunk boundary
+                first = self.decoder.admit(slot, prompt)
+            except Exception as e:  # noqa: BLE001 - per-request capture
+                if self.on_error == "raise":
+                    raise RequestError(
+                        "request {0}: admission failed: {1}".format(
+                            req["idx"], e
+                        ),
+                        kind="admit", request_index=req["idx"],
+                    ) from e
+                self.stats["errors"] += 1
+                self._record(req["idx"], "admit", e)
+                continue  # the slot stays free for the next request
+            committed = req["out"] or []
+            req["out"] = list(committed) + [first]
+            self.stats["admitted"] += 1
+            self._slot_req[slot] = req
+        return progressed
+
+    # -- decode + recovery ---------------------------------------------
+
+    def _run_chunk(self):
+        """One decode chunk under the watchdog; returns the token
+        block, or None when the watchdog fired (state already
+        recovered)."""
+        idx = self._chunk_index
+        self._chunk_index += 1
+        wedge = self._wedge
+        wd = self._watchdog
+        if wd is None:
+            if wedge is not None:
+                wedge(idx)
+            toks = self.decoder.step_chunk()
+        else:
+            def dispatch():
+                if wedge is not None:
+                    wedge(idx)
+                if wd.abandoned:
+                    # the scheduler timed this dispatch out while the
+                    # fault gate held it; never touch the decoder from
+                    # the stale thread
+                    return None
+                return self.decoder.step_chunk()
+
+            try:
+                toks = wd.call(dispatch, self.watchdog_timeout)
+            except WatchdogTimeout as e:
+                logger.warning("serving watchdog: %s — recovering "
+                               "%d in-flight request(s)", e,
+                               len(self._slot_req))
+                self._recover()
+                return None
+        self.stats["chunks"] += 1
+        return toks
+
+    def _recover(self):
+        """Tear the engine down after a wedged dispatch and re-admit
+        every in-flight request from its already-committed tokens.
+
+        The lost chunk's tokens (and any unresolved first-token
+        scalar) are dropped; each request's committed prefix is
+        appended to its prompt and the pair re-prefills into a fresh
+        slot, so greedy decode resumes exactly where the last
+        *synchronized* chunk left it — token-identical continuations
+        (the same masked-prefill invariant the continuous/static
+        parity tests pin down).  Re-admitted requests go to the FRONT
+        of the queue in input order; their deadlines keep running."""
+        self.stats["watchdog_fires"] += 1
+        inflight = sorted(
+            self._slot_req.values(), key=lambda r: r["idx"]
+        )
+        self._slot_req.clear()
+        self.decoder.reset()
+        for req in inflight:
+            committed = [t for t in (req["out"] or [])
+                         if isinstance(t, int)]
+            req["out"] = committed
+            req["resume_prompt"] = (
+                np.concatenate(
+                    [req["prompt"],
+                     np.asarray(committed, np.int32)]
+                ) if committed else req["prompt"]
+            )
+            self.stats["recovered"] += 1
+        self._pending[:0] = inflight
+        self._watchdog = _DispatchWatchdog()
+
+    # -- consume / finalize --------------------------------------------
+
+    def _consume(self, req, chunk_row):
+        """Fold a slot's chunk tokens into its request; True when the
+        request completed (first eos, or its budget).  The trailing
+        element of ``out`` may be the admit dispatch's unresolved
+        device scalar — resolving it here is the sync the chunk pull
+        already paid for."""
+        out = req["out"]
+        if out and not isinstance(out[-1], int):
+            last = int(np.asarray(out[-1]))
+            out[-1] = last
+            if self.eos_id is not None and last == self.eos_id:
+                req["eos_at"] = len(out) - 1
+        for t in (() if chunk_row is None else chunk_row):
+            if req["eos_at"] is not None or len(out) >= req["budget"]:
+                break
+            out.append(int(t))
+            if self.eos_id is not None and int(t) == self.eos_id:
+                req["eos_at"] = len(out) - 1
+        return req["eos_at"] is not None or len(out) >= req["budget"]
+
+    def _finalize(self, req, t_done):
+        arr = np.full((self.max_new,), self._fill, np.int32)
+        toks = req["out"][:self.max_new]
+        arr[:len(toks)] = toks
+        gen_len = (
+            req["eos_at"] if req["eos_at"] is not None else req["budget"]
+        )
+        out = {"generated": arr}
+        if self._emit_len:
+            out["generated_len"] = np.int32(gen_len)
+        self._finished[req["idx"]] = apply_output_mapping(
+            out, self.output_mapping
+        )
+        self.stats["completed"] += 1
+        self.stats["latency_sec"][req["idx"]] = t_done - req["submit"]
+        self.stats["done_at"][req["idx"]] = t_done - self._t0
+
+    def _expire_slot(self, slot, req, now):
+        """Cancel an expired in-flight lane between chunks; neighbors
+        keep decoding undisturbed and nothing recompiles."""
+        committed = [t for t in req["out"] if isinstance(t, int)]
+        self.stats["expired"] += 1
+        self._record(
+            req["idx"], "deadline",
+            "request {0} cancelled after {1:.3f}s (deadline "
+            "{2:.3f}s); {3} token(s) completed".format(
+                req["idx"], now - req["submit"],
+                req["deadline_at"] - req["submit"], len(committed),
+            ),
+            tokens_done=len(committed), partial=committed,
+        )
+        self.decoder.cancel(slot)
+        del self._slot_req[slot]
+
+    def _drain_ready(self):
+        """Stream completed rows in input order as soon as the head of
+        the reorder buffer is ready."""
+        while self._emit_next in self._finished:
+            yield self._finished.pop(self._emit_next)
+            self._emit_next += 1
+
+    # -- the scheduling loop -------------------------------------------
+
+    def serve(self, rows):
+        """Run the engine over ``rows``; yields output rows/records in
+        input order.  Fills ``self.stats`` with ``latency_sec`` /
+        ``done_at`` (per completed request), ``admitted`` / ``chunks``
+        / ``completed`` counters, and the robustness counters
+        ``errors`` / ``shed`` / ``expired`` / ``degraded`` /
+        ``watchdog_fires`` / ``recovered``."""
+        it = iter(rows)
+        try:
+            while True:
+                self._refill(it)
+                self._expire_pending()
+                progressed = self._admit_free(it)
+                for r in self._drain_ready():
+                    yield r
+                if not self._slot_req:
+                    if self._pending or not self._exhausted:
+                        if progressed:
+                            # every admit this pass failed into records
+                            # (on_error="record"); requests are still
+                            # being consumed — keep scheduling
+                            continue
+                        # nothing in flight, nothing consumable: only
+                        # reachable with zero slots; guard against an
+                        # impossible-progress spin
+                        raise RuntimeError(
+                            "continuous scheduler cannot make progress "
+                            "(no slots available)"
+                        )
+                    for r in self._drain_ready():
+                        yield r
+                    return
+                toks = self._run_chunk()
+                if toks is None:
+                    continue  # watchdog fired; state already recovered
+                t_chunk = self._clock()
+                for slot, req in list(self._slot_req.items()):
+                    if self._consume(req, toks[slot]):
+                        self._finalize(req, t_chunk)
+                        self.decoder.evict(slot)
+                        del self._slot_req[slot]
+                    elif (req["deadline_at"] is not None
+                          and t_chunk > req["deadline_at"]):
+                        self._expire_slot(slot, req, t_chunk)
+                for r in self._drain_ready():
+                    yield r
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.close()

@@ -19,6 +19,13 @@ that fails must fail the same way every run):
   to put in front of a reservation server or node manager; it can
   refuse the next N connections or cut every live one on command,
   driving the client retry/backoff paths end to end.
+- **serving faults** (the serving-side family, PR 4): the same plan
+  file can order a ``wedge_dispatch`` (a decode chunk that stalls
+  like a hung XLA call, driving the ServingEngine watchdog through
+  :func:`serving_wedge_fn`); :func:`poison_row` builds deterministic
+  malformed requests for every admission-validation class; and
+  :func:`slow_consumer` stalls the output side the way a slow
+  downstream does.
 
 Nothing here runs unless a test opts in: ``heartbeat_chaos_fn`` returns
 ``None`` when ``TFOS_CHAOS_PLAN`` is unset, so production paths carry a
@@ -67,6 +74,20 @@ class ChaosPlan(object):
         self.faults.append(
             {"kind": "drop_heartbeats", "executor_id": int(executor_id),
              "beats": int(beats)}
+        )
+        return self
+
+    def wedge_dispatch(self, at_chunk, hang_sec=30.0):
+        """Wedge the serving engine's decode dispatch: the first chunk
+        whose index reaches ``at_chunk`` stalls for ``hang_sec``
+        before the device call — what a hung XLA dispatch looks like
+        to the scheduler.  Fires once per fault entry; the serving
+        watchdog (``watchdog_timeout``) is expected to abandon it and
+        re-admit the in-flight requests
+        (tests/test_chaos_serving.py)."""
+        self.faults.append(
+            {"kind": "wedge_dispatch", "at_chunk": int(at_chunk),
+             "hang_sec": float(hang_sec)}
         )
         return self
 
@@ -155,6 +176,94 @@ def heartbeat_chaos_fn(executor_id):
         return False
 
     return drop
+
+
+def serving_wedge_fn():
+    """Build the :class:`ServingEngine` wedge hook from the plan, or
+    None when no plan orders ``wedge_dispatch`` faults (the common
+    case — the engine carries a single None check of overhead).
+
+    Returns ``maybe_wedge(chunk_index)``: sleeps ``hang_sec`` inside
+    the engine's dispatch thread when an armed fault's ``at_chunk``
+    is due.  Each fault fires once, in plan order — two entries model
+    a dispatch that wedges again after recovery."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    wedges = [f for f in plan.faults if f["kind"] == "wedge_dispatch"]
+    if not wedges:
+        return None
+    import time as _time
+
+    spent = set()
+
+    def maybe_wedge(chunk_index):
+        for i, f in enumerate(wedges):
+            if i not in spent and chunk_index >= f["at_chunk"]:
+                spent.add(i)
+                logger.warning(
+                    "chaos: wedging decode dispatch at chunk %d for "
+                    "%.1fs per plan", chunk_index, f["hang_sec"],
+                )
+                _time.sleep(f["hang_sec"])
+                return
+
+    return maybe_wedge
+
+
+#: poison-payload kinds :func:`poison_row` can build — one per
+#: admission-validation failure class of the serving engine
+POISON_KINDS = (
+    "missing_key", "bad_dtype", "bad_shape", "empty", "oversized",
+    "bad_budget",
+)
+
+
+def poison_row(kind, prompt_col="prompt", length=8, vocab=64, seed=0):
+    """A deterministic malformed serving request of a named ``kind``
+    (see :data:`POISON_KINDS`) — the poison half of the serving chaos
+    family.  Each returns a dict row that passes through the normal
+    request path and must be isolated at admission
+    (``on_error="record"``) instead of killing the batch."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    good = rng.randint(0, vocab, (length,)).astype(np.int32)
+    if kind == "missing_key":
+        return {prompt_col + "_typo": good}
+    if kind == "bad_dtype":
+        return {prompt_col: good.astype(np.float32) + 0.5}
+    if kind == "bad_shape":
+        return {prompt_col: np.stack([good, good])}
+    if kind == "empty":
+        return {prompt_col: np.zeros((0,), np.int32)}
+    if kind == "oversized":
+        return {prompt_col: rng.randint(
+            0, vocab, (1 << 16,)
+        ).astype(np.int32)}
+    if kind == "bad_budget":
+        return {prompt_col: good, "max_new": "not-a-number"}
+    raise ValueError(
+        "unknown poison kind {0!r}; pick one of {1}".format(
+            kind, POISON_KINDS
+        )
+    )
+
+
+def slow_consumer(outputs, stall_sec=0.01, every=1):
+    """Wrap a ``predict_rows`` output generator with consumer-side
+    stalls: sleep ``stall_sec`` before every ``every``-th pull — the
+    slow-downstream half of the serving chaos family.  The engine only
+    advances between pulls, so a stalled consumer delays chunk
+    boundaries; deadline expiry under the stall is CORRECT behavior
+    and the emit-order/no-silent-drop invariants must survive it
+    (tests/test_chaos_serving.py)."""
+    import time as _time
+
+    for i, row in enumerate(outputs):
+        if i % max(1, int(every)) == 0:
+            _time.sleep(stall_sec)
+        yield row
 
 
 def kill_compute(cluster, executor_id, sig=signal.SIGKILL):

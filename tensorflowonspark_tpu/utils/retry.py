@@ -12,9 +12,16 @@ This module is the single policy all of them share:
 - **full jitter** — each sleep is drawn uniformly from ``[delay/2,
   delay]`` so a fleet of clients desynchronizes instead of stampeding
   (the AWS "full jitter" result);
-- **hard deadline** — the loop exhausts on wall-clock, not attempt
+- **hard deadline** — the loop exhausts on elapsed time, not attempt
   count, so callers reason in seconds ("give the server 30s to come
-  back"), and the final error names what was being retried.
+  back"), and the final error names what was being retried.  The
+  deadline is measured on ``time.monotonic()`` — NEVER the wall clock:
+  an NTP step or a laptop suspend would otherwise spuriously expire a
+  budget (backwards-compatible clients give up while the server is
+  healthy) or extend it unboundedly (a "30s" retry loop spinning for
+  hours).  The clock is injectable (``clock=``) so the immunity is
+  regression-tested with a patched clock
+  (tests/test_chaos.py::test_backoff_immune_to_wall_clock_jumps).
 """
 
 import logging
@@ -53,7 +60,7 @@ class Backoff(object):
     """
 
     def __init__(self, deadline=30.0, base=0.1, factor=2.0, max_delay=5.0,
-                 sleep=time.sleep, rng=None):
+                 sleep=time.sleep, rng=None, clock=time.monotonic):
         self.deadline = deadline
         self.base = base
         self.factor = factor
@@ -62,6 +69,10 @@ class Backoff(object):
         self.last_error = None
         self._sleep = sleep
         self._rng = rng if rng is not None else random
+        #: deadline clock — monotonic by contract (wall-clock jumps
+        #: must not expire or extend retry budgets); injectable so
+        #: tests can drive it deterministically
+        self._clock = clock
         self._end = None  # armed at first iteration, not construction
 
     def note(self, exc):
@@ -72,7 +83,7 @@ class Backoff(object):
         return self
 
     def __next__(self):
-        now = time.monotonic()
+        now = self._clock()
         if self._end is None:
             self._end = now + self.deadline
         elif now >= self._end:
@@ -103,7 +114,8 @@ class Backoff(object):
 
 
 def retry_call(fn, what, exceptions=(OSError,), deadline=30.0, base=0.1,
-               factor=2.0, max_delay=5.0, on_retry=None):
+               factor=2.0, max_delay=5.0, on_retry=None,
+               clock=time.monotonic):
     """Call ``fn()`` until it returns, retrying ``exceptions`` with
     jittered exponential backoff under a hard ``deadline``.
 
@@ -121,7 +133,7 @@ def retry_call(fn, what, exceptions=(OSError,), deadline=30.0, base=0.1,
     underlying error) on deadline exhaustion.
     """
     bo = Backoff(deadline=deadline, base=base, factor=factor,
-                 max_delay=max_delay)
+                 max_delay=max_delay, clock=clock)
     for attempt in bo:
         try:
             return fn()

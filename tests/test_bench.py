@@ -64,6 +64,16 @@ def _full_record():
                 "chunks": 25, "speedup_vs_static": 1.31,
             },
         },
+        "serving_overload": {
+            "rows": 48, "slots": 4, "queue_depth": 4,
+            "block": {"goodput_rows_s": 9.1, "completed": 48, "shed": 0,
+                      "latency_p50_ms": 2600.0, "latency_p99_ms": 5100.0},
+            "reject": {"goodput_rows_s": 11.8, "completed": 9, "shed": 39,
+                       "latency_p50_ms": 420.0, "latency_p99_ms": 760.0},
+            "degrade": {"goodput_rows_s": 21.4, "completed": 48,
+                        "degraded": 31, "latency_p50_ms": 900.0,
+                        "latency_p99_ms": 2200.0},
+        },
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
                         "resnet50": {"rows_per_sec": 51.5}},
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
@@ -95,6 +105,7 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["moe_tok_s"] is None  # not in the default record
     assert parsed["serving_generate_rows_s"] == 59.77
     assert parsed["serving_continuous_rows_s"] == 78.41
+    assert parsed["serving_overload_goodput"] == 11.8  # reject-policy row
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
     assert parsed["wall_sec"] == 741.2
@@ -107,7 +118,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
     assert sorted(json.loads(line)) == sorted([
         "resnet50_img_s", "vs_baseline", "lm_tok_s", "lm_mfu",
         "spark_feed_steps_s", "moe_tok_s", "serving_generate_rows_s",
-        "serving_continuous_rows_s", "async_ps_compressed_steps_s",
+        "serving_continuous_rows_s", "serving_overload_goodput",
+        "async_ps_compressed_steps_s",
         "async_vs_sync", "wall_sec", "full_record",
     ])
 

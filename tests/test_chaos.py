@@ -147,6 +147,48 @@ def test_backoff_respects_deadline():
     assert "nope" in str(err)
 
 
+def test_backoff_immune_to_wall_clock_jumps():
+    # satellite: deadlines run on a monotonic clock, injectable for
+    # tests.  A patched clock drives the budget deterministically: a
+    # simulated wall-clock step (NTP, suspend) must neither spuriously
+    # expire a live budget nor extend an exhausted one.
+    class Clock(object):
+        def __init__(self):
+            self.t = 100.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    bo = Backoff(deadline=10.0, base=0.01, sleep=lambda s: None,
+                 clock=clk)
+    it = iter(bo)
+    next(it)            # arms the deadline at t=100
+    clk.t = 109.0       # 9s elapsed: still inside the budget
+    next(it)
+    clk.t = 110.5       # past the 10s budget: exhausted
+    with pytest.raises(StopIteration):
+        next(it)
+
+    # a backwards wall-clock step CANNOT revive the budget (monotonic
+    # clocks never go backwards; the injected clock proves the policy
+    # depends only on the clock handed to it, never time.time())
+    clk2 = Clock()
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        clk2.t += 0.05  # each attempt costs 50ms of monotonic time
+        raise OSError("still down")
+
+    with pytest.raises(RetryError, match="patched-clock target"):
+        retry_call(always, "patched-clock target", deadline=0.2,
+                   base=0.01, clock=clk2)
+    # elapsed-time exhaustion: ~0.2s / 0.05s-per-attempt, not the
+    # hours a wall-clock-jumped loop would spin for
+    assert 2 <= calls["n"] <= 10
+
+
 def test_retry_call_succeeds_after_transient_failures():
     calls = {"n": 0}
 

@@ -1,0 +1,294 @@
+"""Serving-side chaos tests (PR 4): the overload-safe engine under
+deterministic fault injection.
+
+Fast tests (no `slow` marker) drive each serving fault family from
+`testing/chaos.py` in-process — poison payloads through admission,
+plan-file wedge hooks through the watchdog, slow-consumer stalls
+through the emit path — and run in the tier-1 lane and the CI
+`chaos-serving` lane.  The combined kill-and-recover e2e (poison +
+one wedged dispatch + offered load 2x admission capacity, per
+policy) carries `slow`.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import serving, serving_engine
+from tensorflowonspark_tpu.testing import chaos
+
+pytestmark = [pytest.mark.chaos, pytest.mark.chaos_serving]
+
+TINY = {
+    "vocab_size": 64, "num_layers": 2, "num_heads": 2, "head_dim": 8,
+    "embed_dim": 16, "mlp_dim": 32, "max_seq_len": 96, "dtype": "float32",
+}
+
+
+def _gen_predict(max_new=6, extra=None):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    model = tr.Transformer(tr.TransformerConfig(**TINY))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cfg = dict(TINY, mode="generate", max_new_tokens=max_new,
+               pad_multiple=16, **(extra or {}))
+    return tr.serving_builder(jax.tree.map(np.asarray, params), cfg)
+
+
+def _prompts(lens, seed=7):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 64, (n,)).astype(np.int32) for n in lens]
+
+
+# ----------------------------------------------------------------------
+# poison payloads (fast)
+# ----------------------------------------------------------------------
+
+
+def test_poison_rows_are_deterministic_and_named():
+    for kind in chaos.POISON_KINDS:
+        a, b = chaos.poison_row(kind), chaos.poison_row(kind)
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(
+                np.asarray(a[k], dtype=object if k == "max_new" else None),
+                np.asarray(b[k], dtype=object if k == "max_new" else None),
+            )
+    with pytest.raises(ValueError, match="unknown poison kind"):
+        chaos.poison_row("nope")
+
+
+def test_every_poison_kind_is_isolated_at_admission():
+    # each malformed family becomes a typed record at its own input
+    # position; the healthy neighbors are untouched
+    predict = _gen_predict(max_new=4)
+    good = _prompts([6, 5])
+    rows = [{"prompt": good[0], "max_new": 4}]
+    for k in chaos.POISON_KINDS:
+        row = chaos.poison_row(k)
+        # the budget column is mapped, so every row must carry it;
+        # bad_budget brings its own (poisoned) value
+        row.setdefault("max_new", 4)
+        rows.append(row)
+    rows.append({"prompt": good[1], "max_new": 4})
+    out = list(serving.predict_rows(
+        predict, rows, {"prompt": "tokens", "max_new": "max_new"},
+        batch_size=2, schedule="continuous", on_error="record",
+    ))
+    assert len(out) == len(rows)
+    assert "error" not in out[0] and "error" not in out[-1]
+    expected_kind = {
+        "missing_key": "missing_input", "bad_dtype": "bad_dtype",
+        "bad_shape": "bad_shape", "empty": "empty_prompt",
+        "oversized": "too_long", "bad_budget": "bad_budget",
+    }
+    for i, kind in enumerate(chaos.POISON_KINDS):
+        err = out[1 + i]["error"]
+        assert err["kind"] == expected_kind[kind], kind
+        assert err["request_index"] == 1 + i
+
+
+def test_poison_fails_fast_by_default():
+    # on_error="raise" (the default) keeps fail-fast semantics but the
+    # error names the poisoned request
+    predict = _gen_predict(max_new=4)
+    rows = [{"prompt": _prompts([6])[0]}, chaos.poison_row("bad_dtype")]
+    with pytest.raises(
+        serving_engine.RequestValidationError, match="request 1"
+    ):
+        list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous",
+        ))
+
+
+# ----------------------------------------------------------------------
+# plan-file wedge hook (fast)
+# ----------------------------------------------------------------------
+
+
+def test_no_plan_means_no_wedge(monkeypatch):
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    assert chaos.serving_wedge_fn() is None
+
+
+def test_plan_without_wedge_faults_means_no_wedge(tmp_path, monkeypatch):
+    plan = chaos.ChaosPlan().kill_worker(1, at_step=3)
+    plan.save(tmp_path / "plan.json")
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(tmp_path / "plan.json"))
+    assert chaos.serving_wedge_fn() is None
+
+
+def test_wedge_fires_once_per_fault_entry(tmp_path, monkeypatch):
+    plan = chaos.ChaosPlan().wedge_dispatch(2, hang_sec=0.05)
+    plan.wedge_dispatch(5, hang_sec=0.05)
+    plan.save(tmp_path / "plan.json")
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(tmp_path / "plan.json"))
+    wedge = chaos.serving_wedge_fn()
+    assert wedge is not None
+    walls = []
+    for idx in range(8):
+        t0 = time.perf_counter()
+        wedge(idx)
+        walls.append(time.perf_counter() - t0)
+    stalled = [i for i, w in enumerate(walls) if w > 0.04]
+    assert stalled == [2, 5]  # one fire per entry, in plan order
+
+
+def test_engine_picks_wedge_up_from_plan_env(tmp_path, monkeypatch):
+    # the default wedge_fn route: TFOS_CHAOS_PLAN orders a wedge, the
+    # engine's watchdog abandons it and recovery completes the run
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    predict = _gen_predict(max_new=8, extra={"chunk_size": 2})
+    rows = [{"prompt": p} for p in _prompts([4, 7, 5])]
+    ref = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], {"prompt": "tokens"},
+        batch_size=2, schedule="continuous",
+    ))  # reference runs BEFORE the plan is advertised
+    plan = chaos.ChaosPlan().wedge_dispatch(1, hang_sec=1.0)
+    plan.save(tmp_path / "plan.json")
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(tmp_path / "plan.json"))
+    stats = {}
+    out = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], {"prompt": "tokens"},
+        batch_size=2, schedule="continuous", watchdog_timeout=0.25,
+        stats=stats,
+    ))
+    assert len(out) == len(rows)
+    assert all("error" not in r for r in out)
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(
+            np.asarray(got["generated"]), np.asarray(want["generated"])
+        )
+
+
+# ----------------------------------------------------------------------
+# slow consumer (fast)
+# ----------------------------------------------------------------------
+
+
+def test_slow_consumer_preserves_order_and_drops_nothing():
+    predict = _gen_predict(max_new=4)
+    rows = [{"prompt": p} for p in _prompts([4, 6, 5, 7, 3])]
+    ref = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], {"prompt": "tokens"},
+        batch_size=2, schedule="continuous",
+    ))
+    out = list(chaos.slow_consumer(
+        serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous",
+        ),
+        stall_sec=0.02, every=2,
+    ))
+    assert len(out) == len(ref)
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(
+            np.asarray(got["generated"]), np.asarray(want["generated"])
+        )
+
+
+def test_slow_consumer_stall_can_expire_deadlines():
+    # a stalled downstream delays chunk boundaries; requests whose
+    # deadline passes under the stall expire as typed records (CORRECT
+    # behavior) and the no-silent-drop invariant survives
+    predict = _gen_predict(max_new=8, extra={"chunk_size": 1})
+    rows = [{"prompt": p} for p in _prompts([4, 6, 5, 7])]
+    stats = {}
+    out = list(chaos.slow_consumer(
+        serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=1,
+            schedule="continuous", default_deadline=0.05, stats=stats,
+        ),
+        stall_sec=0.2, every=1,
+    ))
+    assert len(out) == len(rows)  # nothing dropped silently
+    assert all(
+        "error" not in r or r["error"]["kind"] == "deadline" for r in out
+    )
+    assert stats["completed"] + stats["expired"] == len(rows)
+
+
+# ----------------------------------------------------------------------
+# combined kill-and-recover e2e (slow): poison + one wedged dispatch +
+# offered load 2x admission capacity, per policy
+# ----------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("policy", ["block", "reject", "degrade"])
+def test_e2e_poison_wedge_overload_never_drops_or_deadlocks(
+    tmp_path, monkeypatch, policy
+):
+    slots, queue_depth, max_new = 2, 4, 8
+    predict = _gen_predict(max_new=max_new, extra={"chunk_size": 2})
+    lens = [4, 7, 5, 9, 3, 6, 8, 4, 5, 7, 6, 4]  # 12 = 2x (slots+queue)
+    prompts = _prompts(lens)
+    clean_rows = [{"prompt": p} for p in prompts]
+    # unperturbed reference run (block policy, no faults)
+    ref = list(serving.predict_rows(
+        predict, [dict(r) for r in clean_rows], {"prompt": "tokens"},
+        batch_size=slots, schedule="continuous",
+    ))
+    # fault plan: one wedged dispatch mid-stream
+    plan = chaos.ChaosPlan().wedge_dispatch(3, hang_sec=2.0)
+    plan.save(tmp_path / "plan.json")
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(tmp_path / "plan.json"))
+    # poison requests interleaved into the burst
+    rows = [dict(r) for r in clean_rows]
+    rows.insert(3, chaos.poison_row("bad_dtype"))
+    rows.insert(8, chaos.poison_row("missing_key"))
+    stats = {}
+    t0 = time.monotonic()
+    out = list(serving.predict_rows(
+        predict, rows, {"prompt": "tokens"}, batch_size=slots,
+        schedule="continuous", policy=policy, queue_depth=queue_depth,
+        on_error="record", watchdog_timeout=0.25, stats=stats,
+    ))
+    wall = time.monotonic() - t0
+    assert wall < 60.0  # never deadlocks (wedge hangs 2s, watchdog 0.25s)
+    # every request is accounted: one output per input, input order
+    assert len(out) == len(rows)
+    assert stats["watchdog_fires"] >= 1
+    assert out[3]["error"]["kind"] == "bad_dtype"
+    assert out[8]["error"]["kind"] == "missing_input"
+    # map output positions back to the clean reference rows
+    src = [i for i in range(len(rows)) if i not in (3, 8)]
+    completed = errored = 0
+    for pos, ref_i in zip(src, range(len(clean_rows))):
+        r = out[pos]
+        if "error" in r:
+            # typed record only: shed (reject) — deadlines aren't armed
+            assert r["error"]["kind"] == "shed", r["error"]
+            assert policy == "reject"
+            assert r["error"]["request_index"] == pos
+            errored += 1
+        else:
+            got = np.asarray(r["generated"])
+            want = np.asarray(ref[ref_i]["generated"])
+            if policy == "degrade":
+                # degrade trades tokens for bounded latency: outputs
+                # are exact PREFIXES of the clean run, never garbage
+                ln = int(r["generated_len"])
+                assert ln >= 1
+                np.testing.assert_array_equal(
+                    got[:ln], want[:ln], err_msg="row %d" % ref_i
+                )
+            else:
+                # unaffected requests are token-identical
+                np.testing.assert_array_equal(
+                    got, want, err_msg="row %d" % ref_i
+                )
+            completed += 1
+    assert completed + errored == len(clean_rows)
+    if policy in ("block", "degrade"):
+        assert errored == 0 and completed == len(clean_rows)
+    else:
+        assert stats["shed"] == errored > 0
+    assert stats["completed"] == completed

@@ -342,3 +342,38 @@ def test_transform_native_probe_evaluates_one_partition(monkeypatch, tmp_path, _
     # the first partition computes
     assert log == [0]
     assert [tuple(f) for f in out.schema] == [("pred", "float")]
+
+
+def test_transform_native_on_error_record_isolates_poison(
+    monkeypatch, tmp_path, _linear_export
+):
+    # PR 4 poison isolation through the Estimator/Model surface: with
+    # setOnError("record") a malformed row becomes a typed error
+    # record at its position (surfaced through an "error" column in
+    # the output schema) and its neighbors keep their predictions;
+    # the default stays fail-fast
+    parts, vals = _parts(1, 3)
+    poison = _FakeRow(x=[1.0, 0.0, 9.0])  # ragged: poisons np.stack
+    parts[0][1] = poison
+    log = []
+    m = _mk_model(
+        _linear_export, monkeypatch,
+        extra_args={
+            "output_schema": [("pred", "float"), ("error", "string")]
+        },
+    )
+    assert m.getOnError() == "raise"  # fail-fast default
+    with pytest.raises(Exception):
+        m.transform(_FakeDataFrame(parts, log)).collect()
+
+    out = m.setOnError("record").transform(_FakeDataFrame(parts, []))
+    got = out.collect()
+    assert len(got) == 3
+    for pos, v in ((0, vals[0]), (2, vals[2])):
+        assert got[pos][1] is None
+        np.testing.assert_allclose(
+            got[pos][0], float(np.dot(v, W_TRUE)), rtol=1e-5
+        )
+    rec = got[1][1]
+    assert rec["kind"] == "predict" and rec["request_index"] == 1
+    assert got[1][0] is None

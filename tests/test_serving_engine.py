@@ -1,0 +1,546 @@
+"""Overload-safe serving engine tests (PR 4 tentpole).
+
+Covers the robustness layer around the continuous slot scheduler
+(`tensorflowonspark_tpu/serving_engine.py` + the `predict_rows`
+surgery): admission validation with named errors, poison isolation
+(`on_error="record"`) on both schedules, per-request deadlines with
+slot-level cancellation, the `block | reject | degrade` shedding
+policies, the decode watchdog's in-flight recovery, and the
+emit-order / compile-count invariants the satellites pin down.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import serving, serving_engine
+
+TINY = {
+    "vocab_size": 64, "num_layers": 2, "num_heads": 2, "head_dim": 8,
+    "embed_dim": 16, "mlp_dim": 32, "max_seq_len": 96, "dtype": "float32",
+}
+
+
+def _gen_predict(max_new=6, extra=None, tiny=None):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    tiny = dict(tiny or TINY)
+    model = tr.Transformer(tr.TransformerConfig(**tiny))
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    cfg = dict(tiny, mode="generate", max_new_tokens=max_new,
+               pad_multiple=16, **(extra or {}))
+    predict = tr.serving_builder(jax.tree.map(np.asarray, params), cfg)
+    return model, params, predict
+
+
+def _prompts(lens, vocab=64, seed=13):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, vocab, (n,)).astype(np.int32) for n in lens]
+
+
+def _rows(lens, **extra_cols):
+    prompts = _prompts(lens)
+    rows = [{"prompt": p} for p in prompts]
+    for k, vals in extra_cols.items():
+        for r, v in zip(rows, vals):
+            r[k] = v
+    return prompts, rows
+
+
+# ----------------------------------------------------------------------
+# admission validation + poison isolation
+# ----------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_static_missing_key_names_request_and_column(self, tmp_path):
+        # satellite: a missing mapped key used to KeyError mid-batch
+        # from deep inside _flush; now admission names both
+        from tensorflowonspark_tpu.checkpoint import save_for_serving
+
+        export_dir = str(tmp_path / "export")
+        save_for_serving(
+            export_dir, {"w": np.array([1.0, 1.0], np.float32),
+                         "b": np.float32(0.0)},
+            extra_metadata={
+                "model_ref":
+                    "tensorflowonspark_tpu.models.linear:serving_builder",
+                "model_config": {"input_name": "features"},
+            },
+        )
+        predict = serving.load_predictor(export_dir, use_cache=False)
+        rows = [{"col": [1.0, 2.0]}, {"oops": [3.0, 4.0]}]
+        with pytest.raises(
+            serving.RequestValidationError,
+            match=r"request 1 is missing input column 'col'.*'features'",
+        ):
+            list(serving.predict_rows(
+                predict, rows, {"col": "features"}, batch_size=4
+            ))
+        # record mode: the batch survives, the bad row becomes a record
+        out = list(serving.predict_rows(
+            predict, rows, {"col": "features"}, batch_size=4,
+            on_error="record",
+        ))
+        assert len(out) == 2
+        assert "error" not in out[0]
+        assert out[1]["error"]["kind"] == "missing_input"
+        assert out[1]["error"]["request_index"] == 1
+
+    def test_static_poison_batch_isolated_per_row(self, tmp_path):
+        # a row that kills batch ASSEMBLY (ragged feature length) is
+        # isolated by the per-row fallback; healthy neighbors keep
+        # their normal outputs
+        from tensorflowonspark_tpu.checkpoint import save_for_serving
+
+        export_dir = str(tmp_path / "export")
+        save_for_serving(
+            export_dir, {"w": np.array([2.0, 0.0], np.float32),
+                         "b": np.float32(1.0)},
+            extra_metadata={
+                "model_ref":
+                    "tensorflowonspark_tpu.models.linear:serving_builder",
+                "model_config": {"input_name": "features"},
+            },
+        )
+        predict = serving.load_predictor(export_dir, use_cache=False)
+        rows = [
+            {"col": [1.0, 0.0]},
+            {"col": [1.0, 0.0, 7.0]},  # wrong length: poisons np.stack
+            {"col": [3.0, 0.0]},
+        ]
+        out = list(serving.predict_rows(
+            predict, rows, {"col": "features"}, batch_size=4,
+            on_error="record",
+        ))
+        assert len(out) == 3
+        assert float(out[0]["prediction"]) == pytest.approx(3.0, abs=1e-5)
+        assert out[1]["error"]["kind"] == "predict"
+        assert out[1]["error"]["request_index"] == 1
+        assert float(out[2]["prediction"]) == pytest.approx(7.0, abs=1e-5)
+
+    def test_continuous_validation_kinds(self):
+        _, _, predict = _gen_predict(max_new=4)
+        good = _prompts([5])[0]
+        rows = [
+            {"prompt": good},
+            {"nope": good},                                # missing_input
+            {"prompt": good.astype(np.float32)},           # bad_dtype
+            {"prompt": np.stack([good, good])},            # bad_shape
+            {"prompt": np.zeros((0,), np.int32)},          # empty_prompt
+            {"prompt": np.arange(500, dtype=np.int32) % 64},  # too_long
+            {"prompt": good},
+        ]
+        out = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", on_error="record",
+        ))
+        assert len(out) == len(rows)
+        kinds = [
+            r["error"]["kind"] if "error" in r else "ok" for r in out
+        ]
+        assert kinds == [
+            "ok", "missing_input", "bad_dtype", "bad_shape",
+            "empty_prompt", "too_long", "ok",
+        ]
+        # healthy neighbors are token-identical to an all-good run
+        ref = list(serving.predict_rows(
+            predict, [rows[0], rows[-1]], {"prompt": "tokens"},
+            batch_size=2, schedule="continuous",
+        ))
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["generated"]),
+            np.asarray(ref[0]["generated"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out[-1]["generated"]),
+            np.asarray(ref[1]["generated"]),
+        )
+
+    def test_continuous_raise_mode_names_request(self):
+        _, _, predict = _gen_predict(max_new=4)
+        rows = [{"prompt": _prompts([5])[0]}, {"wrong": [1, 2]}]
+        with pytest.raises(
+            serving.RequestValidationError, match="request 1"
+        ):
+            list(serving.predict_rows(
+                predict, rows, {"prompt": "tokens"}, batch_size=2,
+                schedule="continuous",
+            ))
+
+    def test_bad_budget_is_named(self):
+        _, _, predict = _gen_predict(max_new=4)
+        rows = [{"prompt": _prompts([5])[0], "max_new": "banana"}]
+        out = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens", "max_new": "max_new"},
+            batch_size=2, schedule="continuous", on_error="record",
+        ))
+        assert out[0]["error"]["kind"] == "bad_budget"
+
+    def test_admit_failures_drain_as_records_without_stall(self):
+        # if MORE than num_slots requests fail at admit (device-side,
+        # past validation) in record mode, the scheduler must keep
+        # consuming the queue — not trip the no-progress guard
+        class _Decoder:
+            max_new_tokens, eos_id, cache_len, chunk_size = 4, None, 64, 4
+
+            def __init__(self, n):
+                self._n = n
+
+            def free_slots(self):
+                return list(range(self._n))
+
+            def admit(self, slot, prompt):
+                raise RuntimeError("device OOM")
+
+        class _Pred:
+            column_padding = {"tokens": 0}
+
+            def make_slot_decoder(self, n, chunk=None):
+                return _Decoder(n)
+
+        rows = [{"prompt": np.arange(1, 4, dtype=np.int32)}
+                for _ in range(5)]
+        eng = serving_engine.ServingEngine(
+            _Pred(), {"prompt": "tokens"}, num_slots=2,
+            policy="degrade", on_error="record",
+        )
+        out = list(eng.serve(rows))
+        assert len(out) == 5
+        assert all(r["error"]["kind"] == "admit" for r in out)
+        assert eng.stats["errors"] == 5
+        # raise mode: fail fast, naming the request
+        eng2 = serving_engine.ServingEngine(
+            _Pred(), {"prompt": "tokens"}, num_slots=2,
+        )
+        with pytest.raises(
+            serving_engine.RequestError, match="request 0.*device OOM"
+        ):
+            list(eng2.serve(rows))
+
+    def test_overload_knobs_rejected_on_static_schedule(self, tmp_path):
+        with pytest.raises(ValueError, match="continuous-schedule"):
+            list(serving.predict_rows(
+                lambda b: b, [], {"col": "x"}, policy="reject"
+            ))
+
+
+# ----------------------------------------------------------------------
+# per-request deadlines + slot cancellation
+# ----------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_expired_lane_cancelled_neighbors_unaffected(self):
+        # row 1 carries an already-hopeless deadline; it is cancelled
+        # between chunks with a typed record carrying its committed
+        # prefix, and rows 0/2 match a deadline-free run exactly
+        _, _, predict = _gen_predict(
+            max_new=12, extra={"chunk_size": 2}
+        )
+        prompts, rows = _rows([4, 7, 9])
+        ref = list(serving.predict_rows(
+            predict, [dict(r) for r in rows], {"prompt": "tokens"},
+            batch_size=3, schedule="continuous",
+        ))
+        for r, d in zip(rows, [1e9, 1e-6, 1e9]):
+            r["deadline_sec"] = d
+        out = list(serving.predict_rows(
+            predict, rows,
+            {"prompt": "tokens", "deadline_sec": "deadline_sec"},
+            batch_size=3, schedule="continuous",
+        ))
+        assert len(out) == 3
+        np.testing.assert_array_equal(
+            np.asarray(out[0]["generated"]),
+            np.asarray(ref[0]["generated"]),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out[2]["generated"]),
+            np.asarray(ref[2]["generated"]),
+        )
+        err = out[1]["error"]
+        assert err["kind"] == "deadline"
+        assert err["request_index"] == 1
+        # the committed prefix is the static path's prefix
+        assert err["partial"] == [
+            int(t) for t in
+            np.asarray(ref[1]["generated"])[:err["tokens_done"]]
+        ]
+
+    def test_queued_request_expires_before_admission(self):
+        # num_slots=1 serializes and degrade drains the source eagerly,
+        # so rows 1/2 sit in the admission queue while row 0 holds the
+        # slot; their hopeless deadlines expire them in the QUEUE — a
+        # typed record with zero tokens, nothing ever dispatched
+        _, _, predict = _gen_predict(max_new=8)
+        prompts, rows = _rows(
+            [4, 6, 5], deadline_sec=[1e9, 1e-6, 1e-6]
+        )
+        stats = {}
+        out = list(serving.predict_rows(
+            predict, rows,
+            {"prompt": "tokens", "deadline_sec": "deadline_sec"},
+            batch_size=1, schedule="continuous", policy="degrade",
+            stats=stats,
+        ))
+        assert len(out) == 3
+        assert "error" not in out[0]
+        assert all("error" in r and r["error"]["kind"] == "deadline"
+                   and r["error"]["tokens_done"] == 0 for r in out[1:])
+        assert stats["expired"] == 2 and stats["admitted"] == 1
+
+    def test_cancellation_adds_no_programs(self):
+        # satellite: cancellation must not grow the compiled-program
+        # census — an expired lane is evicted, not re-traced
+        _, _, predict = _gen_predict(
+            max_new=10, extra={"chunk_size": 2}
+        )
+        decoder = predict.make_slot_decoder(2)
+        prompts, rows = _rows([4, 7, 5, 9])
+        list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous",
+        ))
+        counts = decoder.compile_counts()
+        for r in rows:
+            r["deadline_sec"] = 1e-6
+        out = list(serving.predict_rows(
+            predict, rows,
+            {"prompt": "tokens", "deadline_sec": "deadline_sec"},
+            batch_size=2, schedule="continuous",
+        ))
+        assert all("error" in r for r in out)
+        assert decoder.compile_counts() == counts
+
+
+# ----------------------------------------------------------------------
+# admission policies
+# ----------------------------------------------------------------------
+
+
+class TestPolicies:
+    def test_reject_sheds_past_queue_bound(self):
+        _, _, predict = _gen_predict(max_new=4)
+        prompts, rows = _rows([4] * 10)
+        stats = {}
+        out = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", policy="reject", queue_depth=2,
+            stats=stats,
+        ))
+        assert len(out) == 10  # nothing dropped silently
+        shed = [r for r in out if "error" in r]
+        served = [r for r in out if "error" not in r]
+        # capacity at the burst: 2 slots + 2 queued = 4 served
+        assert len(served) == 4 and len(shed) == 6
+        assert all(r["error"]["kind"] == "shed" for r in shed)
+        assert stats["shed"] == 6 and stats["completed"] == 4
+        # served rows are the FIRST four (arrival order), and shed
+        # records sit at their own input positions
+        assert [r["error"]["request_index"] for r in shed] == \
+            list(range(4, 10))
+
+    def test_degrade_shrinks_budgets_under_backlog(self):
+        _, _, predict = _gen_predict(max_new=12)
+        prompts, rows = _rows([4] * 12)
+        stats = {}
+        out = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", policy="degrade", queue_depth=2,
+            stats=stats,
+        ))
+        assert len(out) == 12
+        assert all("error" not in r for r in out)  # nothing shed
+        assert stats["degraded"] > 0
+        lens = [int(r["generated_len"]) for r in out]
+        # early rows see the full backlog -> shrunk budgets; the
+        # backlog drains, so the tail runs at (or near) full budget
+        assert min(lens) < 12 and max(lens) == 12
+        assert all(ln >= 1 for ln in lens)
+        # degraded outputs are PREFIXES of the undegraded run
+        ref = list(serving.predict_rows(
+            predict, [{"prompt": p} for p in prompts],
+            {"prompt": "tokens"}, batch_size=1,
+        ))
+        for i, ln in enumerate(lens):
+            np.testing.assert_array_equal(
+                np.asarray(out[i]["generated"])[:ln],
+                np.asarray(ref[i]["generated"])[:ln], err_msg=str(i),
+            )
+
+    def test_block_serves_everything(self):
+        _, _, predict = _gen_predict(max_new=4)
+        prompts, rows = _rows([4] * 9)
+        stats = {}
+        out = list(serving.predict_rows(
+            predict, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", policy="block", queue_depth=2,
+            stats=stats,
+        ))
+        assert len(out) == 9
+        assert all("error" not in r for r in out)
+        assert stats["shed"] == 0 and stats["completed"] == 9
+
+    def test_bad_policy_rejected(self):
+        _, _, predict = _gen_predict(max_new=4)
+        with pytest.raises(ValueError, match="policy"):
+            list(serving.predict_rows(
+                predict, [], {"prompt": "tokens"}, batch_size=2,
+                schedule="continuous", policy="nope",
+            ))
+
+
+# ----------------------------------------------------------------------
+# decode watchdog + in-flight recovery
+# ----------------------------------------------------------------------
+
+
+class _WedgeOnce:
+    """Engine-level wedge: stall the given chunk index once, long
+    enough to trip the watchdog."""
+
+    def __init__(self, at_chunk, hang_sec):
+        self.at_chunk = at_chunk
+        self.hang_sec = hang_sec
+        self.fired = 0
+
+    def __call__(self, chunk_index):
+        if self.fired == 0 and chunk_index >= self.at_chunk:
+            self.fired += 1
+            time.sleep(self.hang_sec)
+
+
+class TestWatchdog:
+    def _engine_out(self, predict, rows, wedge, **kw):
+        stats = {}
+        eng = serving_engine.ServingEngine(
+            predict, {"prompt": "tokens"}, num_slots=2,
+            watchdog_timeout=0.25, wedge_fn=wedge, stats=stats, **kw
+        )
+        return list(eng.serve(rows)), stats, eng
+
+    def test_recovery_is_token_identical(self):
+        # a wedged chunk sync is abandoned; in-flight requests
+        # re-admit from their committed tokens and finish with the
+        # exact tokens of an unperturbed run (greedy)
+        _, _, predict = _gen_predict(
+            max_new=10, extra={"chunk_size": 2}
+        )
+        prompts, rows = _rows([4, 7, 5, 9, 3])
+        ref = list(serving.predict_rows(
+            predict, [dict(r) for r in rows], {"prompt": "tokens"},
+            batch_size=2, schedule="continuous",
+        ))
+        wedge = _WedgeOnce(at_chunk=2, hang_sec=1.0)
+        out, stats, _ = self._engine_out(predict, rows, wedge)
+        assert wedge.fired == 1
+        assert stats["watchdog_fires"] == 1
+        assert stats["recovered"] >= 1
+        assert len(out) == len(rows)
+        for i in range(len(rows)):
+            assert "error" not in out[i], out[i]
+            np.testing.assert_array_equal(
+                np.asarray(out[i]["generated"]),
+                np.asarray(ref[i]["generated"]), err_msg=str(i),
+            )
+
+    def test_recovery_adds_no_programs(self):
+        # satellite: re-admit re-uses the existing prefill buckets and
+        # the one chunk program — the census must not grow.  Prompt
+        # lengths are chosen so prompt+committed stays inside the same
+        # 16-bucket.
+        _, _, predict = _gen_predict(
+            max_new=4, extra={"chunk_size": 2}
+        )
+        decoder = predict.make_slot_decoder(2)
+        prompts, rows = _rows([4, 7, 5, 6])
+        list(serving.predict_rows(
+            predict, [dict(r) for r in rows], {"prompt": "tokens"},
+            batch_size=2, schedule="continuous",
+        ))
+        counts = decoder.compile_counts()
+        wedge = _WedgeOnce(at_chunk=1, hang_sec=1.0)
+        out, stats, _ = self._engine_out(predict, rows, wedge)
+        assert stats["watchdog_fires"] == 1
+        assert len(out) == len(rows)
+        assert decoder.compile_counts() == counts
+
+    def test_no_watchdog_no_thread(self):
+        _, _, predict = _gen_predict(max_new=4)
+        eng = serving_engine.ServingEngine(
+            predict, {"prompt": "tokens"}, num_slots=2
+        )
+        assert eng._watchdog is None  # zero overhead by default
+
+
+# ----------------------------------------------------------------------
+# emit-order invariant (satellite)
+# ----------------------------------------------------------------------
+
+
+def test_emit_order_under_mixed_evict_reasons():
+    # eos stops, per-request budgets, deadline expiries, and poison
+    # records all in one job: rows must come back in INPUT order, one
+    # output (row or record) per request
+    model, params, predict0 = _gen_predict(max_new=8)
+    prompts, rows0 = _rows([4, 7, 11, 2, 9, 5])
+    free = list(serving.predict_rows(
+        predict0, rows0, {"prompt": "tokens"}, batch_size=1
+    ))
+    eos = int(np.asarray(free[0]["generated"])[2])  # row 0 stops early
+    _, _, predict = _gen_predict(
+        max_new=8, extra={"eos_id": eos, "chunk_size": 2}
+    )
+    ref = list(serving.predict_rows(
+        predict, rows0, {"prompt": "tokens"}, batch_size=1
+    ))
+    rows = [dict(r) for r in rows0]
+    budgets = [8, 2, 8, 8, 3, 8]          # rows 1/4 evict on budget
+    deadlines = [1e9, 1e9, 1e-6, 1e9, 1e9, 1e9]  # row 2 expires
+    for r, b, d in zip(rows, budgets, deadlines):
+        r["max_new"], r["deadline_sec"] = b, d
+    rows.insert(3, {"poison": np.arange(3, dtype=np.int32)})  # record
+    out = list(serving.predict_rows(
+        predict, rows,
+        {"prompt": "tokens", "max_new": "max_new",
+         "deadline_sec": "deadline_sec"},
+        batch_size=2, schedule="continuous", on_error="record",
+    ))
+    assert len(out) == len(rows)
+    # records sit exactly at their input positions
+    assert out[2]["error"]["kind"] == "deadline"
+    assert out[2]["error"]["request_index"] == 2
+    assert out[3]["error"]["kind"] == "missing_input"
+    assert out[3]["error"]["request_index"] == 3
+    # eos/budget rows carry the static path's tokens up to their stop
+    # (positions 2/3 hold the deadline/poison records checked above)
+    src = {0: 0, 1: 1, 4: 3, 5: 4, 6: 5}  # out position -> rows0 index
+    for pos, i in src.items():
+        b = budgets[i]
+        got = np.asarray(out[pos]["generated"])
+        np.testing.assert_array_equal(
+            got[:b], np.asarray(ref[i]["generated"])[:b],
+            err_msg="row %d" % i,
+        )
+
+
+def test_stats_surface_robustness_counters():
+    _, _, predict = _gen_predict(max_new=4)
+    prompts, rows = _rows([4, 5])
+    stats = {}
+    list(serving.predict_rows(
+        predict, rows, {"prompt": "tokens"}, batch_size=2,
+        schedule="continuous", stats=stats,
+    ))
+    for key in ("latency_sec", "done_at", "admitted", "chunks",
+                "completed", "errors", "shed", "expired", "degraded",
+                "watchdog_fires", "recovered"):
+        assert key in stats, key
+    assert stats["completed"] == 2 and stats["errors"] == 0

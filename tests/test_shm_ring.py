@@ -402,3 +402,72 @@ def test_cluster_ragged_rows_through_shm_ring():
         assert int(m.get("consumed")._getvalue() or 0) == 400
     finally:
         engine.stop()
+
+
+# --- producer liveness (PR 4 satellite) --------------------------------
+
+
+def _producer_child(name, n_records):
+    """Child producer: attach, announce, push, then park forever —
+    the parent SIGKILLs it to simulate a feeder death mid-stream."""
+    import time
+
+    r = shm_ring.ShmRing(name)
+    r.announce_producer()
+    for i in range(n_records):
+        r.push(b"rec-%d" % i)
+    while True:
+        time.sleep(60)
+
+
+def test_announce_producer_roundtrip(ring):
+    p, c = ring
+    assert c.producer_pid() == 0  # zero-filled header: none announced
+    p.announce_producer()
+    assert c.producer_pid() == os.getpid()
+    p.announce_producer(pid=424242)  # a new producer overwrites
+    assert c.producer_pid() == 424242
+
+
+def test_pop_without_announced_producer_times_out_quietly(ring):
+    # rings predating the announcement (or queue-only feeds) keep the
+    # old contract: empty pop is a timeout, never an error
+    _, c = ring
+    assert c.pop(timeout=0.5) is None
+
+
+def test_pop_raises_when_child_producer_dies(ring):
+    # satellite: a consumer used to block for its FULL timeout (or
+    # forever in a retry loop) when the producer process died
+    # mid-stream; now the death is detected while waiting and raised
+    # as a named error
+    import multiprocessing
+    import signal
+    import time as _time
+
+    p, c = ring
+    child = multiprocessing.get_context("fork").Process(
+        target=_producer_child, args=(p.name, 3), daemon=True
+    )
+    child.start()
+    try:
+        # drain the records the producer DID push — delivered data is
+        # never lost to the liveness check
+        got = [c.pop(timeout=10.0) for _ in range(3)]
+        assert [bytes(g) for g in got] == [b"rec-0", b"rec-1", b"rec-2"]
+        assert c.producer_pid() == child.pid
+        os.kill(child.pid, signal.SIGKILL)
+        child.join(timeout=10.0)
+        t0 = _time.monotonic()
+        with pytest.raises(
+            shm_ring.ProducerDiedError,
+            match=r"producer pid %d died" % child.pid,
+        ):
+            # far longer than the detection path needs: the error must
+            # preempt the timeout, not ride on it
+            c.pop(timeout=60.0)
+        assert _time.monotonic() - t0 < 10.0
+    finally:
+        if child.is_alive():
+            child.kill()
+        child.join(timeout=5.0)
