@@ -14,7 +14,15 @@
 //   uint64 magic; uint64 capacity;        // data region size in bytes
 //   atomic<uint64> head;                  // next write offset (mod cap)
 //   atomic<uint64> tail;                  // next read offset (mod cap)
+//   uint64 producer_pid;                  // liveness slot (python layer)
+//   uint32 format_tag;                    // record wire-format tag
 //   uint8 data[capacity];
+//
+// format_tag names the RECORD encoding the producer writes (0 = legacy
+// pickled blocks only, 1 = dtype-tagged columnar wire records — the
+// narrow-dtype plane's self-describing [magic|json header|raw column
+// buffers] format).  Consumers read it once at attach and refuse rings
+// whose tag they don't understand instead of mis-decoding frames.
 //
 // Framing: [uint32 len][len bytes], wrapping byte-wise at the region
 // end.  A record longer than capacity-8 is rejected (-2).
@@ -34,7 +42,10 @@ struct Header {
   uint64_t capacity;
   std::atomic<uint64_t> head;
   std::atomic<uint64_t> tail;
-  uint8_t pad[64 - 2 * sizeof(uint64_t) - 2 * sizeof(std::atomic<uint64_t>)];
+  uint64_t producer_pid;  // written by the python liveness layer
+  uint32_t format_tag;    // record wire-format tag (see file comment)
+  uint8_t pad[64 - 3 * sizeof(uint64_t) - 2 * sizeof(std::atomic<uint64_t>) -
+              sizeof(uint32_t)];
 };
 
 static_assert(sizeof(Header) == 64, "header must be one cache line");
@@ -70,9 +81,26 @@ int64_t shmring_init(uint8_t* base, uint64_t total_bytes) {
   Header* h = H(base);
   h->magic = kMagic;
   h->capacity = total_bytes - sizeof(Header);
+  h->producer_pid = 0;
+  h->format_tag = 0;
   h->head.store(0, std::memory_order_relaxed);
   h->tail.store(0, std::memory_order_release);
   return static_cast<int64_t>(h->capacity);
+}
+
+// record wire-format negotiation: the creating/producing side tags the
+// segment, consumers verify before decoding.  -3 = bad segment.
+int shmring_set_format(uint8_t* base, uint32_t tag) {
+  Header* h = H(base);
+  if (h->magic != kMagic) return -3;
+  h->format_tag = tag;
+  return 0;
+}
+
+int64_t shmring_format(uint8_t* base) {
+  Header* h = H(base);
+  if (h->magic != kMagic) return -3;
+  return static_cast<int64_t>(h->format_tag);
 }
 
 // 0 = ok, -1 = full (retry later), -2 = record too large, -3 = bad segment

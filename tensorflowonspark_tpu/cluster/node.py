@@ -520,6 +520,11 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
 
                 _node._evict_stale_rings(cluster_meta["id"])
                 ring = shm_ring.ShmRing(ring_name, ring_cap, create=True)
+                # dtype-tagged segments: record the wire format the
+                # feeders will write so consumers can verify at attach
+                # (shm_ring.FORMAT_COLUMNAR_V1 — columnar records with
+                # self-describing per-column dtypes, pickle fallback)
+                ring.set_format(shm_ring.FORMAT_COLUMNAR_V1)
                 _node._LOCAL_RINGS.append((cluster_meta["id"], ring))
                 mgr.set(
                     "shm_ring", {"name": ring_name, "capacity": ring_cap}
@@ -767,6 +772,65 @@ def build_cluster_spec(cluster_info):
 # ----------------------------------------------------------------------
 
 
+class _PipelinedShipper(object):
+    """Feeder-side decode pipeline (the 'pipelined decode' stage of the
+    narrow-dtype data plane, docs/data_plane.md): a small worker pool
+    runs the CPU-bound encode — columnar pack, wire encode,
+    ``pickle.dumps`` — for block N+1 while the caller's iterator
+    deserializes block N+2 and the single pusher (the submitting
+    thread) writes block N into the shm ring.  Submission order is
+    preserved (results drain FIFO), and all pushes stay on one thread,
+    so the ring's single-producer contract holds.
+
+    Errors from encode workers re-raise in the submitting thread at the
+    next ``ship``/``close``; the feeder's error contract is unchanged.
+    """
+
+    def __init__(self, encode, push, workers=2, depth=4):
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._encode = encode
+        self._push = push
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, workers),
+            thread_name_prefix="feed-encode",
+        )
+        self._depth = max(1, depth)
+        self._pending = collections.deque()
+
+    def ship(self, rows, use_ring):
+        # bound the in-flight window, then opportunistically drain
+        # completed heads so pushes interleave with in-flight encodes
+        while len(self._pending) >= self._depth:
+            self._drain_one()
+        self._pending.append(
+            self._pool.submit(self._encode, rows, use_ring)
+        )
+        while self._pending and self._pending[0].done():
+            self._drain_one()
+
+    def _drain_one(self):
+        fut = self._pending.popleft()
+        for action in fut.result():
+            self._push(action)
+
+    def close(self):
+        """Flush every queued block in order, then stop the pool."""
+        try:
+            while self._pending:
+                self._drain_one()
+        finally:
+            self._pool.shutdown(wait=True)
+
+    def abort(self):
+        """Error-path teardown: drop queued work, stop the pool (its
+        threads are non-daemon — leaving them running would pin the
+        executor process past the failing task)."""
+        self._pending.clear()
+        self._pool.shutdown(wait=True, cancel_futures=True)
+
+
 def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
     """Build the feeder map function for training data
     (reference: TFSparkNode.py:436-503)."""
@@ -895,90 +959,137 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     )
             return ring_choice[0]
 
-        def _push_record(header, bufs):
-            """Push one wire-format record; False when it doesn't fit
-            a frame (caller falls through to the pickle/split path)."""
-            total = len(header) + sum(b.nbytes for b in bufs)
-            if total + 8 >= wire_cap:
-                return False
-            ring.pushv(
-                [header] + bufs,
-                timeout=feed_timeout,
-                error_check=lambda: _check_error_queue(mgr, err_q),
-            )
-            return True
-
-        def _ship(rows):
-            if _use_ring(rows):
-                if columnar_ok and _row_is_large(rows[0]):
-                    # zero-copy fast path: per-row buffers scatter-
-                    # gather straight into the ring — the contiguous
-                    # record write IS the column stack (no pack, no
-                    # pickle)
-                    enc = encode_rows_parts(rows)
-                    if enc is not None:
-                        if _push_record(enc[0], enc[1]):
-                            return
-                        # known oversize from the exact wire total:
-                        # split now instead of materializing a full
-                        # stacked copy below just to re-measure it
-                        if len(rows) > 1:
-                            mid = len(rows) // 2
-                            _ship(rows[:mid])
-                            _ship(rows[mid:])
-                            return
-                        # single row bigger than a ring frame: the
-                        # queue path never had a size cap
-                        queue.put(Block(rows), block=True)
+        def _encode_into(rows, use_ring, actions):
+            """Encode one block into ordered ship actions —
+            ``('pushv', parts, nbytes)`` / ``('push', payload, nbytes)``
+            / ``('queue', obj)`` — splitting blocks that exceed a ring
+            frame.  Pure CPU work (pack / wire encode / pickle): safe
+            on the shipper's worker pool, no manager or ring calls."""
+            if not use_ring:
+                actions.append(("queue", _pack(rows)))
+                return
+            if columnar_ok and _row_is_large(rows[0]):
+                # zero-copy fast path: per-row buffers scatter-gather
+                # straight into the ring — the contiguous record write
+                # IS the column stack (no pack, no pickle)
+                enc = encode_rows_parts(rows)
+                if enc is not None:
+                    header, bufs, total = enc
+                    if total + 8 < wire_cap:
+                        actions.append(("pushv", [header] + bufs, total))
                         return
-                packed = _pack(rows)
-                if isinstance(packed, ColumnarBlock):
-                    # stacked-columns path (small or scalar rows):
-                    # still zero-pickle — one copy instead of three.
-                    # None = not wire-encodable (non-string dict keys);
-                    # such blocks ship pickled below.
-                    enc2 = encode_columnar_parts(packed)
-                    if enc2 is not None:
-                        if _push_record(enc2[0], enc2[1]):
-                            return
-                        if len(rows) > 1:
-                            # known oversize from the exact wire total:
-                            # split now, don't materialize a multi-GB
-                            # pickle just to re-measure it
-                            mid = len(rows) // 2
-                            _ship(rows[:mid])
-                            _ship(rows[mid:])
-                            return
-                import pickle as _p
-
-                payload = _p.dumps(packed, protocol=5)
-                # a block that outgrows a ring frame is split, not
-                # fatal — the queue path never had a size cap; a single
-                # giant row falls back to the queue
-                if len(payload) + 8 >= wire_cap:
-                    if len(rows) == 1:
-                        queue.put(Block(rows), block=True)
+                    # known oversize from the exact wire total: split
+                    # now instead of materializing a full stacked copy
+                    # below just to re-measure it
+                    if len(rows) > 1:
+                        mid = len(rows) // 2
+                        _encode_into(rows[:mid], use_ring, actions)
+                        _encode_into(rows[mid:], use_ring, actions)
                         return
-                    mid = len(rows) // 2
-                    _ship(rows[:mid])
-                    _ship(rows[mid:])
+                    # single row bigger than a ring frame: the queue
+                    # path never had a size cap
+                    actions.append(("queue", Block(rows)))
                     return
-                ring.push(
-                    payload,
+            packed = _pack(rows)
+            if isinstance(packed, ColumnarBlock):
+                # stacked-columns path (small or scalar rows): still
+                # zero-pickle — one copy instead of three.  None = not
+                # wire-encodable (non-string dict keys); such blocks
+                # ship pickled below.
+                enc2 = encode_columnar_parts(packed)
+                if enc2 is not None:
+                    header, bufs = enc2
+                    total = len(header) + sum(b.nbytes for b in bufs)
+                    if total + 8 < wire_cap:
+                        actions.append(("pushv", [header] + bufs, total))
+                        return
+                    if len(rows) > 1:
+                        mid = len(rows) // 2
+                        _encode_into(rows[:mid], use_ring, actions)
+                        _encode_into(rows[mid:], use_ring, actions)
+                        return
+            import pickle as _p
+
+            payload = _p.dumps(packed, protocol=5)
+            # a block that outgrows a ring frame is split, not fatal —
+            # the queue path never had a size cap; a single giant row
+            # falls back to the queue
+            if len(payload) + 8 >= wire_cap:
+                if len(rows) == 1:
+                    actions.append(("queue", Block(rows)))
+                    return
+                mid = len(rows) // 2
+                _encode_into(rows[:mid], use_ring, actions)
+                _encode_into(rows[mid:], use_ring, actions)
+                return
+            actions.append(("push", payload, len(payload)))
+
+        def _encode(rows, use_ring):
+            actions = []
+            _encode_into(rows, use_ring, actions)
+            return actions
+
+        wire_sent = [0]  # ring wire bytes shipped (narrowing telemetry)
+
+        def _push_action(action):
+            """Perform one ship action — ALWAYS on the feeder's main
+            thread (the ring is SPSC: one producer)."""
+            kind = action[0]
+            if kind == "queue":
+                queue.put(action[1], block=True)
+                return
+            if kind == "pushv":
+                ring.pushv(
+                    action[1],
                     timeout=feed_timeout,
                     error_check=lambda: _check_error_queue(mgr, err_q),
                 )
             else:
-                queue.put(_pack(rows), block=True)
+                ring.push(
+                    action[1],
+                    timeout=feed_timeout,
+                    error_check=lambda: _check_error_queue(mgr, err_q),
+                )
+            wire_sent[0] += action[2]
 
-        for item in iterator:
-            count += 1
-            block.append(item)
-            if len(block) >= FEED_BLOCK_SIZE:
+        # Pipelined decode (docs/data_plane.md): encode block N+1 on a
+        # small worker pool while block N pushes and the engine iterator
+        # deserializes N+2.  TFOS_FEED_PIPELINE=0 restores the serial
+        # path (debugging / single-core executors).
+        shipper = None
+        if os.environ.get("TFOS_FEED_PIPELINE", "1") != "0":
+            shipper = _PipelinedShipper(
+                _encode,
+                _push_action,
+                workers=int(
+                    os.environ.get("TFOS_FEED_PIPELINE_WORKERS", "2")
+                ),
+                depth=int(os.environ.get("TFOS_FEED_PIPELINE_DEPTH", "4")),
+            )
+
+        def _ship(rows):
+            use_ring = _use_ring(rows)  # sticky choice: main thread only
+            if shipper is not None:
+                shipper.ship(rows, use_ring)
+            else:
+                for action in _encode(rows, use_ring):
+                    _push_action(action)
+
+        try:
+            for item in iterator:
+                count += 1
+                block.append(item)
+                if len(block) >= FEED_BLOCK_SIZE:
+                    _ship(block)
+                    block = []
+            if block:
                 _ship(block)
-                block = []
-        if block:
-            _ship(block)
+            if shipper is not None:
+                shipper.close()  # flush queued encodes, in order
+        except BaseException:
+            if shipper is not None:
+                shipper.abort()
+            raise
         # wait for consumption, surfacing compute errors promptly
         # (reference: TFSparkNode.py:472-483).  Wall-clock deadline —
         # decrementing a counter by the nominal sleep would inflate the
@@ -1022,7 +1133,9 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             # the partition is DELIVERED — it becomes durable (committed)
             # only when the compute process checkpoints past it
             mgr.ledger("deliver", pid)
-        logger.info("fed %d items", count)
+        logger.info(
+            "fed %d items (%.2f MB ring wire)", count, wire_sent[0] / 1e6
+        )
         return []
 
     return _train

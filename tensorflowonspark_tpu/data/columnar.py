@@ -11,6 +11,17 @@ without a compiler.
 
 Fixed-width numeric columns only (the training fast path); string /
 ragged features go through the row decoder.
+
+Narrow-dtype wire plane (docs/data_plane.md): ``tf.train.Example``
+only stores float32/int64, so the proto layer PROMOTES — a uint8 pixel
+costs 8 bytes as an int64 feature value.  :class:`WireSpec` and the
+narrow-dtype support in :func:`decode_batch` undo that at ingest:
+columns declared narrow (uint8/int8/int16/int32/uint16/float16) are
+value-checked and stored in their wire dtype immediately after the
+proto decode, so every later hop — ``ColumnarBlock`` pack, the shm
+ring, ``DataFeed.next_arrays``, the host→HBM DMA — ships the narrow
+bytes.  Widening back to the compute dtype happens ON DEVICE
+(:mod:`tensorflowonspark_tpu.data.preprocess`).
 """
 
 import ctypes
@@ -21,6 +32,87 @@ import numpy as np
 from tensorflowonspark_tpu.data import _native
 
 logger = logging.getLogger(__name__)
+
+#: wire dtypes decode_batch can narrow an int64-kind feature to (value
+#: checked — out-of-range raises, never silently wraps)
+NARROW_INT_DTYPES = ("uint8", "int8", "uint16", "int16", "uint32", "int32")
+#: wire dtypes a float32-kind feature can narrow to (precision-lossy by
+#: declaration — the caller chose the storage dtype)
+NARROW_FLOAT_DTYPES = ("float16",)
+
+
+def narrow_cast(arr, dtype):
+    """Cast ``arr`` to a narrower integer ``dtype`` with a VALUE check:
+    a label of 300 declared uint8 must raise, not silently wrap to 44
+    (corrupted training data).  Float narrowing (float16) is allowed
+    without the check — precision loss is the declared storage
+    contract, wrap-around is not."""
+    dtype = np.dtype(dtype)
+    if arr.dtype == dtype:
+        return arr
+    if dtype.kind in "iu":
+        info = np.iinfo(dtype)
+        if arr.size and (arr.min() < info.min or arr.max() > info.max):
+            raise ValueError(
+                "values outside {0} range [{1}, {2}] (min={3}, max={4})"
+                ": refusing the silent wrap-around".format(
+                    dtype.name, info.min, info.max,
+                    arr.min(), arr.max(),
+                )
+            )
+    return arr.astype(dtype)
+
+
+class WireSpec(object):
+    """Per-column wire (storage) dtypes for the narrow-dtype plane.
+
+    ``WireSpec({"image": "uint8", "label": "int32"})`` declares the
+    dtype each column ships in end-to-end (feeder → ring → consumer);
+    columns not named pass through unchanged.  Use :meth:`narrow` at
+    ingest (after a promoting decode) and
+    :func:`~tensorflowonspark_tpu.data.preprocess.make_preprocess` on
+    device to widen back to the compute dtype.
+    """
+
+    def __init__(self, dtypes):
+        self.dtypes = {k: np.dtype(v) for k, v in dict(dtypes).items()}
+
+    def narrow(self, columns):
+        """Cast named columns of a dict/tuple column set to their wire
+        dtypes (value-checked via :func:`narrow_cast`).  Tuple column
+        sets are addressed by integer keys in the spec."""
+        if isinstance(columns, dict):
+            return {
+                k: narrow_cast(np.asarray(v), self.dtypes[k])
+                if k in self.dtypes else v
+                for k, v in columns.items()
+            }
+        return tuple(
+            narrow_cast(np.asarray(v), self.dtypes[i])
+            if i in self.dtypes else v
+            for i, v in enumerate(columns)
+        )
+
+    def narrow_rows(self, rows):
+        """Narrow dict rows one by one (the feeder-side map for row
+        streams that are not yet columnar)."""
+        out = []
+        for row in rows:
+            out.append({
+                k: narrow_cast(np.asarray(v), self.dtypes[k])
+                if k in self.dtypes else v
+                for k, v in row.items()
+            })
+        return out
+
+    @staticmethod
+    def wire_bytes(columns):
+        """Total wire bytes of a dict/tuple column set (what one batch
+        costs on the tunnel) — the accounting half of the narrowing
+        claim (``feed.wire_stats()`` aggregates the same number on the
+        consumer side)."""
+        vals = columns.values() if isinstance(columns, dict) else columns
+        return int(sum(np.asarray(v).nbytes for v in vals))
 
 _LIB_NAME = "libexample_codec.so"
 
@@ -100,10 +192,16 @@ def decode_batch(records, columns):
 
     Args:
       records: list of ``bytes`` (serialized ``tf.train.Example``).
-      columns: ``{name: (dtype, width)}`` with dtype ``"float32"`` or
-        ``"int64"``; every record must carry exactly ``width`` values
-        (missing/ragged features raise — silent zero-fill would corrupt
-        training data).
+      columns: ``{name: (dtype, width)}``; every record must carry
+        exactly ``width`` values (missing/ragged features raise —
+        silent zero-fill would corrupt training data).  ``dtype`` is
+        ``"float32"`` / ``"int64"`` (the proto's native kinds) or a
+        NARROW wire dtype: int64-kind features narrow to any of
+        ``NARROW_INT_DTYPES`` (value-checked — an out-of-range value
+        raises instead of wrapping) and float32-kind features to
+        ``NARROW_FLOAT_DTYPES``.  Narrowing happens immediately after
+        the proto decode, so everything downstream (ColumnarBlock, shm
+        ring, device_put) ships the narrow bytes (docs/data_plane.md).
 
     Returns:
       ``{name: np.ndarray[n, width]}`` (width-1 columns keep the
@@ -118,18 +216,33 @@ def decode_batch(records, columns):
         lens = (ctypes.c_uint64 * len(records))(*[len(r) for r in records])
     out = {}
     for name, (dtype, width) in columns.items():
-        dtype = np.dtype(dtype).type
-        if dtype not in (np.float32, np.int64):
+        wire_dtype = np.dtype(dtype)
+        if wire_dtype.name in NARROW_INT_DTYPES:
+            extract_dtype = np.int64
+        elif wire_dtype.name in NARROW_FLOAT_DTYPES:
+            extract_dtype = np.float32
+        elif wire_dtype.type in (np.float32, np.int64):
+            extract_dtype = wire_dtype.type
+        else:
             raise ValueError(
-                "column {0!r}: only float32/int64 columnar decode is "
-                "supported (got {1})".format(name, dtype)
+                "column {0!r}: columnar decode supports float32/int64 "
+                "and the narrow wire dtypes {1} (got {2})".format(
+                    name,
+                    NARROW_INT_DTYPES + NARROW_FLOAT_DTYPES,
+                    wire_dtype,
+                )
             )
         if lib is not None:
-            out[name] = _extract_native(
-                lib, records, name, width, dtype, recs=recs, lens=lens
+            arr = _extract_native(
+                lib, records, name, width, extract_dtype,
+                recs=recs, lens=lens,
             )
         else:
-            out[name] = _extract_python(records, name, width, dtype)
+            arr = _extract_python(records, name, width, extract_dtype)
+        try:
+            out[name] = narrow_cast(arr, wire_dtype)
+        except ValueError as e:
+            raise ValueError("column {0!r}: {1}".format(name, e))
     return out
 
 

@@ -98,8 +98,50 @@ class DataFeed(object):
         #: which source produced the last item ("ring" | "queue") —
         #: next_batch blocks on the hot source, polls the other
         self._hot_source = "ring"
+        #: wire accounting (docs/data_plane.md): bytes/records/rows
+        #: received over the feed plane.  Ring records count their
+        #: exact wire length; queue blocks count their column/row
+        #: payload bytes (pickle framing excluded — the payload is
+        #: what dtype narrowing shrinks, and the number is comparable
+        #: across transports).
+        self.wire_bytes = 0
+        self.wire_records = 0
+        self.wire_rows = 0
 
     _RING_SENTINEL = object()  # internal: ring produced a block
+
+    def _account(self, nbytes, nrows):
+        self.wire_bytes += int(nbytes)
+        self.wire_records += 1
+        self.wire_rows += int(nrows)
+
+    def _account_item(self, item):
+        """Wire accounting for a queue-delivered element (Block /
+        ColumnarBlock / bare row): payload bytes + row count."""
+        if isinstance(item, ColumnarBlock):
+            self._account(_columns_nbytes(item.columns), item.count)
+        elif isinstance(item, Block):
+            self._account(
+                sum(_row_nbytes(r) for r in item.items), len(item.items)
+            )
+        else:
+            self._account(_row_nbytes(item), 1)
+
+    def wire_stats(self):
+        """Cumulative feed-plane wire accounting: ``wire_bytes`` (ring
+        records at exact wire length, queue blocks at payload bytes),
+        ``records``, ``rows``, and derived ``bytes_per_row`` — the
+        number the narrow-dtype plane shrinks (docs/data_plane.md;
+        asserted >= 3x smaller for uint8-vs-float32 image columns in
+        tests/test_dataplane.py)."""
+        return {
+            "wire_bytes": self.wire_bytes,
+            "records": self.wire_records,
+            "rows": self.wire_rows,
+            "bytes_per_row": (
+                self.wire_bytes / self.wire_rows if self.wire_rows else 0.0
+            ),
+        }
 
     def _fetch(self):
         """Block until the next feed element arrives; returns it.
@@ -134,12 +176,12 @@ class DataFeed(object):
                         if rec is None:
                             continue
                         self._hot_source = "ring"
-                        self._set_pending(_decode_ring_record(rec))
+                        self._install_ring_record(rec)
                         return self._RING_SENTINEL
                 else:
                     rec = self._ring_pop(0.05)
                     if rec is not None:
-                        self._set_pending(_decode_ring_record(rec))
+                        self._install_ring_record(rec)
                         return self._RING_SENTINEL
                     try:
                         item = queue_in.get(block=False)
@@ -160,6 +202,12 @@ class DataFeed(object):
                     return queue_in.get(block=True, timeout=1.0)
                 except queue_mod.Empty:
                     continue
+
+    def _install_ring_record(self, rec):
+        """Decode one ring record, install it as pending, and account
+        its EXACT wire length (the ring frame is the tunnel payload)."""
+        self._set_pending(_decode_ring_record(rec))
+        self._account(len(rec), self._pending_left())
 
     def _ring_pop(self, timeout):
         """Ring pop with producer-liveness handling: a dead feeder
@@ -249,6 +297,7 @@ class DataFeed(object):
                 self._set_pending(
                     item.items if isinstance(item, Block) else item
                 )
+                self._account_item(item)
                 queue_in.task_done()
             elif isinstance(item, EndPartition):
                 # Truncate the batch at a partition boundary
@@ -258,6 +307,7 @@ class DataFeed(object):
                     break
             else:
                 _consume(item)
+                self._account_item(item)
                 count += 1
                 queue_in.task_done()
         logger.debug("next_batch() returning %d items", count)
@@ -327,9 +377,11 @@ class DataFeed(object):
                 break
             elif isinstance(item, ColumnarBlock):
                 self._set_pending(item)
+                self._account_item(item)
                 queue_in.task_done()
             elif isinstance(item, Block):
                 self._set_pending(item.items)
+                self._account_item(item)
                 queue_in.task_done()
             elif isinstance(item, EndPartition):
                 queue_in.task_done()
@@ -337,6 +389,7 @@ class DataFeed(object):
                     break
             else:
                 self._set_pending([item])
+                self._account_item(item)
                 queue_in.task_done()
         if count == 0:
             return None, 0
@@ -363,10 +416,27 @@ class DataFeed(object):
         except Exception:  # noqa: BLE001 - kv read is best effort
             info = None
         if info:
-            from tensorflowonspark_tpu.data.shm_ring import ShmRing
+            from tensorflowonspark_tpu.data import shm_ring
 
-            self._ring = ShmRing(info["name"])
-            logger.info("consuming from shm feed ring %s", info["name"])
+            ring = shm_ring.ShmRing(info["name"])
+            # wire-format negotiation: the segment header tags the
+            # record encoding its producer writes; a tag this build
+            # doesn't know means frames would MIS-decode — stay on the
+            # queue path (correct, just slower) instead
+            tag = ring.format_tag()
+            if tag not in shm_ring.KNOWN_FORMATS:
+                logger.warning(
+                    "shm ring %s carries unknown wire-format tag %d "
+                    "(this build knows %s); staying on the queue path",
+                    info["name"], tag, shm_ring.KNOWN_FORMATS,
+                )
+                ring.close(unlink=False)
+                return
+            self._ring = ring
+            logger.info(
+                "consuming from shm feed ring %s (wire format %d)",
+                info["name"], tag,
+            )
 
     def should_stop(self):
         """True once the feeder posted the end-of-feed sentinel
@@ -471,6 +541,31 @@ class DataFeed(object):
                 yield batch
 
 
+def _columns_nbytes(cols):
+    vals = cols.values() if isinstance(cols, dict) else cols
+    return sum(getattr(np.asarray(v), "nbytes", 0) for v in vals)
+
+
+def _row_nbytes(row):
+    """Cheap payload-byte estimate of one row object (arrays exact,
+    bytes/str by length, everything else 8 — scalars and refs)."""
+    vals = (
+        row.values() if isinstance(row, dict)
+        else row if isinstance(row, (tuple, list))
+        else (row,)
+    )
+    total = 0
+    try:
+        for v in vals:
+            n = getattr(v, "nbytes", None)
+            if n is None:
+                n = len(v) if isinstance(v, (bytes, str)) else 8
+            total += n
+    except TypeError:
+        return 0
+    return total
+
+
 def _concat_pieces(pieces):
     """Join per-fragment column sets (single fragment: no copy)."""
     first = pieces[0]
@@ -524,7 +619,9 @@ def _pad_batch(batch, batch_size):
     return pad(batch)
 
 
-def prefetch_to_device(iterator, size=2, sharding=None):
+def prefetch_to_device(
+    iterator, size=2, sharding=None, preprocess=None, host_prefetch=False
+):
     """Double-buffered host→device transfer.
 
     Keeps ``size`` batches in flight: batch N+1's ``jax.device_put`` (an
@@ -541,6 +638,19 @@ def prefetch_to_device(iterator, size=2, sharding=None):
       size: number of in-flight device batches (>= 1).
       sharding: optional ``jax.sharding.Sharding`` for multi-chip
         placement of each batch (data-parallel feeding).
+      preprocess: optional on-device preprocess — a callable or a
+        :func:`~tensorflowonspark_tpu.data.preprocess.make_preprocess`
+        kwargs dict — jitted and applied AFTER the ``device_put``, so
+        narrow wire dtypes (uint8 pixels) cross the host→HBM link
+        narrow and widen in HBM (docs/data_plane.md).  Deterministic
+        only here (no rng); use ``SyncTrainer(device_preprocess=...)``
+        for rng-bearing augmentation fused into the train step.
+      host_prefetch: run the ITERATOR (host-side decode/stacking) plus
+        the ``device_put`` dispatch on a background thread with a
+        bounded ``size``-deep buffer, so host decode of batch N+1
+        overlaps compute on batch N — the last stage of the
+        decode→ring→device pipeline.  Order is preserved; iterator
+        exceptions re-raise in the consumer.
     """
     import collections
 
@@ -551,14 +661,20 @@ def prefetch_to_device(iterator, size=2, sharding=None):
             "prefetch_to_device size must be >= 1, got {0}".format(size)
         )
 
-    q = collections.deque()
+    pre = None
+    if preprocess is not None:
+        from tensorflowonspark_tpu.data import preprocess as pp_mod
+
+        pre = jax.jit(pp_mod.resolve_preprocess(preprocess))
 
     def put_tree(tree):
         if sharding is not None:
-            return jax.tree_util.tree_map(
+            tree = jax.tree_util.tree_map(
                 lambda x: jax.device_put(x, sharding), tree
             )
-        return jax.tree_util.tree_map(jax.device_put, tree)
+        else:
+            tree = jax.tree_util.tree_map(jax.device_put, tree)
+        return pre(tree) if pre is not None else tree
 
     def put(item):
         # (batch, n) from pad_to_batch: only the batch goes to device;
@@ -571,9 +687,70 @@ def prefetch_to_device(iterator, size=2, sharding=None):
             return (put_tree(item[0]), int(item[1]))
         return put_tree(item)
 
-    for item in iterator:
-        q.append(put(item))
-        if len(q) >= size:
+    if host_prefetch:
+        return _host_prefetch_gen(iterator, put, size)
+
+    def _sync_gen():
+        q = collections.deque()
+        for item in iterator:
+            q.append(put(item))
+            if len(q) >= size:
+                yield q.popleft()
+        while q:
             yield q.popleft()
-    while q:
-        yield q.popleft()
+
+    return _sync_gen()
+
+
+def _host_prefetch_gen(iterator, put, size):
+    """Background-thread variant of prefetch_to_device: the worker
+    drains the iterator and dispatches ``device_put`` into a bounded
+    queue; the consumer generator yields in order.  The worker is a
+    daemon and honors a stop flag, so abandoning the generator (or the
+    consumer erroring out) cannot deadlock on a full buffer."""
+    import queue as _q
+    import threading
+
+    out_q = _q.Queue(maxsize=size)
+    stop = threading.Event()
+
+    def worker():
+        try:
+            for item in iterator:
+                msg = ("ok", put(item))
+                while not stop.is_set():
+                    try:
+                        out_q.put(msg, timeout=0.1)
+                        break
+                    except _q.Full:
+                        continue
+                if stop.is_set():
+                    return
+            msg = ("end", None)
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+            msg = ("err", e)
+        while not stop.is_set():
+            try:
+                out_q.put(msg, timeout=0.1)
+                return
+            except _q.Full:
+                continue
+
+    t = threading.Thread(
+        target=worker, daemon=True, name="prefetch-host"
+    )
+    t.start()
+
+    def gen():
+        try:
+            while True:
+                kind, payload = out_q.get()
+                if kind == "end":
+                    return
+                if kind == "err":
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+
+    return gen()

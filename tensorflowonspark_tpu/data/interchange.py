@@ -26,11 +26,29 @@ from tensorflowonspark_tpu.utils import fs as fs_utils
 logger = logging.getLogger(__name__)
 
 #: scalar schema types (the SimpleTypeParser base-type set,
-#: SimpleTypeParser.scala:42-55)
+#: SimpleTypeParser.scala:42-55, plus the narrow-dtype plane's
+#: ``byte``/``ubyte`` extension — docs/data_plane.md: an image column
+#: declared ``ubyte`` ships uint8 end-to-end instead of promoting to
+#: the proto's int64/float32)
 SCALAR_TYPES = (
     "binary", "boolean", "double", "float", "int", "long", "string",
-    "short",
+    "short", "byte", "ubyte",
 )
+
+#: schema base type → the numpy WIRE dtype a numeric column of that
+#: type ships in (the storage dtype, not the proto kind: the proto
+#: layer promotes everything to int64/float32, and the narrow plane
+#: undoes that at ingest — see :func:`schema_wire_spec`)
+WIRE_DTYPE_OF_BASE = {
+    "boolean": "uint8",
+    "ubyte": "uint8",
+    "byte": "int8",
+    "short": "int16",
+    "int": "int32",
+    "long": "int64",
+    "float": "float32",
+    "double": "float64",
+}
 
 
 # ----------------------------------------------------------------------
@@ -127,12 +145,38 @@ _KIND_OF_BASE = {
     "binary": ex.KIND_BYTES,
     "string": ex.KIND_BYTES,
     "boolean": ex.KIND_INT64,
+    "byte": ex.KIND_INT64,
+    "ubyte": ex.KIND_INT64,
     "short": ex.KIND_INT64,
     "int": ex.KIND_INT64,
     "long": ex.KIND_INT64,
     "float": ex.KIND_FLOAT,
     "double": ex.KIND_FLOAT,
 }
+
+
+def schema_wire_spec(schema):
+    """Derive the narrow-dtype plane's per-column wire dtypes from a
+    schema (docs/data_plane.md).
+
+    ``schema`` is a ``struct<...>`` string or ``[(name, type)]``; the
+    result is a :class:`~tensorflowonspark_tpu.data.columnar.WireSpec`
+    over every numeric column — ``ubyte`` image columns come out
+    uint8, ``short`` int16, etc. — ready for ``WireSpec.narrow`` /
+    ``narrow_rows`` at the feeder, so a schema-declared storage dtype
+    is honored end-to-end instead of riding the proto's int64/float32
+    promotion.  String/binary columns are not wire-narrowable and are
+    left out (they pass through feeds untouched)."""
+    from tensorflowonspark_tpu.data import columnar
+
+    if isinstance(schema, str):
+        schema = parse_schema(schema)
+    dtypes = {}
+    for name, typ in schema:
+        base, _ = _base_of(typ)
+        if base in WIRE_DTYPE_OF_BASE:
+            dtypes[name] = WIRE_DTYPE_OF_BASE[base]
+    return columnar.WireSpec(dtypes)
 
 
 def _base_of(typ):
@@ -185,7 +229,7 @@ def example_to_row(record, schema):
             ]
         elif base == "boolean":
             values = [bool(v) for v in values]
-        elif base in ("int", "short"):
+        elif base in ("int", "short", "byte", "ubyte"):
             values = [int(v) for v in values]
         elif base == "double":
             values = [float(v) for v in values]

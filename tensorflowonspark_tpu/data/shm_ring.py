@@ -47,6 +47,18 @@ _PRODUCER_PID_OFFSET = 32
 #: empty ring (one os.kill(pid, 0) per interval — negligible)
 _LIVENESS_INTERVAL = 0.2
 
+#: record wire-format tags (the native header's format_tag field):
+#: producers tag the segment with the encoding their records use;
+#: consumers verify at attach and refuse tags they don't understand
+#: instead of mis-decoding frames.  0 = legacy (pickled blocks only);
+#: 1 = dtype-tagged columnar wire records (cluster/marker.py
+#: COLUMNAR_MAGIC format — self-describing per-column dtypes, the
+#: narrow-dtype plane) with pickle fallback.
+FORMAT_LEGACY = 0
+FORMAT_COLUMNAR_V1 = 1
+#: tags this build knows how to decode
+KNOWN_FORMATS = (FORMAT_LEGACY, FORMAT_COLUMNAR_V1)
+
 
 class ProducerDiedError(RuntimeError):
     """The ring's announced producer process died with the ring empty:
@@ -86,6 +98,10 @@ def _configure(lib):
     ]
     lib.shmring_size.restype = ctypes.c_int64
     lib.shmring_size.argtypes = [u8p]
+    lib.shmring_set_format.restype = ctypes.c_int
+    lib.shmring_set_format.argtypes = [u8p, ctypes.c_uint32]
+    lib.shmring_format.restype = ctypes.c_int64
+    lib.shmring_format.argtypes = [u8p]
 
 
 def _load():
@@ -134,6 +150,24 @@ class ShmRing(object):
 
     def _base(self):
         return self._cbase
+
+    # -- wire-format negotiation ---------------------------------------
+
+    def set_format(self, tag):
+        """Tag the segment with the record wire format its producer
+        writes (``FORMAT_*``); the creating side calls this once."""
+        rc = self._lib.shmring_set_format(self._base(), int(tag))
+        if rc == -3:
+            raise RuntimeError("corrupt ring segment")
+
+    def format_tag(self):
+        """The segment's record wire-format tag (``FORMAT_LEGACY`` on
+        segments from builds predating the tag — the header region is
+        zero-filled at creation)."""
+        tag = int(self._lib.shmring_format(self._base()))
+        if tag == -3:
+            raise RuntimeError("corrupt ring segment")
+        return tag
 
     # -- producer liveness ---------------------------------------------
 

@@ -75,6 +75,15 @@ class SyncTrainer(object):
       rules: logical→mesh sharding rules (default DP: params replicated).
       annotations: optional logical-axis pytree for the params (see
         :func:`tensorflowonspark_tpu.parallel.sharding.param_specs`).
+      device_preprocess: optional on-device batch preprocess — a
+        callable ``fn(batch)`` / ``fn(batch, rng)`` or a
+        :func:`~tensorflowonspark_tpu.data.preprocess.make_preprocess`
+        kwargs dict — traced INTO the jitted train step (and the fused
+        multi-step scan body), so narrow wire dtypes (uint8 pixels)
+        cross host→HBM narrow and widen in HBM (docs/data_plane.md).
+        An rng-taking preprocess (random flip/crop) gets a key split
+        from the step rng.  Numerics parity with the host-side float
+        path is tested in tests/test_preprocess.py.
     """
 
     def __init__(
@@ -87,7 +96,10 @@ class SyncTrainer(object):
         has_aux=False,
         has_model_state=False,
         data_axes=("data", "fsdp"),
+        device_preprocess=None,
     ):
+        from tensorflowonspark_tpu.data import preprocess as pp_mod
+
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh if mesh is not None else build_mesh()
@@ -96,6 +108,13 @@ class SyncTrainer(object):
         self.has_aux = has_aux
         self.has_model_state = has_model_state
         self.data_axes = data_axes
+        self.device_preprocess = pp_mod.resolve_preprocess(
+            device_preprocess
+        )
+        self._pre_takes_rng = (
+            self.device_preprocess is not None
+            and pp_mod.takes_rng(self.device_preprocess)
+        )
         self._step_fn = self._build_step()
         self._eval_fn = None
         self._multi_fn = None
@@ -121,8 +140,21 @@ class SyncTrainer(object):
     def _build_step(self):
         loss_fn, optimizer = self.loss_fn, self.optimizer
         has_aux, has_model_state = self.has_aux, self.has_model_state
+        pre, pre_rng = self.device_preprocess, self._pre_takes_rng
 
         def train_step(state, batch, rng):
+            # on-device preprocess, fused in front of the step: the
+            # narrow-dtype batch widens in HBM, not on the host.  An
+            # rng-bearing preprocess (augmentation) consumes a split of
+            # the step key — the loss rng chain changes ONLY when such
+            # a preprocess is installed.
+            if pre is not None:
+                if pre_rng:
+                    rng, k = jax.random.split(rng)
+                    batch = pre(batch, k)
+                else:
+                    batch = pre(batch)
+
             def _loss(p):
                 if has_model_state:
                     return loss_fn(p, state.model_state, batch, rng)

@@ -131,6 +131,17 @@ HasOnError = _mixin(
 HasOutputMapping = _mixin(
     "output_mapping", "mapping of predictor outputs to output columns"
 )
+# the narrow-dtype data plane's widening stage (docs/data_plane.md):
+# a JSON-able dict of data.preprocess.make_preprocess kwargs.  On
+# TFModel it is fused in front of the predictor on device
+# (serving.with_preprocess); on TFEstimator it rides the merged args
+# so train_fns can build SyncTrainer(device_preprocess=args.preprocess)
+HasPreprocess = _mixin(
+    "preprocess",
+    "on-device preprocess spec (data.preprocess.make_preprocess "
+    "kwargs dict) — cast/scale/normalize narrow wire dtypes in HBM "
+    "instead of on the host",
+)
 # the reference's HasProtocol chose TF's RPC fabric ('grpc'|'rdma',
 # reference: pipeline.py:189-199) — N/A on TPU, where XLA owns the
 # collective transport; the param survives as an ICI/DCN placement hint
@@ -191,6 +202,7 @@ _ESTIMATOR_MIXINS = (
     HasMasterNode,
     HasModelDir,
     HasNumPS,
+    HasPreprocess,
     HasProtocol,
     HasReservationTimeout,
     HasFeedTimeout,
@@ -205,6 +217,7 @@ _MODEL_MIXINS = (
     HasModelDir,
     HasOnError,
     HasOutputMapping,
+    HasPreprocess,
     HasSignatureDefKey,
     HasTagSet,
 )
@@ -407,16 +420,19 @@ def _run_model_iter(rows, args, predictor_builder=None):
     partition)."""
     from tensorflowonspark_tpu import serving
 
+    preprocess = getattr(args, "preprocess", None)
     key = (
         args.export_dir,
         args.signature_def_key,
         args.tag_set,
         serving._builder_key(predictor_builder),
+        serving._preprocess_key(preprocess),
     )
     if _TRANSFORM_STATE["key"] != key:
         logger.info("loading predictor for %s", key)
         _TRANSFORM_STATE["predict"] = serving.load_predictor(
-            args.export_dir, builder=predictor_builder
+            args.export_dir, builder=predictor_builder,
+            preprocess=preprocess,
         )
         _TRANSFORM_STATE["key"] = key
     predict = _TRANSFORM_STATE["predict"]

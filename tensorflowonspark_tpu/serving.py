@@ -84,7 +84,50 @@ def resolve_ref(ref):
     return obj
 
 
-def load_predictor(export_dir, builder=None, use_cache=True):
+def with_preprocess(predict, preprocess):
+    """Fuse an ON-DEVICE preprocess stage in front of ``predict``.
+
+    ``preprocess`` (a callable or a
+    :func:`~tensorflowonspark_tpu.data.preprocess.make_preprocess`
+    kwargs dict) is jitted and applied to the assembled batch before
+    the predictor — so rows kept in their narrow wire dtype (uint8
+    pixels) cross the host→device link narrow and widen in HBM
+    (docs/data_plane.md), instead of the host pre-inflating the batch
+    to float32.  Predictor batch-shape attributes (``column_padding``,
+    ``pad_multiple``, ``pad_cap``, ``make_slot_decoder``) are carried
+    over; note the continuous schedule drives ``make_slot_decoder``
+    directly, so a preprocess stage applies to the STATIC schedule and
+    non-generation predictors.
+    """
+    import jax
+
+    from tensorflowonspark_tpu.data import preprocess as pp_mod
+
+    pre = jax.jit(pp_mod.resolve_preprocess(preprocess))
+
+    def wrapped(batch):
+        return predict(pre(batch))
+
+    for attr in (
+        "column_padding", "pad_multiple", "pad_cap", "make_slot_decoder"
+    ):
+        if hasattr(predict, attr):
+            setattr(wrapped, attr, getattr(predict, attr))
+    return wrapped
+
+
+def _preprocess_key(preprocess):
+    """Cache-key component for a preprocess argument: dict specs key by
+    their (sorted) contents, callables by content digest."""
+    if preprocess is None:
+        return None
+    if isinstance(preprocess, dict):
+        return json.dumps(preprocess, sort_keys=True, default=str)
+    return _builder_key(preprocess)
+
+
+def load_predictor(export_dir, builder=None, use_cache=True,
+                   preprocess=None):
     """Load a serving export and return its ``predict`` callable.
 
     Args:
@@ -95,8 +138,20 @@ def load_predictor(export_dir, builder=None, use_cache=True):
       use_cache: reuse a previously built predictor for the same export
         (the per-process singleton the reference kept,
         TFModel.scala:257-263).
+      preprocess: optional on-device preprocess fused in front of the
+        predictor (see :func:`with_preprocess`) — a callable or a
+        ``make_preprocess`` kwargs dict.  Defaults to the export
+        metadata's ``"preprocess"`` key, so an export can declare its
+        own wire contract ("ship me uint8, I widen on device"):
+        ``save_for_serving(..., extra_metadata={"preprocess":
+        {"scale": 1/255}})``.  Pass ``False`` to disable even the
+        metadata-declared stage (the caller widens on the host).
     """
-    key = (os.path.abspath(os.fspath(export_dir)), _builder_key(builder))
+    key = (
+        os.path.abspath(os.fspath(export_dir)),
+        _builder_key(builder),
+        _preprocess_key(preprocess),
+    )
     if use_cache and key in _PREDICTOR_CACHE:
         return _PREDICTOR_CACHE[key]
 
@@ -113,6 +168,10 @@ def load_predictor(export_dir, builder=None, use_cache=True):
             )
         builder = resolve_ref(ref)
     predict = builder(params, meta.get("model_config") or {})
+    if preprocess is None:
+        preprocess = meta.get("preprocess")
+    if preprocess is not None and preprocess is not False:
+        predict = with_preprocess(predict, preprocess)
     if use_cache:
         _PREDICTOR_CACHE[key] = predict
     return predict

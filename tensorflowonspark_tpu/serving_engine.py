@@ -326,6 +326,10 @@ class ServingEngine(object):
             "chunks": 0, "chunk_size": self.decoder.chunk_size,
             "completed": 0, "errors": 0, "shed": 0, "expired": 0,
             "degraded": 0, "watchdog_fires": 0, "recovered": 0,
+            # wire accounting (docs/data_plane.md): prompt bytes of
+            # admitted requests as they cross to the device — int32
+            # today; narrower token dtypes would show up here
+            "request_wire_bytes": 0,
         })
         # scheduler state
         self._pending = []      # validated, waiting for a slot
@@ -553,6 +557,9 @@ class ServingEngine(object):
             committed = req["out"] or []
             req["out"] = list(committed) + [first]
             self.stats["admitted"] += 1
+            self.stats["request_wire_bytes"] += int(
+                getattr(prompt, "nbytes", 0)
+            )
             self._slot_req[slot] = req
         return progressed
 

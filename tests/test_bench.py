@@ -32,7 +32,16 @@ def _full_record():
             "queue": {"rows_per_sec": 5664.8, "steps_per_sec": 88.51,
                       "steps": 1280, "feed_wall_sec": 29.96},
             "ring": {"rows_per_sec": 6100.0, "steps_per_sec": 95.31,
-                     "steps": 1280, "feed_wall_sec": 27.1},
+                     "steps": 1280, "feed_wall_sec": 27.1,
+                     "wire_mb_per_step": 0.0512},
+            "ring_f32": {"rows_per_sec": 5100.0, "steps_per_sec": 79.7,
+                         "wire_mb_per_step": 0.2016},
+            "wire_narrowing": {
+                "uint8_wire_mb_per_step": 0.0512,
+                "float32_wire_mb_per_step": 0.2016,
+                "wire_ratio": 3.94,
+                "uint8_vs_float32_rows": 1.2,
+            },
             "image_queue": {"rows_per_sec": 612.3, "mb_per_sec": 92.2},
             "image_ring": {"rows_per_sec": 2368.8, "mb_per_sec": 356.6},
             "ring_vs_queue": 1.08,
@@ -75,7 +84,14 @@ def _full_record():
                         "latency_p99_ms": 2200.0},
         },
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
-                        "resnet50": {"rows_per_sec": 51.5}},
+                        "resnet50": {"rows_per_sec": 51.5,
+                                     "wire_mb_per_batch": 38.535},
+                        "resnet50_uint8": {"rows_per_sec": 172.0,
+                                           "wire_mb_per_batch": 9.634},
+                        "uint8_wire_ratio": 4.0,
+                        "uint8_vs_float32_rows": 3.34},
+        "dataplane": {"batches": 48, "sync_wall_sec": 1.62,
+                      "overlap_wall_sec": 1.21, "overlap_gain": 1.34},
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
                          "async_compressed_steps_per_sec": 61.7,
                          "async_compressed_wire_kb_per_step": 812.4,
@@ -108,6 +124,9 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["serving_overload_goodput"] == 11.8  # reject-policy row
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
+    assert parsed["feed_wire_mb_per_step"] == 0.0512  # narrowed wire
+    assert parsed["serving_u8_vs_f32"] == 3.34
+    assert parsed["decode_overlap_gain"] == 1.34
     assert parsed["wall_sec"] == 741.2
 
 
@@ -120,8 +139,27 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "spark_feed_steps_s", "moe_tok_s", "serving_generate_rows_s",
         "serving_continuous_rows_s", "serving_overload_goodput",
         "async_ps_compressed_steps_s",
-        "async_vs_sync", "wall_sec", "full_record",
+        "async_vs_sync", "feed_wire_mb_per_step", "serving_u8_vs_f32",
+        "decode_overlap_gain", "wall_sec", "full_record",
     ])
+
+
+def test_summary_survives_an_absurd_full_record_path(tmp_path):
+    # every summary value is a plucked number; the one unbounded field
+    # is the full-record PATH — a deeply nested run directory must not
+    # push the line past the driver's tail window (the r5 failure mode
+    # regression-tested at its root)
+    deep = tmp_path
+    for i in range(40):
+        deep = deep / ("deeply-nested-run-directory-%02d" % i)
+    deep.mkdir(parents=True)
+    line = bench.emit_record(
+        _full_record(), full_path=str(deep / "full.json")
+    )
+    assert len(line) <= 1500
+    parsed = json.loads(line)
+    assert parsed["resnet50_img_s"] == 2675.11
+    assert parsed["full_record"] == "full.json"  # shortened, not lost
 
 
 def test_full_record_lands_in_file(tmp_path):
