@@ -809,6 +809,229 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
     return out
 
 
+def serving_prefix_bench(rows_n=32, slots=8, max_new=8, chunk=8,
+                         prefix_len=320, shared_frac=0.8):
+    """Cross-request KV reuse row (ROADMAP item 2): the continuous
+    engine with the device-resident radix prefix cache, at 0% and 80%
+    prefix-shared synthetic workloads vs a cold (cache-disabled) run.
+
+    Workload: ``shared_frac`` of the prompts extend ONE
+    ``prefix_len``-token shared prefix (system-prompt/few-shot-header
+    traffic) with short unique tails; the rest are fully random at
+    comparable length.  The cold run prefills every prompt from token
+    0 (classic left-pad admits); the cached run admits at canonical
+    positions, installs the cached prefix blocks with one segment
+    write and prefills only the tail — outputs are asserted
+    token-identical per request.  ``prefix_gain`` is the 80%-shared
+    rows/s over the cold run (the acceptance bar is >= 1.5x); the
+    0%-shared row shows the miss-path overhead (~1.0x).  Summary key:
+    ``serving_prefix_gain``."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    cfg = dict(
+        vocab_size=1024, num_layers=4, num_heads=4, head_dim=32,
+        embed_dim=128, mlp_dim=512, max_seq_len=512, dtype="float32",
+    )
+    over = json.loads(os.environ.get("TFOS_SERVING_PREFIX_CONFIG", "{}"))
+    rows_n = int(over.pop("rows_n", rows_n))
+    slots = int(over.pop("slots", slots))
+    max_new = int(over.pop("max_new", max_new))
+    chunk = int(over.pop("chunk", chunk))
+    prefix_len = int(over.pop("prefix_len", prefix_len))
+    shared_frac = float(over.pop("shared_frac", shared_frac))
+    cfg.update(over)
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    serve_cfg = dict(
+        cfg, mode="generate", max_new_tokens=max_new, pad_multiple=32,
+        chunk_size=chunk, max_prompt_len=prefix_len + 32,
+    )
+    predict_cold = tr.serving_builder(params, serve_cfg)
+    predict_warm = tr.serving_builder(
+        params,
+        dict(serve_cfg, prefix_cache=True, prefix_block=16,
+             prefix_mem_mb=64.0),
+    )
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg["vocab_size"], (prefix_len,)).astype(
+        np.int32
+    )
+
+    def workload(frac):
+        rows = []
+        for i in range(rows_n):
+            tail = rng.randint(
+                0, cfg["vocab_size"], (rng.randint(8, 25),)
+            ).astype(np.int32)
+            if i < int(round(rows_n * frac)):
+                rows.append({"prompt": np.concatenate([shared, tail])})
+            else:
+                rows.append({"prompt": rng.randint(
+                    0, cfg["vocab_size"], (prefix_len + tail.shape[0],)
+                ).astype(np.int32)})
+        rng.shuffle(rows)
+        return rows
+
+    mapping = {"prompt": "tokens"}
+    rows80 = workload(shared_frac)
+    rows0 = workload(0.0)
+
+    def run(predict, rows):
+        stats = {}
+        t0 = time.perf_counter()
+        out = list(serving.predict_rows(
+            predict, rows, mapping, batch_size=slots,
+            schedule="continuous", stats=stats,
+        ))
+        return out, time.perf_counter() - t0, stats
+
+    def _pct(lat_ms, q):
+        return round(float(np.percentile(np.asarray(lat_ms), q)), 1)
+
+    # warm both predictors' compiled programs (and DROP the warmup's
+    # cache contents so the timed 80% run starts cold-cache)
+    warmup = workload(shared_frac)[:2 * slots]
+    run(predict_cold, warmup)
+    run(predict_warm, warmup)
+    predict_warm.make_slot_decoder(slots).prefix_cache.clear()
+
+    cold_out, dt_cold, _ = run(predict_cold, rows80)
+    warm_out, dt_warm, st_warm = run(predict_warm, rows80)
+    match = all(
+        np.array_equal(a["generated"], b["generated"])
+        for a, b in zip(cold_out, warm_out)
+    )
+    assert match, "prefix-cache outputs diverged from the cold run"
+    predict_warm.make_slot_decoder(slots).prefix_cache.clear()
+    out0, dt0, st0 = run(predict_warm, rows0)
+    lat80 = [1e3 * v for v in st_warm["latency_sec"].values()]
+    return {
+        "rows": rows_n, "slots": slots, "max_new_tokens": max_new,
+        "prefix_len": prefix_len, "shared_frac": shared_frac,
+        "config": "L%d Dm%d vocab %d, block 16, prefix %d-token" % (
+            cfg["num_layers"], cfg["embed_dim"], cfg["vocab_size"],
+            prefix_len,
+        ),
+        "cold_rows_per_sec": round(rows_n / dt_cold, 2),
+        "shared80": {
+            "rows_per_sec": round(rows_n / dt_warm, 2),
+            "latency_p50_ms": _pct(lat80, 50),
+            "latency_p99_ms": _pct(lat80, 99),
+            "hit_rate": round(
+                st_warm["prefix_hits"] / float(rows_n), 3
+            ),
+            "prefix_tokens_saved": st_warm["prefix_tokens_saved"],
+            "wall_sec": round(dt_warm, 3),
+        },
+        "shared0": {
+            "rows_per_sec": round(rows_n / dt0, 2),
+            "hit_rate": round(st0["prefix_hits"] / float(rows_n), 3),
+            "wall_sec": round(dt0, 3),
+        },
+        "prefix_gain": round(dt_cold / dt_warm, 3),
+        "outputs_match": bool(match),
+        "platform": __import__("jax").devices()[0].platform,
+    }
+
+
+def serving_speculative_bench(batch=4, prompt_len=64, max_new=64,
+                              draft_len=4):
+    """Draft-model speculative decoding row: tok/s vs plain greedy
+    ``generate`` with the accept rate reported (summary key
+    ``spec_accept_rate``).
+
+    The draft is the flagship's FIRST LAYER (shared embedding/head);
+    draft fidelity is emulated by down-weighting the flagship's deeper
+    layers — the trained-model regime a distilled draft provides,
+    without a training run in the bench.  Outputs are asserted
+    token-identical to plain greedy decode (speculation is lossless by
+    construction: the verify forward recomputes the exact argmax
+    chain, so accept rate moves THROUGHPUT only)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    cfg = dict(
+        vocab_size=1024, num_layers=4, num_heads=4, head_dim=16,
+        embed_dim=64, mlp_dim=256, max_seq_len=384, dtype="float32",
+    )
+    over = json.loads(os.environ.get("TFOS_SERVING_SPEC_CONFIG", "{}"))
+    batch = int(over.pop("batch", batch))
+    prompt_len = int(over.pop("prompt_len", prompt_len))
+    max_new = int(over.pop("max_new", max_new))
+    draft_len = int(over.pop("draft_len", draft_len))
+    cfg.update(over)
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    params = dict(jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0)))
+    for i in range(1, cfg["num_layers"]):
+        params["block_%d" % i] = jax.tree.map(
+            lambda x: x * 1e-2, params["block_%d" % i]
+        )
+    draft = tr.Transformer(
+        tr.TransformerConfig(**dict(cfg, num_layers=1))
+    )
+    dparams = {k: params[k]
+               for k in ("embedding", "block_0", "ln_f", "lm_head")}
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(3), (batch, prompt_len), 0, cfg["vocab_size"]
+    )
+
+    # warm the compiled programs outside the timed region
+    np.asarray(tr.generate(model, params, prompt, max_new))
+    tr.generate_speculative(
+        model, params, prompt, max_new, draft_len=draft_len,
+        draft_model=draft, draft_params=dparams,
+    )
+
+    t0 = time.perf_counter()
+    ref = np.asarray(tr.generate(model, params, prompt, max_new))
+    dt_plain = time.perf_counter() - t0
+    st = {}
+    t0 = time.perf_counter()
+    got = np.asarray(tr.generate_speculative(
+        model, params, prompt, max_new, draft_len=draft_len,
+        draft_model=draft, draft_params=dparams, stats=st,
+    ))
+    dt_spec = time.perf_counter() - t0
+    exact = bool(np.array_equal(ref, got))
+    assert exact, "speculative decode diverged from plain greedy"
+    total = batch * max_new
+    return {
+        "batch": batch, "prompt_len": prompt_len,
+        "max_new_tokens": max_new, "draft_len": draft_len,
+        "config": "L%d flagship, 1-layer draft (layer-truncated, "
+                  "deep layers down-weighted to emulate draft "
+                  "fidelity)" % cfg["num_layers"],
+        "plain_tokens_per_sec": round(total / dt_plain, 1),
+        "spec_tokens_per_sec": round(total / dt_spec, 1),
+        "speedup_vs_greedy": round(dt_plain / dt_spec, 3),
+        "accept_rate": round(st["accept_rate"], 3),
+        "rounds": st["rounds"],
+        "tokens_per_verify": round(max_new / max(1, st["rounds"]), 2),
+        "token_exact": exact,
+        "regime": "speculation converts per-token weight reads into "
+                  "one batched verify: the win is HBM bandwidth, so "
+                  "speedup_vs_greedy is meaningful on accelerator "
+                  "decode (CPU is compute-bound — the verify step "
+                  "costs the compute it saves; accept_rate and "
+                  "token_exact are the machinery contract here)",
+        "platform": __import__("jax").devices()[0].platform,
+    }
+
+
 def serving_overload_bench(rows_n=32, slots=4, max_new=24, chunk=8,
                            queue_depth=12):
     """Overload row (PR 4 robustness): the continuous engine under
@@ -2104,6 +2327,14 @@ def bench_summary(record):
         "serving_overload_goodput": _pluck(
             record, "serving_overload", "reject", "goodput_rows_s"
         ),
+        # cross-request reuse plane (docs/serving.md "Prefix cache &
+        # speculative decoding")
+        "serving_prefix_gain": _pluck(
+            record, "serving_prefix", "prefix_gain"
+        ),
+        "spec_accept_rate": _pluck(
+            record, "serving_speculative", "accept_rate"
+        ),
         "async_ps_compressed_steps_s": _pluck(
             record, "async_ps_tpu", "async_compressed_steps_per_sec"
         ),
@@ -2209,6 +2440,10 @@ def main(model_name="resnet50", with_feed=True):
             # overload behavior per admission policy (tiny model —
             # measures the scheduler, not the chip)
             ("serving_overload", serving_overload_bench, 60),
+            # cross-request KV reuse: radix prefix cache at 0%/80%
+            # shared workloads + draft-model speculative decode
+            ("serving_prefix", serving_prefix_bench, 90),
+            ("serving_speculative", serving_speculative_bench, 60),
             ("decode_long", decode_long_bench, 160),
             ("async_ps_tpu", ps_tpu_bench, 100),
             ("serving_tpu", serving_tpu_bench, 120),
@@ -2262,6 +2497,10 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(serving_generate_bench)))
     elif "serving_overload" in sys.argv:
         print(json.dumps(with_retry(serving_overload_bench)))
+    elif "serving_prefix" in sys.argv:
+        print(json.dumps(with_retry(serving_prefix_bench)))
+    elif "serving_speculative" in sys.argv:
+        print(json.dumps(with_retry(serving_speculative_bench)))
     elif "serving" in sys.argv:
         print(json.dumps(with_retry(serving_bench)))
     elif "long_context" in sys.argv:

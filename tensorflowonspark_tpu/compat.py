@@ -14,6 +14,67 @@ import logging
 logger = logging.getLogger(__name__)
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax promotes ``shard_map`` to the top-level namespace (with a
+    ``check_vma`` flag); the builds this repo also supports only ship
+    ``jax.experimental.shard_map.shard_map`` (where the same knob is
+    spelled ``check_rep``).  Every in-repo call site
+    (ops/ring_attention.py, ops/ulysses.py via ops/attention.py's
+    dispatcher, parallel/pp.py) routes through this shim so the kernels
+    run on either build.
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    if check_vma is not None:
+        # same semantics, pre-rename spelling
+        kwargs["check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def axis_size(axis_name):
+    """``jax.lax.axis_size`` across jax versions: falls back to the
+    static mesh-axis size from the trace's axis env on builds that
+    predate the public accessor (the shard_map-era companion of the
+    :func:`shard_map` shim above — sizes are static either way)."""
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    import jax.core as core
+
+    frame = core.axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def supports_cpu_multiprocess():
+    """True when this jax build can form multi-process groups on the
+    CPU backend (Gloo cross-process collectives).  Some builds compile
+    XLA:CPU without collectives support and raise ``Multiprocess
+    computations aren't implemented on the CPU backend`` at dispatch —
+    tests that need a real 2-process CPU group gate on this."""
+    try:
+        from jax._src import distributed  # noqa: F401
+        from jax._src.lib import xla_client
+
+        return hasattr(
+            xla_client._xla, "collectives"
+        ) and xla_client._xla.collectives is not None
+    except Exception:  # noqa: BLE001 - any probe failure = unsupported
+        return False
+
+
 def export_saved_model(params, export_dir, is_chief=False, metadata=None):
     """Chief-only serving export (reference: compat.py:10-17 — chief
     exported, workers wrote to a dummy dir; here non-chiefs no-op)."""

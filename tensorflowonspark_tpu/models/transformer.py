@@ -663,32 +663,50 @@ def generate(model, params, prompt, max_new_tokens, temperature=0.0,
 
 
 def generate_speculative(model, params, prompt, max_new_tokens,
-                         draft_len=4, ngram=2, return_stats=False):
-    """Greedy generation with prompt-lookup speculative decoding.
+                         draft_len=4, ngram=2, return_stats=False,
+                         draft_model=None, draft_params=None, stats=None):
+    """Greedy generation with speculative decoding.
 
     Decode is HBM-bound: one token per forward re-reads all weights.
     Speculation verifies ``draft_len`` guessed tokens in ONE forward
     (same weight read, ``draft_len+1`` query rows — nearly free on the
-    MXU), so every accepted draft is a weight read saved.  Drafts come
-    from PROMPT LOOKUP (n-gram continuation): find the most recent
-    earlier occurrence of the last ``ngram`` emitted/prompt tokens and
-    copy what followed it — no draft model, and highly effective on
-    inputs with repeated structure (code, extraction, summarization).
+    MXU), so every accepted draft is a weight read saved.  Two draft
+    sources:
 
-    Greedy-only and LOSSLESS: the verify forward recomputes the exact
-    argmax chain, accepted tokens match :func:`generate`'s output
-    token for token (tested).  Rejected verify rows leave stale cache
-    entries BEYOND the accepted position; they are masked (decode
-    attends ``kpos <= qpos``) and overwritten by the next round's
-    writes before the write pointer reaches them.  Batch rows accept
-    in lockstep (the cache write pointer is shared): the per-round
-    acceptance is the minimum over rows, so speculation pays off most
-    at small batch — exactly the bandwidth-bound serving regime.
+    - **prompt lookup** (default, ``draft_model=None``): n-gram
+      continuation — find the most recent earlier occurrence of the
+      last ``ngram`` emitted/prompt tokens and copy what followed it.
+      No extra model; highly effective on inputs with repeated
+      structure (code, extraction, summarization).
+    - **draft model** (``draft_model``/``draft_params``): a small
+      :class:`Transformer` with the SAME vocabulary proposes
+      ``draft_len`` tokens autoregressively through its own KV cache
+      (prefilled on the prompt, write pointer rewound in lockstep with
+      the flagship's after every verify round), and the flagship
+      verifies all of them in one batched step.  Beats prompt lookup
+      on free-form text, where n-grams rarely repeat; see
+      docs/serving.md "Prefix cache & speculative decoding".
+
+    Greedy-only and LOSSLESS either way: the verify forward recomputes
+    the exact argmax chain, accepted tokens match :func:`generate`'s
+    output token for token (tested) — draft quality only moves the
+    accept rate, never the tokens.  Rejected verify rows leave stale
+    cache entries BEYOND the accepted position; they are masked
+    (decode attends ``kpos <= qpos``) and overwritten by the next
+    round's writes before the write pointer reaches them.  Batch rows
+    accept in lockstep (the cache write pointer is shared): the
+    per-round acceptance is the minimum over rows, so speculation pays
+    off most at small batch — exactly the bandwidth-bound serving
+    regime.  Uniform-length prompts only: the batch is one ``[B, P]``
+    array (ragged rows fail at stacking with a named error in
+    ``serving.predict_rows``; see docs/inference.md).
 
     Returns ``[B, max_new_tokens]`` int32 (with ``return_stats=True``,
     a ``(tokens, rounds)`` pair — ``max_new_tokens/rounds`` is the
     mean tokens per verify forward; 1.0 means nothing accepted, ``1 +
-    draft_len`` is the ceiling).
+    draft_len`` is the ceiling).  Pass a dict as ``stats`` to also get
+    ``{"rounds", "proposed", "accepted", "accept_rate"}`` — the
+    accept-rate accounting the serving engine and bench report.
     """
     b, p = prompt.shape
     k = int(draft_len)
@@ -699,9 +717,22 @@ def generate_speculative(model, params, prompt, max_new_tokens,
         # ngram=0 would make every history position a "match" and draft
         # from position 0 forever
         raise ValueError("ngram must be >= 1")
+    if draft_model is not None:
+        if draft_params is None:
+            raise ValueError("draft_model needs draft_params")
+        if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+            raise ValueError(
+                "draft and flagship models must share a vocabulary; "
+                "got draft vocab {0} vs flagship {1}".format(
+                    draft_model.cfg.vocab_size, model.cfg.vocab_size
+                )
+            )
     if max_new_tokens <= 0:
         # mirror generate(): nothing to emit — skip cache alloc/prefill
         out = jnp.zeros((prompt.shape[0], 0), jnp.int32)
+        if stats is not None:
+            stats.update(rounds=0, proposed=0, accepted=0,
+                         accept_rate=0.0)
         return (out, 0) if return_stats else out
     if total > model.cfg.max_seq_len:
         raise ValueError(
@@ -721,6 +752,11 @@ def generate_speculative(model, params, prompt, max_new_tokens,
         params = qz.dequantize_tree(
             qparams, model.cfg.jdtype, barrier=False
         )
+    if draft_model is not None and qz.is_quantized(draft_params):
+        # the draft is small: dequantize once, no per-step barrier
+        draft_params = qz.dequantize_tree(
+            draft_params, draft_model.cfg.jdtype, barrier=False
+        )
     # cache must hold the last verify block that crosses max_new
     cache = init_cache(model, b, cache_len=total + k + 1)
     logits, mut = model.apply(
@@ -728,6 +764,16 @@ def generate_speculative(model, params, prompt, max_new_tokens,
         mutable=["cache"],
     )
     first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    dcache = None
+    if draft_model is not None:
+        # the draft keeps its own cache, prefilled on the same prompt;
+        # its write pointer tracks the flagship's round for round
+        dcache = init_cache(draft_model, b, cache_len=total + k + 1)
+        _, dmut = draft_model.apply(
+            {"params": draft_params, "cache": dcache}, prompt,
+            decode=True, mutable=["cache"],
+        )
+        dcache = dict(dmut["cache"])
 
     hist_len = total + k + 1
     history = jnp.zeros((b, hist_len), jnp.int32).at[:, :p].set(prompt)
@@ -756,11 +802,33 @@ def generate_speculative(model, params, prompt, max_new_tokens,
         fallback = jnp.full((k,), last, jnp.int32)
         return jnp.where((j >= 0) & in_range, cont, fallback)
 
+    def model_drafts(dcache, last):
+        """k autoregressive draft-model steps (plus one extra feeding
+        the final proposal, so ITS kv is banked too — when every draft
+        is accepted the flagship pointer moves past it, and a hole
+        there would poison all later draft rounds)."""
+        def dstep(carry, _):
+            dc, tok = carry
+            dlogits, dmut = draft_model.apply(
+                {"params": draft_params, "cache": dc}, tok[:, None],
+                decode=True, mutable=["cache"],
+            )
+            nxt = jnp.argmax(dlogits[:, 0], axis=-1).astype(jnp.int32)
+            return (dict(dmut["cache"]), nxt), nxt
+
+        (dcache, _), douts = jax.lax.scan(
+            dstep, (dcache, last), None, length=k + 1
+        )
+        return dcache, jnp.swapaxes(douts, 0, 1)[:, :k]  # [B, k]
+
     def round_(state):
-        history, emitted, cache, n, last, rounds = state
-        drafts = jax.vmap(find_drafts)(
-            history, jnp.full((b,), p + n), last
-        )  # [B, k]
+        history, emitted, cache, dcache, n, last, rounds, acc = state
+        if draft_model is not None:
+            dcache, drafts = model_drafts(dcache, last)
+        else:
+            drafts = jax.vmap(find_drafts)(
+                history, jnp.full((b,), p + n), last
+            )  # [B, k]
         block = jnp.concatenate([last[:, None], drafts], axis=1)
         pr = (
             qz.dequantize_tree(qparams, model.cfg.jdtype, barrier=True)
@@ -791,21 +859,54 @@ def generate_speculative(model, params, prompt, max_new_tokens,
         cache["position"] = jnp.asarray(
             p + n + gained - 1, jnp.int32
         )
+        if draft_model is not None:
+            # lockstep rewind: stale draft kv beyond the pointer is
+            # causally masked and overwritten by the next round's
+            # sequential feeds, exactly like the flagship's
+            dcache = dict(dcache)
+            dcache["position"] = jnp.asarray(
+                p + n + gained - 1, jnp.int32
+            )
         last = jnp.take_along_axis(targets, m[None].repeat(b)[:, None],
                                    axis=1)[:, 0]
-        return history, emitted, cache, n + gained, last, rounds + 1
+        return (history, emitted, cache, dcache, n + gained, last,
+                rounds + 1, acc + m)
 
     def cond(state):
-        return state[3] < max_new_tokens
+        return state[4] < max_new_tokens
 
     # after prefill the pointer is already at p — `first`'s slot
     cache = dict(mut["cache"])
-    state = (history, emitted, cache, jnp.int32(1), first, jnp.int32(0))
-    history, emitted, cache, n, last, rounds = jax.lax.while_loop(
-        cond, round_, state
+    state = (history, emitted, cache, dcache, jnp.int32(1), first,
+             jnp.int32(0), jnp.int32(0))
+    history, emitted, cache, dcache, n, last, rounds, acc = (
+        jax.lax.while_loop(cond, round_, state)
     )
     tokens = emitted[:, :max_new_tokens]
+    if stats is not None:
+        r = int(rounds)
+        a = int(acc)
+        stats.update(
+            rounds=r, proposed=r * k, accepted=a,
+            accept_rate=(a / float(r * k)) if r else 0.0,
+        )
     return (tokens, rounds) if return_stats else tokens
+
+
+class _BlockRef(object):
+    """A prefix-cache block payload: a zero-copy VIEW into a donor
+    extract-segment (``segment`` is the per-bank leaf tuple one
+    ``SlotDecoder._extract_jit`` call produced; ``index`` is this
+    block's position in it).  Storing views keeps insert free of
+    device dispatches; the donor segment's buffers live until every
+    block referencing them is evicted (bytes are accounted per block,
+    so the amplification is bounded by one prompt's segment)."""
+
+    __slots__ = ("segment", "index")
+
+    def __init__(self, segment, index):
+        self.segment = segment
+        self.index = index
 
 
 class SlotDecoder:
@@ -852,12 +953,39 @@ class SlotDecoder:
     RTT); the only synchronizing pull is the chunk's token block,
     which the scheduler needs anyway to make evict decisions.  The
     host keeps just the ``active`` scheduling mask.
+
+    Two request-level reuse planes compose on top (ISSUE 6 /
+    docs/serving.md "Prefix cache & speculative decoding"):
+
+    - ``prefix_cache``: a
+      :class:`~tensorflowonspark_tpu.prefix_cache.PrefixCache` turns
+      admits CANONICAL (token ``i`` at cache position ``i``): the
+      longest cached block-prefix installs into the lane with one
+      segment write and only the uncached suffix prefills
+      (:meth:`_prefill_canonical_impl`); finished prefills commit
+      their blocks back.  Token-identical to cold admits (the RoPE
+      position-difference invariant), asserted in
+      tests/test_prefix_cache.py.
+    - ``draft_model``/``draft_params``: chunks become per-slot
+      SPECULATIVE rounds (:meth:`_chunk_spec_impl`) — the draft owns
+      a second slot table at the same canonical positions, proposes
+      ``draft_len`` tokens per slot, the flagship verifies them in
+      one batched step, and every slot accepts independently.
+      Greedy-only, lossless; accept counters surface through
+      :meth:`reuse_stats`.
+
+    All cache/state buffers are DONATED through the jitted programs
+    (the handles are linear — consumed and reassigned every
+    dispatch), so admits scatter one lane and chunks append one
+    position per step genuinely in place instead of copying every
+    bank every dispatch.
     """
 
     def __init__(self, model, params, num_slots, max_new_tokens, *,
                  cache_len=None, chunk_size=16, pad_multiple=64,
                  temperature=0.0, top_k=0, top_p=0.0, eos_id=None,
-                 seed=0):
+                 seed=0, prefix_cache=None, draft_model=None,
+                 draft_params=None, draft_len=4):
         import numpy as np
 
         from tensorflowonspark_tpu import quantize as qz
@@ -880,6 +1008,35 @@ class SlotDecoder:
                     self.cache_len, self.max_new_tokens
                 )
             )
+        self.prefix_cache = prefix_cache
+        self._use_prefix = prefix_cache is not None
+        self.draft_model = draft_model
+        self.draft_len = int(draft_len)
+        self._spec = draft_model is not None
+        if self._spec:
+            if self.temperature > 0:
+                raise ValueError(
+                    "draft-model speculative decoding is greedy-only "
+                    "(temperature must be 0)"
+                )
+            if self.draft_len < 1:
+                raise ValueError("draft_len must be >= 1")
+            if draft_params is None:
+                raise ValueError("draft_model needs draft_params")
+            if draft_model.cfg.vocab_size != model.cfg.vocab_size:
+                raise ValueError(
+                    "draft and flagship models must share a "
+                    "vocabulary; got {0} vs {1}".format(
+                        draft_model.cfg.vocab_size, model.cfg.vocab_size
+                    )
+                )
+        # bank slack past cache_len: a verify round writes the whole
+        # [last, drafts] block at the current pointer, so the banks
+        # keep draft_len+1 scratch positions the admission bound
+        # (prompt + max_new <= cache_len) never hands out
+        self._bank_len = self.cache_len + (
+            self.draft_len + 1 if self._spec else 0
+        )
         self._np = np
         self._qz = qz
         self._rng = jax.random.PRNGKey(int(seed))
@@ -895,11 +1052,52 @@ class SlotDecoder:
             if self._quantized else self._qparams
         )
         self.cache = init_cache(model, self.num_slots,
-                                cache_len=self.cache_len)
+                                cache_len=self._bank_len)
+        if self._spec:
+            # the draft's own slot-table banks, at the SAME canonical
+            # per-slot positions as the flagship's (one admit prefills
+            # both in one compiled program); draft weights are small —
+            # dequantize once if quantized, no per-step barrier
+            self._dparams = jax.tree.map(jnp.asarray, draft_params)
+            if qz.is_quantized(self._dparams):
+                self._dparams = qz.dequantize_tree(
+                    self._dparams, draft_model.cfg.jdtype, barrier=False
+                )
+            self.draft_cache = init_cache(
+                draft_model, self.num_slots, cache_len=self._bank_len
+            )
+        else:
+            self._dparams = None
+            self.draft_cache = None
+        # host-side accept accounting (resolved with each chunk block)
+        self.spec_accepted = 0
+        self.spec_proposed = 0
         self.state = self._idle_state()
         self.active = np.zeros((self.num_slots,), bool)
-        self._prefill_jit = jax.jit(self._prefill_impl)
-        self._chunk_jit = jax.jit(self._chunk_impl)
+        # the cache/state buffers are linear: every program consumes
+        # the previous value and the handle is immediately reassigned,
+        # so DONATE them — XLA then updates the multi-MB banks in
+        # place (admit scatters one lane, a chunk appends one position
+        # per step) instead of copying every bank every dispatch
+        self._prefill_jit = jax.jit(
+            self._prefill_impl, donate_argnums=(2, 3, 4)
+        )
+        self._chunk_jit = jax.jit(
+            self._chunk_spec_impl, donate_argnums=(2, 3, 4)
+        ) if self._spec else jax.jit(
+            self._chunk_impl, donate_argnums=(1, 2)
+        )
+        if self._use_prefix:
+            self._prefill_canonical_jit = jax.jit(
+                self._prefill_canonical_impl, donate_argnums=(2, 3, 4)
+            )
+            self._install_jit = jax.jit(
+                self._install_segment_impl, donate_argnums=(0,)
+            )
+            # extract only READS the banks — nothing to donate
+            self._extract_jit = jax.jit(
+                self._extract_segment_impl, static_argnums=(3,)
+            )
 
     def _idle_state(self):
         b = self.num_slots
@@ -919,23 +1117,19 @@ class SlotDecoder:
             top_k=self.top_k, top_p=self.top_p,
         )
 
-    def _prefill_impl(self, params, cache, state, slot, tokens, pad, key):
-        """Slot-scoped prefill: lane ``slot`` of every cache bank gets
-        the bucketed prompt's KV, and the slot's state-vector entries
-        (position, pad region, first token, eos flag) are scattered in
-        place.  All shapes static per prompt bucket; ``slot`` is
-        traced (no recompilation on admit)."""
+    @staticmethod
+    def _lane_of(cache, slot):
+        """Slice lane ``slot`` out of every 4-dim cache bank (the
+        shared position counter resets to 0 — slot mode ignores it)."""
         def _lane(leaf):
             if getattr(leaf, "ndim", 0) == 4:  # [B, L, H, Dx] banks
                 return jax.lax.dynamic_slice_in_dim(leaf, slot, 1, axis=0)
-            return jnp.zeros((), jnp.int32)  # position: prefill at 0
+            return jnp.zeros((), jnp.int32)
 
-        lane = jax.tree.map(_lane, cache)
-        logits, mut = self.model.apply(
-            {"params": params, "cache": lane}, tokens, decode=True,
-            mutable=["cache"], pad_start=pad,
-        )
+        return jax.tree.map(_lane, cache)
 
+    @staticmethod
+    def _merge_lane(cache, lane, slot):
         def _merge(full, lane_leaf):
             if getattr(full, "ndim", 0) == 4:
                 return jax.lax.dynamic_update_slice_in_dim(
@@ -943,7 +1137,30 @@ class SlotDecoder:
                 )
             return full  # shared position counter: slot mode ignores it
 
-        cache = jax.tree.map(_merge, cache, mut["cache"])
+        return jax.tree.map(_merge, cache, lane)
+
+    def _prefill_impl(self, params, dparams, cache, dcache, state, slot,
+                      tokens, pad, key):
+        """Slot-scoped prefill: lane ``slot`` of every cache bank gets
+        the bucketed prompt's KV, and the slot's state-vector entries
+        (position, pad region, first token, eos flag) are scattered in
+        place.  All shapes static per prompt bucket; ``slot`` is
+        traced (no recompilation on admit).  With a draft model, the
+        SAME program prefills the draft's lane on the same padded
+        tokens — one dispatch, both banks, identical positions."""
+        lane = self._lane_of(cache, slot)
+        logits, mut = self.model.apply(
+            {"params": params, "cache": lane}, tokens, decode=True,
+            mutable=["cache"], pad_start=pad,
+        )
+        cache = self._merge_lane(cache, mut["cache"], slot)
+        if self._spec:
+            dlane = self._lane_of(dcache, slot)
+            _, dmut = self.draft_model.apply(
+                {"params": dparams, "cache": dlane}, tokens,
+                decode=True, mutable=["cache"], pad_start=pad,
+            )
+            dcache = self._merge_lane(dcache, dmut["cache"], slot)
         first = self._sample(logits[:, -1], key)[0]
         state = {
             "positions": state["positions"].at[slot].set(tokens.shape[1]),
@@ -954,7 +1171,95 @@ class SlotDecoder:
                 else False
             ),
         }
-        return cache, state, first
+        return cache, dcache, state, first
+
+    def _prefill_canonical_impl(self, params, dparams, cache, dcache,
+                                state, slot, suffix, full, n, kpref, key):
+        """Cached-prefix prefill at CANONICAL positions (token ``i`` of
+        the prompt at cache position ``i`` — the layout the prefix
+        cache's committed blocks are stored in, see
+        :mod:`tensorflowonspark_tpu.prefix_cache`).
+
+        The first ``kpref`` positions of the lane already hold the
+        cached prefix KV (installed by :meth:`admit` before this
+        dispatch); ``suffix`` is the uncached tail right-padded to its
+        own bucket, prefilled as a multi-token decode step starting at
+        position ``kpref`` (per-slot positions thread the same
+        causal/window masking a chunked decode uses, so pad-tail query
+        rows write scratch KV past ``n`` that the causal mask hides
+        and decode overwrites).  The first token samples from the last
+        REAL suffix row, ``n - kpref - 1``.  ``slot``, ``n`` and
+        ``kpref`` are traced — one compiled program per suffix bucket,
+        shared by hits of every depth including misses (kpref=0)."""
+        lane = self._lane_of(cache, slot)
+        logits, mut = self.model.apply(
+            {"params": params, "cache": lane}, suffix, decode=True,
+            mutable=["cache"], pad_start=jnp.zeros((1,), jnp.int32),
+            slot_positions=kpref[None],
+        )
+        cache = self._merge_lane(cache, mut["cache"], slot)
+        if self._spec:
+            # the draft re-prefills the WHOLE prompt (its banks are not
+            # prefix-cached; a stale-prefix draft would only cost
+            # accept rate, but a cheap full prefill keeps it sharp)
+            dlane = self._lane_of(dcache, slot)
+            _, dmut = self.draft_model.apply(
+                {"params": dparams, "cache": dlane}, full,
+                decode=True, mutable=["cache"],
+                pad_start=jnp.zeros((1,), jnp.int32),
+                slot_positions=jnp.zeros((1,), jnp.int32),
+            )
+            dcache = self._merge_lane(dcache, dmut["cache"], slot)
+        row = jax.lax.dynamic_slice_in_dim(
+            logits, n - kpref - 1, 1, axis=1
+        )[:, 0]
+        first = self._sample(row, key)[0]
+        state = {
+            "positions": state["positions"].at[slot].set(n),
+            "pad_start": state["pad_start"].at[slot].set(0),
+            "last_tok": state["last_tok"].at[slot].set(first),
+            "done": state["done"].at[slot].set(
+                first == self.eos_id if self.eos_id is not None
+                else False
+            ),
+        }
+        return cache, dcache, state, first
+
+    def _install_segment_impl(self, cache, slot, segment):
+        """Write a cached-prefix segment (per-bank ``[L_seg, H, Dx]``
+        leaves, flattened bank order) into lane ``slot`` at positions
+        ``[0, L_seg)`` — prefix blocks always sit at canonical
+        offset 0.  One dispatch per admit hit."""
+        flat, treedef = jax.tree_util.tree_flatten(cache)
+        it = iter(segment)
+        out = []
+        for leaf in flat:
+            if getattr(leaf, "ndim", 0) == 4:
+                seg = next(it)
+                out.append(jax.lax.dynamic_update_slice(
+                    leaf, seg[None].astype(leaf.dtype),
+                    (slot, 0, 0, 0),
+                ))
+            else:
+                out.append(leaf)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _extract_segment_impl(self, cache, slot, start, length):
+        """Read ``[start, start+length)`` of lane ``slot`` from every
+        bank (flattened order, matching :meth:`_install_segment_impl`)
+        — the committed KV a finished prefill donates to the prefix
+        cache.  ``length`` is static (it keys the program)."""
+        flat, _ = jax.tree_util.tree_flatten(cache)
+        out = []
+        for leaf in flat:
+            if getattr(leaf, "ndim", 0) == 4:
+                lane = jax.lax.dynamic_slice_in_dim(
+                    leaf, slot, 1, axis=0
+                )[0]
+                out.append(jax.lax.dynamic_slice_in_dim(
+                    lane, start, length, axis=0
+                ))
+        return tuple(out)
 
     def _chunk_impl(self, params, cache, state, active, keys):
         """``chunk_size`` single-token decode steps over all slots with
@@ -994,6 +1299,120 @@ class SlotDecoder:
                      done=done)
         return cache, state, jnp.swapaxes(toks, 0, 1)
 
+    def _chunk_spec_impl(self, params, dparams, cache, dcache, state,
+                         active, keys):
+        """``chunk_size`` SPECULATIVE rounds over all slots: per round
+        the draft model proposes ``draft_len`` tokens per slot (its own
+        per-slot cache, one extra step to bank the final proposal's
+        KV), the flagship verifies all of them in ONE batched
+        ``draft_len+1``-token step, and each slot accepts
+        INDEPENDENTLY (no lockstep minimum — per-slot positions make
+        the batch rows autonomous, which is exactly what the shared
+        write pointer forbids in :func:`generate_speculative`).
+
+        Accepted tokens compact left into a per-slot output buffer
+        (``buf``) with per-slot valid counts (``off``); rejected-tail
+        KV beyond each slot's pointer is causally masked and
+        overwritten by the next round's writes, the same stale-entry
+        contract the static speculative path relies on.  Greedy only
+        (enforced at construction).  Also returns per-slot
+        accepted/proposed draft counters for the engine's accept-rate
+        stats."""
+        kd = self.draft_len
+        eos = self.eos_id
+
+        def round_(carry, _key):
+            cache, dcache, pos, tok, done, buf, off, acc, prop = carry
+            p = (
+                self._qz.dequantize_tree(
+                    params, self.model.cfg.jdtype, barrier=True
+                )
+                if self._quantized else params
+            )
+
+            def dstep(c, i):
+                dc, t = c
+                dlogits, dmut = self.draft_model.apply(
+                    {"params": dparams, "cache": dc}, t[:, None],
+                    decode=True, mutable=["cache"],
+                    pad_start=state["pad_start"], slot_positions=pos + i,
+                )
+                nxt = jnp.argmax(
+                    dlogits[:, 0], axis=-1
+                ).astype(jnp.int32)
+                return (dmut["cache"], nxt), nxt
+
+            # kd+1 draft steps: kd proposals + one feed of the final
+            # proposal so its KV is banked (a hole there would poison
+            # every later round once the pointer moves past it)
+            (dcache, _), douts = jax.lax.scan(
+                dstep, (dcache, tok), jnp.arange(kd + 1)
+            )
+            drafts = jnp.swapaxes(douts, 0, 1)[:, :kd]  # [B, kd]
+            block = jnp.concatenate([tok[:, None], drafts], axis=1)
+            logits, mut = self.model.apply(
+                {"params": p, "cache": cache}, block, decode=True,
+                mutable=["cache"], pad_start=state["pad_start"],
+                slot_positions=pos,
+            )
+            targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            ok = drafts == targets[:, :kd]
+            m = jnp.sum(
+                jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1
+            )  # [B] — per-slot acceptance
+            gained = m + 1
+            out_block = targets
+            if eos is not None:
+                iseos = out_block == eos
+                first_eos = jnp.where(
+                    iseos.any(axis=1), iseos.argmax(axis=1),
+                    jnp.int32(kd + 1),
+                )
+                newly_done = first_eos < gained
+                gained = jnp.minimum(gained, first_eos + 1)
+                # already-done rows keep emitting a full eos block (the
+                # static scan's contract); the scheduler reads none of it
+                out_block = jnp.where(
+                    done[:, None], jnp.int32(eos), out_block
+                )
+                new_done = jnp.logical_or(done, newly_done)
+            else:
+                new_done = done
+            gained = jnp.where(done, jnp.int32(kd + 1), gained)
+            alive = jnp.logical_and(active, jnp.logical_not(done))
+            acc = acc + jnp.where(alive, m, 0)
+            prop = prop + jnp.where(alive, jnp.int32(kd), 0)
+            buf = jax.vmap(
+                lambda b_r, v_r, o_r: jax.lax.dynamic_update_slice(
+                    b_r, v_r, (o_r,)
+                )
+            )(buf, out_block, off)
+            off = off + gained
+            last = jnp.take_along_axis(
+                out_block, (gained - 1)[:, None], axis=1
+            )[:, 0]
+            pos = jnp.where(
+                active,
+                jnp.minimum(pos + gained, self.cache_len - 1), pos,
+            )
+            return (mut["cache"], dcache, pos, last, new_done, buf,
+                    off, acc, prop), None
+
+        b = self.num_slots
+        cap = self.chunk_size * (kd + 1)
+        buf0 = jnp.zeros((b, cap), jnp.int32)
+        zero = jnp.zeros((b,), jnp.int32)
+        (cache, dcache, positions, last_tok, done, buf, off, acc,
+         prop), _ = jax.lax.scan(
+            round_,
+            (cache, dcache, state["positions"], state["last_tok"],
+             state["done"], buf0, zero, zero, zero),
+            keys,
+        )
+        state = dict(state, positions=positions, last_tok=last_tok,
+                     done=done)
+        return cache, dcache, state, buf, off, acc, prop
+
     # -- host-side slot operations -------------------------------------
 
     def _next_key(self, n=None):
@@ -1013,6 +1432,16 @@ class SlotDecoder:
         return max(int(prompt_len), min(b, self.cache_len
                                         - self.max_new_tokens))
 
+    def _suffix_bucket(self, suffix_len, kpref):
+        """Suffix-prefill bucket for a cached-prefix admit: round the
+        uncached tail up to ``pad_multiple``, capped so the bucketed
+        write ``[kpref, kpref + bucket)`` stays inside the banks (the
+        scratch tail past the real tokens is causally masked and
+        overwritten by decode)."""
+        m = self.pad_multiple
+        b = ((int(suffix_len) + m - 1) // m) * m
+        return max(int(suffix_len), min(b, self._bank_len - int(kpref)))
+
     def free_slots(self):
         return [i for i in range(self.num_slots) if not self.active[i]]
 
@@ -1022,7 +1451,17 @@ class SlotDecoder:
         scalar (the request's first output) without synchronizing —
         the scheduler resolves it together with the next chunk's
         block.  Raises when the prompt cannot fit
-        ``cache_len - max_new_tokens``."""
+        ``cache_len - max_new_tokens``.
+
+        With a :class:`~tensorflowonspark_tpu.prefix_cache.PrefixCache`
+        attached, admits run at CANONICAL positions: the longest cached
+        block-prefix of the prompt is installed into the lane with one
+        segment write, only the uncached suffix prefills
+        (:meth:`_prefill_canonical_impl`), and the prompt's own full
+        blocks are committed back to the cache — so the NEXT request
+        sharing the prefix skips its prefill.  All dispatches stay
+        async; outputs are token-identical to a cold admit
+        (tests/test_prefix_cache.py)."""
         np = self._np
         prompt = np.asarray(prompt, np.int32).ravel()
         n = prompt.shape[0]
@@ -1037,15 +1476,100 @@ class SlotDecoder:
             )
         if self.active[slot]:
             raise ValueError("slot {0} is still active".format(slot))
-        b = self.bucket_len(n)
-        padded = np.zeros((1, b), np.int32)
-        padded[0, b - n:] = prompt
-        self.cache, self.state, first = self._prefill_jit(
-            self._params, self.cache, self.state, jnp.int32(slot),
-            jnp.asarray(padded), jnp.asarray([b - n], jnp.int32),
-            self._next_key(),
-        )
+        if self._use_prefix:
+            first = self._admit_canonical(slot, prompt, n)
+        else:
+            b = self.bucket_len(n)
+            padded = np.zeros((1, b), np.int32)
+            padded[0, b - n:] = prompt
+            (self.cache, self.draft_cache, self.state,
+             first) = self._prefill_jit(
+                self._params, self._dparams, self.cache,
+                self.draft_cache, self.state, jnp.int32(slot),
+                jnp.asarray(padded), jnp.asarray([b - n], jnp.int32),
+                self._next_key(),
+            )
         self.active[slot] = True
+        return first
+
+    @staticmethod
+    def _assemble_segment(payloads, blk):
+        """Materialize a contiguous install segment from block
+        payloads.  Payloads are :class:`_BlockRef` VIEWS into donor
+        extract-segments (zero-copy at insert time); consecutive
+        blocks from the same donor collapse into one slice — the
+        common all-one-donor hit path materializes with zero
+        dispatches (the donor segment IS the install segment)."""
+        runs = []
+        for p in payloads:
+            if (runs and p.segment is runs[-1][-1].segment
+                    and p.index == runs[-1][-1].index + 1):
+                runs[-1].append(p)
+            else:
+                runs.append([p])
+        out = []
+        for li in range(len(payloads[0].segment)):
+            pieces = []
+            for run in runs:
+                seg = run[0].segment[li]
+                s = run[0].index * blk
+                e = (run[-1].index + 1) * blk
+                pieces.append(
+                    seg if (s == 0 and e == seg.shape[0]) else seg[s:e]
+                )
+            out.append(
+                pieces[0] if len(pieces) == 1
+                else jnp.concatenate(pieces)
+            )
+        return tuple(out)
+
+    def _admit_canonical(self, slot, prompt, n):
+        """The cached-prefix admit path (see :meth:`admit`)."""
+        np = self._np
+        pc = self.prefix_cache
+        blk = pc.block_tokens
+        # at least one real token must prefill (first-token logits)
+        lease = pc.acquire(prompt, limit_tokens=n - 1)
+        kpref = lease.n_tokens
+        if kpref:
+            segment = self._assemble_segment(lease.payloads(), blk)
+            self.cache = self._install_jit(
+                self.cache, jnp.int32(slot), segment
+            )
+        # install dispatches hold the block buffers; safe to unpin now
+        pc.release(lease)
+        sb = self._suffix_bucket(n - kpref, kpref)
+        suffix = np.zeros((1, sb), np.int32)
+        suffix[0, :n - kpref] = prompt[kpref:]
+        if self._spec:
+            fb = self.bucket_len(n)
+            full = np.zeros((1, fb), np.int32)
+            full[0, :n] = prompt
+            full = jnp.asarray(full)
+        else:
+            full = None
+        (self.cache, self.draft_cache, self.state,
+         first) = self._prefill_canonical_jit(
+            self._params, self._dparams, self.cache, self.draft_cache,
+            self.state, jnp.int32(slot), jnp.asarray(suffix), full,
+            jnp.int32(n), jnp.int32(kpref), self._next_key(),
+        )
+        # commit the prompt's NEW full blocks (the matched ones are
+        # already cached) — ONE async segment read; the per-block
+        # payloads are zero-copy views into it (_BlockRef), so insert
+        # costs no device dispatches and a donor's whole segment
+        # re-installs without re-assembly
+        total_blocks = n // blk
+        first_new = kpref // blk
+        if total_blocks > first_new:
+            n_new = total_blocks - first_new
+            seg = self._extract_jit(
+                self.cache, jnp.int32(slot), jnp.int32(first_new * blk),
+                n_new * blk,
+            )
+            payloads = [_BlockRef(seg, i) for i in range(n_new)]
+            nbytes = sum(int(leaf.nbytes) for leaf in seg) // n_new
+            pc.insert(prompt, payloads, first_new, nbytes)
         return first
 
     def evict(self, slot):
@@ -1078,41 +1602,87 @@ class SlotDecoder:
     def dispatch_chunk(self):
         """Dispatch one compiled decode chunk over every slot WITHOUT
         synchronizing: the cache/state futures are installed
-        immediately and the ``[num_slots, chunk_size]`` token block
-        comes back as an unresolved device array.  Pair with
-        :meth:`resolve_chunk`; the split lets the serving engine do
-        host-side work (queue refill, deadline bookkeeping) while the
-        chunk runs, and lets its watchdog bound only the
-        synchronizing half."""
+        immediately and the token block comes back as unresolved
+        device arrays.  Pair with :meth:`resolve_chunk`; the split
+        lets the serving engine do host-side work (queue refill,
+        deadline bookkeeping) while the chunk runs, and lets its
+        watchdog bound only the synchronizing half."""
         keys = self._next_key(self.chunk_size)
+        params = self._qparams if self._quantized else self._params
+        if self._spec:
+            (self.cache, self.draft_cache, self.state, buf, off, acc,
+             prop) = self._chunk_jit(
+                params, self._dparams, self.cache, self.draft_cache,
+                self.state, jnp.asarray(self.active), keys,
+            )
+            return buf, off, acc, prop
         self.cache, self.state, toks = self._chunk_jit(
-            self._qparams if self._quantized else self._params,
-            self.cache, self.state, jnp.asarray(self.active), keys,
+            params, self.cache, self.state, jnp.asarray(self.active),
+            keys,
         )
         return toks
 
-    def resolve_chunk(self, toks):
-        """Synchronize a :meth:`dispatch_chunk` token block to host
-        int32 (idle lanes hold garbage — the scheduler only reads
-        active lanes' rows).  The ONLY synchronizing host pull in the
-        engine — and therefore the call a wedged device dispatch
-        hangs, which is why the serving watchdog wraps exactly
-        this."""
-        return self._np.asarray(toks)
+    def resolve_chunk(self, pending):
+        """Synchronize a :meth:`dispatch_chunk` block to host int32 as
+        ``(tokens [B, T], valid [B])`` — row ``r``'s tokens are
+        ``tokens[r, :valid[r]]`` (idle lanes hold garbage — the
+        scheduler only reads active lanes' rows).  Plain chunks fill
+        every row to ``chunk_size``; speculative chunks compact each
+        slot's accepted tokens left, so ``valid`` varies per slot (up
+        to ``chunk_size * (draft_len+1)``) and the per-slot
+        accepted/proposed draft counters fold into
+        :attr:`spec_accepted`/:attr:`spec_proposed`.  The ONLY
+        synchronizing host pull in the engine — and therefore the
+        call a wedged device dispatch hangs, which is why the serving
+        watchdog wraps exactly this."""
+        np = self._np
+        if self._spec:
+            buf, off, acc, prop = pending
+            toks = np.asarray(buf)
+            valid = np.asarray(off)
+            self.spec_accepted += int(np.asarray(acc).sum())
+            self.spec_proposed += int(np.asarray(prop).sum())
+            return toks, valid
+        toks = np.asarray(pending)
+        return toks, np.full((toks.shape[0],), toks.shape[1], np.int32)
 
     def step_chunk(self):
         """Dispatch + resolve one decode chunk (see
         :meth:`dispatch_chunk` / :meth:`resolve_chunk`)."""
         return self.resolve_chunk(self.dispatch_chunk())
 
+    def reuse_stats(self):
+        """Cross-request reuse counters: the prefix cache's
+        cumulative stats (when attached) plus the speculative
+        accept accounting.  The serving engine snapshots these at
+        job start and reports per-job deltas."""
+        out = {
+            "spec_accepted": self.spec_accepted,
+            "spec_proposed": self.spec_proposed,
+        }
+        if self._use_prefix:
+            out.update(self.prefix_cache.stats())
+        return out
+
     def compile_counts(self):
         """Compiled-program census: {"prefill": one per prompt bucket,
         "chunk": 1}.  Admit/evict must never grow these (asserted in
-        tests/test_serving.py)."""
-        return {
+        tests/test_serving.py).  With a prefix cache the census adds
+        the canonical-admit programs: one suffix-prefill per suffix
+        bucket, one install per hit-segment length, one extract per
+        commit-segment length — still admission-count-independent
+        (tests/test_prefix_cache.py)."""
+        out = {
             "prefill": int(self._prefill_jit._cache_size()),
             "chunk": int(self._chunk_jit._cache_size()),
         }
+        if self._use_prefix:
+            out["prefill_canonical"] = int(
+                self._prefill_canonical_jit._cache_size()
+            )
+            out["install"] = int(self._install_jit._cache_size())
+            out["extract"] = int(self._extract_jit._cache_size())
+        return out
 
 
 def serving_builder(params, config):
@@ -1130,6 +1700,34 @@ def serving_builder(params, config):
         **{k: v for k, v in overrides.items() if k in cfg_fields}
     )
     model = Transformer(cfg)
+    # draft-model speculative decoding: draft weights ride the export
+    # as a "draft" sibling of "params" (save_for_serving({"params": ...,
+    # "draft": ...})) or arrive in-process via config["draft_params"];
+    # config["draft_config"] carries the draft's TransformerConfig
+    # fields (defaults: the flagship's geometry)
+    draft_params = config.get("draft_params")
+    if isinstance(params, dict) and "draft" in params:
+        params = dict(params)
+        popped = params.pop("draft")
+        if draft_params is None:
+            draft_params = popped
+    draft_model = None
+    if config.get("draft_config") is not None:
+        if draft_params is None:
+            raise ValueError(
+                "draft_config given but no draft weights: pass "
+                "config['draft_params'] or export "
+                "{'params': ..., 'draft': ...}"
+            )
+        dover = dict(
+            config["draft_config"], attention_impl="dot", mesh=None
+        )
+        dover.setdefault("vocab_size", cfg.vocab_size)
+        dcfg = TransformerConfig(
+            **{k: v for k, v in dover.items() if k in cfg_fields}
+        )
+        draft_model = Transformer(dcfg)
+        draft_params = jax.tree.map(jnp.asarray, draft_params)
     if config.get("quantize") == "int8":
         # weight-only int8 (quantize.py): halves the weight HBM read —
         # generate() dequantizes per decode step; the logits path
@@ -1145,15 +1743,20 @@ def serving_builder(params, config):
         # generation serving: prompt batch in -> sampled continuations
         # out (KV-cache decode; see generate()).  config keys:
         # max_new_tokens (required), temperature, top_k, top_p, seed;
-        # speculative=true switches to prompt-lookup speculative
-        # decoding (greedy-only; draft_len/ngram tune it).
+        # speculative=true switches the STATIC path to speculative
+        # decoding (greedy-only, uniform-length batches; draft_len/
+        # ngram tune it, draft_config+draft_params swap the n-gram
+        # lookup for a draft model).  draft_config alone arms per-slot
+        # speculation on the CONTINUOUS schedule; prefix_cache=true
+        # arms cross-request KV reuse there (docs/serving.md "Prefix
+        # cache & speculative decoding").
         max_new = int(config["max_new_tokens"])
         temperature = float(config.get("temperature", 0.0))
         top_k = int(config.get("top_k", 0))
         top_p = float(config.get("top_p", 0.0))
         rng = jax.random.PRNGKey(int(config.get("seed", 0)))
         speculative = bool(config.get("speculative", False))
-        if speculative and temperature > 0:
+        if (speculative or draft_model is not None) and temperature > 0:
             raise ValueError(
                 "speculative generation serving is greedy-only "
                 "(temperature must be 0)"
@@ -1168,19 +1771,31 @@ def serving_builder(params, config):
 
         if speculative:
             # uniform-length batches only (generate_speculative has no
-            # ragged support; rows of unequal length fail at stacking)
-            def _gen_spec(v, tokens):
-                return generate_speculative(
-                    model, v["params"], jnp.asarray(tokens, jnp.int32),
-                    max_new, draft_len=draft_len, ngram=ngram,
+            # ragged support; rows of unequal length fail at stacking
+            # with a named ValueError from predict_rows — see
+            # docs/inference.md "Speculative decoding")
+            def predict_spec(batch):
+                tokens = jnp.asarray(batch[input_name], jnp.int32)
+                st = {}
+                toks, _rounds = generate_speculative(
+                    model, variables["params"], tokens, max_new,
+                    draft_len=draft_len, ngram=ngram,
+                    draft_model=draft_model, draft_params=draft_params,
+                    return_stats=True, stats=st,
                 )
+                out = {"generated": np.asarray(toks, np.int32)}
+                if draft_model is not None:
+                    # per-batch accept rate as a per-row column (the
+                    # bench / engine stats surface)
+                    out["accept_rate"] = np.full(
+                        (tokens.shape[0],), st["accept_rate"],
+                        np.float32,
+                    )
+                predict_spec.last_spec_stats = st
+                return out
 
-            return base.make_serving_predict(
-                variables,
-                _gen_spec,
-                input_name,
-                lambda toks: {"generated": np.asarray(toks, np.int32)},
-            )
+            predict_spec.last_spec_stats = {}
+            return predict_spec
 
         # ragged multi-request batching: predict_rows left-pads each
         # batch's prompts (predict.column_padding) and ships per-row
@@ -1228,9 +1843,33 @@ def serving_builder(params, config):
         # the slot cache to bucket(max_prompt_len) + max_new instead
         # of max_seq_len — decode re-reads the whole cache every
         # step, so a right-sized cache is pure bandwidth savings).
+        # Cross-request reuse knobs (docs/serving.md "Prefix cache &
+        # speculative decoding"): prefix_cache=true attaches a
+        # device-resident radix prefix cache over committed KV blocks
+        # (prefix_block tokens per block, prefix_mem_mb HBM budget —
+        # shared by every slot geometry of this predictor, so a warm
+        # cache survives across jobs); speculative=true with a
+        # draft_config runs per-slot draft-model speculative decode
+        # chunks (greedy-only).
         chunk_size = int(config.get("chunk_size", 16))
         max_prompt = config.get("max_prompt_len")
         slot_decoders = {}
+        prefix_holder = []
+
+        def _prefix_cache():
+            if not config.get("prefix_cache", False):
+                return None
+            if not prefix_holder:
+                from tensorflowonspark_tpu.prefix_cache import PrefixCache
+
+                prefix_holder.append(PrefixCache(
+                    block_tokens=int(config.get("prefix_block", 16)),
+                    mem_budget_bytes=int(
+                        float(config.get("prefix_mem_mb", 256.0))
+                        * (1 << 20)
+                    ),
+                ))
+            return prefix_holder[0]
 
         def make_slot_decoder(num_slots, chunk=None):
             # memoized per (slots, chunk): a SlotDecoder owns its
@@ -1256,6 +1895,9 @@ def serving_builder(params, config):
                 pad_multiple=predict.pad_multiple,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, seed=int(config.get("seed", 0)),
+                prefix_cache=_prefix_cache(),
+                draft_model=draft_model, draft_params=draft_params,
+                draft_len=draft_len,
             )
             slot_decoders[key] = dec
             return dec

@@ -140,8 +140,10 @@ def attention(q, k, v, impl="dot", causal=True, scale=None, mesh=None,
     inputs must be local shards and the call must already be inside
     ``shard_map``-decorated code where ``seq_axis`` is bound; with a mesh
     given, the inputs are *global* arrays and the op wraps itself in a
-    ``shard_map`` over the mesh's ``seq`` axis (do NOT pass a mesh from
-    code that is itself under ``shard_map``).  ``flash`` runs the pallas
+    ``shard_map`` over the mesh's ``seq`` axis (via
+    :func:`tensorflowonspark_tpu.compat.shard_map`, which falls back to
+    ``jax.experimental.shard_map`` on builds without ``jax.shard_map``;
+    do NOT pass a mesh from code that is itself under ``shard_map``).  ``flash`` runs the pallas
     kernels in interpret mode off-TPU so the same model runs in CPU
     tests.  ``block_q``/``block_k`` bound the pallas tiles for both the
     ``flash`` impl and ``ring``'s flash inner step; ``ring_impl``

@@ -62,7 +62,11 @@ def _compiler_params():
     dims freely instead of assuming a fully sequential grid."""
     from jax.experimental.pallas import tpu as pltpu
 
-    return pltpu.CompilerParams(
+    # renamed TPUCompilerParams -> CompilerParams across jax versions
+    params_cls = getattr(pltpu, "CompilerParams", None) or (
+        pltpu.TPUCompilerParams
+    )
+    return params_cls(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
     )
 

@@ -43,6 +43,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tensorflowonspark_tpu import compat
 from tensorflowonspark_tpu.ops.flash_attention import (
     _bwd_core,
     _fwd_core,
@@ -156,7 +157,7 @@ def _window_branch(my_idx, t, p, max_dist):
 
 def _ring_flash_fwd(q, k, v, scale, causal, block_q, block_k, axis_name,
                     window=0):
-    p = lax.axis_size(axis_name)
+    p = compat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]
     b, s_local, h, d = q.shape
@@ -238,7 +239,7 @@ def _ring_flash_bwd(scale, causal, block_q, block_k, axis_name, window,
     (home again after P hops); per-chunk gradients come from the flash
     backward kernels driven by the ring-global (out, lse)."""
     q, k, v, out, lse = res
-    p = lax.axis_size(axis_name)
+    p = compat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % p) for i in range(p)]
 
@@ -351,7 +352,7 @@ def _ring_dense(q, k, v, causal=True, scale=None, axis_name="seq",
     ``lax.scan`` AD; ``ppermute``'s transpose is the inverse
     permutation, so gradients counter-rotate automatically."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    p = lax.axis_size(axis_name)
+    p = compat.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, s_local, h, d = q.shape
     if k.shape[2] != h:
@@ -427,7 +428,7 @@ def ring_attention_sharded(q, k, v, mesh, causal=True, scale=None,
             impl=impl, block_q=block_q, block_k=block_k, window=window,
         )
 
-    return jax.shard_map(
+    return compat.shard_map(
         _local,
         mesh=mesh,
         in_specs=(spec, spec, spec),

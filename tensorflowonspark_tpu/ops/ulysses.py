@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from tensorflowonspark_tpu import compat
 from tensorflowonspark_tpu.ops.attention import dot_attention
 from tensorflowonspark_tpu.ops.flash_attention import flash_supported
 
@@ -42,7 +43,7 @@ def ulysses_attention(q, k, v, causal=True, scale=None, axis_name="seq",
         contract as ring attention's fallback).
     Returns the local ``[B, S_local, H, D]`` output shard.
     """
-    p = lax.axis_size(axis_name)
+    p = compat.axis_size(axis_name)
     h, hkv = q.shape[2], k.shape[2]
     if h % p != 0 or hkv % p != 0:
         raise ValueError(
@@ -102,7 +103,7 @@ def ulysses_attention_sharded(q, k, v, mesh, causal=True, scale=None,
             window=window,
         )
 
-    return jax.shard_map(
+    return compat.shard_map(
         _local,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -39,6 +39,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tensorflowonspark_tpu import compat
+from tensorflowonspark_tpu.compat import shard_map
+
 logger = logging.getLogger(__name__)
 
 
@@ -63,7 +66,7 @@ def pipeline(stage_fn, stage_params, microbatches, axis_name="pipe",
     Returns ``[M, mb, ...]`` outputs: on the last stage (or everywhere
     with ``broadcast_result``) the pipelined results; zeros elsewhere.
     """
-    p = lax.axis_size(axis_name)
+    p = compat.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     total = m + p - 1
@@ -361,7 +364,7 @@ class PipelineTrainer(object):
         def local_loss(params, batch):
             """Runs under shard_map: params['stages'] is the local stage,
             batch is the local data shard."""
-            p = lax.axis_size(pipe)
+            p = compat.axis_size(pipe)
             idx = lax.axis_index(pipe)
 
             h0 = first_fn(params["first"], batch)  # [B_local, ...]
@@ -398,7 +401,7 @@ class PipelineTrainer(object):
             return loss, metrics
 
         @functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(param_specs, batch_spec),
             out_specs=(param_specs, P()),
@@ -639,7 +642,7 @@ class PipelineTrainer(object):
             return grads, metrics
 
         grad_fn = functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(param_specs, batch_spec),
             out_specs=(param_specs, P()),
@@ -912,7 +915,7 @@ class PipelineTrainer(object):
             return grads, metrics
 
         grad_fn = functools.partial(
-            jax.shard_map,
+            shard_map,
             mesh=mesh,
             in_specs=(param_specs, batch_spec),
             out_specs=(param_specs, P()),

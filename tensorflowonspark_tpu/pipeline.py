@@ -131,6 +131,25 @@ HasOnError = _mixin(
 HasOutputMapping = _mixin(
     "output_mapping", "mapping of predictor outputs to output columns"
 )
+# serving schedule for TFModel.transform: "static" fixed-size batches
+# or "continuous" slot-level in-flight batching for generation exports
+# (batch_size then counts KV-cache slots — docs/serving.md)
+HasSchedule = _mixin(
+    "schedule",
+    "inference batching schedule: 'static' | 'continuous'",
+    "static",
+)
+# deployment-time model_config overrides laid over the export metadata
+# before the predictor builds (serving.load_predictor config_overrides)
+# — the pipeline surface for the cross-request reuse knobs:
+# prefix_cache/prefix_block/prefix_mem_mb, draft_config/draft_len,
+# chunk_size, speculative (docs/serving.md "Prefix cache & speculative
+# decoding")
+HasModelConfig = _mixin(
+    "model_config",
+    "dict of model_config keys laid over the serving export's "
+    "metadata at load time (prefix cache, draft model, chunk sizing)",
+)
 # the narrow-dtype data plane's widening stage (docs/data_plane.md):
 # a JSON-able dict of data.preprocess.make_preprocess kwargs.  On
 # TFModel it is fused in front of the predictor on device
@@ -214,10 +233,12 @@ _MODEL_MIXINS = (
     HasBatchSize,
     HasExportDir,
     HasInputMapping,
+    HasModelConfig,
     HasModelDir,
     HasOnError,
     HasOutputMapping,
     HasPreprocess,
+    HasSchedule,
     HasSignatureDefKey,
     HasTagSet,
 )
@@ -418,21 +439,26 @@ def _run_model_iter(rows, args, predictor_builder=None):
     output dict-rows as they are produced (the lazy Spark path streams
     them straight into the result RDD without materializing the
     partition)."""
+    import json as _json
+
     from tensorflowonspark_tpu import serving
 
     preprocess = getattr(args, "preprocess", None)
+    model_config = getattr(args, "model_config", None)
     key = (
         args.export_dir,
         args.signature_def_key,
         args.tag_set,
         serving._builder_key(predictor_builder),
         serving._preprocess_key(preprocess),
+        _json.dumps(model_config, sort_keys=True, default=str)
+        if model_config else None,
     )
     if _TRANSFORM_STATE["key"] != key:
         logger.info("loading predictor for %s", key)
         _TRANSFORM_STATE["predict"] = serving.load_predictor(
             args.export_dir, builder=predictor_builder,
-            preprocess=preprocess,
+            preprocess=preprocess, config_overrides=model_config,
         )
         _TRANSFORM_STATE["key"] = key
     predict = _TRANSFORM_STATE["predict"]
@@ -443,6 +469,10 @@ def _run_model_iter(rows, args, predictor_builder=None):
         input_mapping=args.input_mapping,
         output_mapping=args.output_mapping,
         batch_size=args.batch_size,
+        # setSchedule("continuous"): slot-level in-flight batching for
+        # generation exports — batch_size counts KV slots, and the
+        # prefix-cache / speculative knobs (setModelConfig) apply
+        schedule=getattr(args, "schedule", None) or "static",
         # poison isolation (setOnError("record")): a bad row becomes a
         # typed error record at its position instead of failing the
         # partition — when transforming to a typed DataFrame, include

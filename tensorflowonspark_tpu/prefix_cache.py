@@ -1,0 +1,283 @@
+"""Device-resident radix prefix cache for cross-request KV reuse.
+
+At fleet traffic most prompts share long prefixes (system prompts,
+few-shot headers), yet a cold serving engine prefills every request
+from token 0.  This module is the request-level reuse plane for the
+continuous-batching engine: a radix tree over token prefixes whose
+nodes own *committed KV blocks* — device-resident slices of the slot
+table's per-layer key/value banks — so an admit whose prompt extends a
+cached prefix installs the cached banks into its slot's lanes and
+prefills only the uncached suffix
+(:meth:`~tensorflowonspark_tpu.models.transformer.SlotDecoder.admit`).
+
+Design notes:
+
+- **Fixed-width radix edges.**  The tree is indexed in blocks of
+  ``block_tokens`` tokens: every node is exactly one block, keyed by
+  its token content, and a path root→node spells a prompt prefix in
+  whole blocks.  Fixed-width edges keep lookup O(prompt/block) dict
+  hops, make sharing *block-granular* (two prompts share exactly the
+  blocks their token prefixes share), and — critically — match the
+  device layout: one node == one contiguous ``[block, heads, dim]``
+  slice per cache leaf, installable with a single
+  ``dynamic_update_slice`` per admit.
+- **Canonical positions.**  Cached keys are post-RoPE, so a block is
+  only reusable at the *same* physical cache positions it was written
+  at.  The cache therefore stores blocks at canonical positions
+  (token ``i`` of the prompt lives at cache position ``i``), and the
+  SlotDecoder's cached-prefix admit path places every request at
+  canonical positions too (right-padded prefill — see
+  ``SlotDecoder._prefill_canonical``).  Outputs stay token-identical
+  to a cold run: RoPE scores depend only on position differences, the
+  same invariant the ragged left-pad parity tests pin down.
+- **Refcounted sharing + LRU leaf eviction.**  A lookup *pins* its
+  matched path (refcount) until the admit's install dispatches are
+  enqueued; eviction only ever removes cold *leaves* (no children, no
+  pins), oldest-``last_used`` first, so a shared interior block
+  outlives every prompt family built on it.
+- **Memory accounting against the slot table's HBM budget.**  Every
+  block's device bytes are accounted; inserts evict cold branches to
+  stay under ``mem_budget_bytes``, and the serving engine's degrade
+  policy calls :meth:`evict_cold` under backlog pressure *before*
+  shrinking token budgets (cold cache is the cheapest thing to give
+  back — see docs/serving.md "Prefix cache & speculative decoding").
+
+The payloads are opaque to this module (the SlotDecoder passes device
+pytrees); all bookkeeping here is host-side, so the policy is unit
+testable with plain numpy payloads (tests/test_prefix_cache.py).
+"""
+
+import itertools
+import logging
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _Node(object):
+    """One cached block: ``tokens`` (the edge label), its KV
+    ``payload``, and the radix links/bookkeeping."""
+
+    __slots__ = ("key", "parent", "children", "payload", "nbytes",
+                 "refs", "last_used")
+
+    def __init__(self, key, parent, payload, nbytes):
+        self.key = key
+        self.parent = parent
+        self.children = {}
+        self.payload = payload
+        self.nbytes = int(nbytes)
+        self.refs = 0
+        self.last_used = 0
+
+
+class Lease(object):
+    """A pinned lookup result: the matched path (root-most first) and
+    how many tokens it covers.  Hold it across the install dispatches,
+    then :meth:`PrefixCache.release` it."""
+
+    __slots__ = ("nodes", "n_tokens")
+
+    def __init__(self, nodes, n_tokens):
+        self.nodes = nodes
+        self.n_tokens = int(n_tokens)
+
+    @property
+    def n_blocks(self):
+        return len(self.nodes)
+
+    def payloads(self):
+        return [n.payload for n in self.nodes]
+
+
+def _block_key(tokens):
+    """Hashable content key for one block of tokens (dtype-normalized
+    so int32/int64 prompts index the same node)."""
+    return np.asarray(tokens, np.int32).tobytes()
+
+
+class PrefixCache(object):
+    """Radix/trie index over token prefixes → committed KV blocks.
+
+    Args:
+      block_tokens: tokens per cached block (the radix edge width and
+        the install/extract granularity on device).
+      mem_budget_bytes: HBM budget for cached payloads; inserts evict
+        cold leaves to stay under it, and inserts that cannot fit
+        (everything pinned) are dropped with a counter bump rather
+        than blowing the budget.
+      clock: injectable LRU counter (tests); default is a process-wide
+        monotonic tick.
+    """
+
+    def __init__(self, block_tokens=16, mem_budget_bytes=256 << 20,
+                 clock=None):
+        if int(block_tokens) < 1:
+            raise ValueError(
+                "block_tokens must be >= 1, got {0}".format(block_tokens)
+            )
+        self.block_tokens = int(block_tokens)
+        self.mem_budget_bytes = int(mem_budget_bytes)
+        self._clock = clock if clock is not None else itertools.count(1).__next__
+        self._root = _Node(None, None, None, 0)
+        self.bytes_used = 0
+        self.n_nodes = 0
+        # counters consumed by ServingEngine.stats (deltas per job)
+        self.hits = 0          # lookups that matched >= 1 block
+        self.misses = 0        # lookups that matched nothing
+        self.tokens_saved = 0  # prompt tokens NOT re-prefilled
+        self.evictions = 0     # blocks evicted (budget or pressure)
+        self.insert_drops = 0  # inserts dropped: budget full of pins
+
+    # -- lookup / pin ---------------------------------------------------
+
+    def match_blocks(self, tokens, limit_tokens=None):
+        """Longest cached path of whole blocks prefixing ``tokens``
+        (bounded by ``limit_tokens``), WITHOUT pinning.  Returns the
+        node list, root-most first."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        n = tokens.shape[0] if limit_tokens is None else min(
+            tokens.shape[0], int(limit_tokens)
+        )
+        b = self.block_tokens
+        nodes = []
+        cur = self._root
+        for i in range(n // b):
+            child = cur.children.get(_block_key(tokens[i * b:(i + 1) * b]))
+            if child is None:
+                break
+            nodes.append(child)
+            cur = child
+        return nodes
+
+    def acquire(self, tokens, limit_tokens=None):
+        """Look up the longest cached prefix of ``tokens`` and PIN it
+        (refcount along the path).  Returns a :class:`Lease` —
+        ``n_tokens == 0`` on a miss.  ``limit_tokens`` caps the match
+        (the SlotDecoder passes ``len(prompt) - 1`` so at least one
+        real token remains to prefill for the first-token logits)."""
+        nodes = self.match_blocks(tokens, limit_tokens)
+        now = self._clock()
+        for node in nodes:
+            node.refs += 1
+            node.last_used = now
+        matched = len(nodes) * self.block_tokens
+        if nodes:
+            self.hits += 1
+            self.tokens_saved += matched
+        else:
+            self.misses += 1
+        return Lease(nodes, matched)
+
+    def release(self, lease):
+        """Unpin a :class:`Lease` (after the install dispatches are
+        enqueued — the device runtime keeps the buffers alive for any
+        in-flight computation that read them).  A lease releases
+        exactly once."""
+        if lease.nodes is None:
+            raise ValueError("lease already released")
+        for node in lease.nodes:
+            if node.refs <= 0:
+                raise ValueError("release() without matching acquire()")
+            node.refs -= 1
+        lease.nodes = None
+
+    # -- insert / evict -------------------------------------------------
+
+    def insert(self, tokens, payloads, first_block, nbytes_per_block):
+        """Attach ``payloads`` as blocks ``first_block..`` of the
+        ``tokens`` prefix path.  The first ``first_block`` blocks must
+        already be cached (they are: ``first_block`` is the lookup's
+        match length).  Returns how many blocks were newly inserted —
+        existing nodes are left in place (first writer wins; the
+        payloads are token-identical by construction)."""
+        tokens = np.asarray(tokens, np.int32).ravel()
+        b = self.block_tokens
+        cur = self._root
+        for i in range(int(first_block)):
+            cur = cur.children[_block_key(tokens[i * b:(i + 1) * b])]
+        inserted = 0
+        for j, payload in enumerate(payloads):
+            i = int(first_block) + j
+            key = _block_key(tokens[i * b:(i + 1) * b])
+            child = cur.children.get(key)
+            if child is None:
+                if not self._make_room(int(nbytes_per_block)):
+                    self.insert_drops += 1
+                    break
+                child = _Node(key, cur, payload, nbytes_per_block)
+                child.last_used = self._clock()
+                cur.children[key] = child
+                self.bytes_used += child.nbytes
+                self.n_nodes += 1
+                inserted += 1
+            cur = child
+        return inserted
+
+    def _make_room(self, nbytes):
+        """Evict cold leaves until ``nbytes`` more fits the budget;
+        False when it cannot (budget smaller than the block, or all
+        remaining blocks pinned/interior)."""
+        if nbytes > self.mem_budget_bytes:
+            return False
+        while self.bytes_used + nbytes > self.mem_budget_bytes:
+            if not self._evict_one():
+                return False
+        return True
+
+    def _cold_leaves(self):
+        out = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is not self._root and not node.children \
+                    and node.refs == 0:
+                out.append(node)
+        return out
+
+    def _evict_one(self):
+        leaves = self._cold_leaves()
+        if not leaves:
+            return False
+        victim = min(leaves, key=lambda n: n.last_used)
+        del victim.parent.children[victim.key]
+        victim.parent = None
+        victim.payload = None  # drops the device buffers
+        self.bytes_used -= victim.nbytes
+        self.n_nodes -= 1
+        self.evictions += 1
+        return True
+
+    def evict_cold(self, target_bytes):
+        """Evict cold leaf blocks (LRU first) until ``bytes_used <=
+        target_bytes``; the serving engine's degrade policy calls this
+        under backlog pressure BEFORE shrinking token budgets.
+        Returns the number of blocks evicted."""
+        n = 0
+        while self.bytes_used > max(0, int(target_bytes)):
+            if not self._evict_one():
+                break
+            n += 1
+        return n
+
+    def clear(self):
+        """Drop every unpinned block (between jobs / tests)."""
+        return self.evict_cold(0)
+
+    # -- introspection --------------------------------------------------
+
+    def stats(self):
+        return {
+            "prefix_hits": self.hits,
+            "prefix_misses": self.misses,
+            "prefix_tokens_saved": self.tokens_saved,
+            "evictions": self.evictions,
+            "insert_drops": self.insert_drops,
+            "bytes_used": self.bytes_used,
+            "nodes": self.n_nodes,
+        }
+
+    def __len__(self):
+        return self.n_nodes

@@ -127,7 +127,7 @@ def _preprocess_key(preprocess):
 
 
 def load_predictor(export_dir, builder=None, use_cache=True,
-                   preprocess=None):
+                   preprocess=None, config_overrides=None):
     """Load a serving export and return its ``predict`` callable.
 
     Args:
@@ -146,11 +146,18 @@ def load_predictor(export_dir, builder=None, use_cache=True,
         ``save_for_serving(..., extra_metadata={"preprocess":
         {"scale": 1/255}})``.  Pass ``False`` to disable even the
         metadata-declared stage (the caller widens on the host).
+      config_overrides: optional dict laid over the export metadata's
+        ``model_config`` before the builder runs — deployment-time
+        knobs that don't belong in the export (prefix-cache sizing,
+        ``draft_config`` toggles, ``chunk_size``...).  Exposed on the
+        Spark pipeline as ``TFModel.setModelConfig`` (pipeline.py).
     """
     key = (
         os.path.abspath(os.fspath(export_dir)),
         _builder_key(builder),
         _preprocess_key(preprocess),
+        json.dumps(config_overrides, sort_keys=True, default=str)
+        if config_overrides else None,
     )
     if use_cache and key in _PREDICTOR_CACHE:
         return _PREDICTOR_CACHE[key]
@@ -167,7 +174,10 @@ def load_predictor(export_dir, builder=None, use_cache=True,
                 "{{'model_ref': 'pkg.module:builder'}})".format(export_dir)
             )
         builder = resolve_ref(ref)
-    predict = builder(params, meta.get("model_config") or {})
+    model_config = dict(meta.get("model_config") or {})
+    if config_overrides:
+        model_config.update(config_overrides)
+    predict = builder(params, model_config)
     if preprocess is None:
         preprocess = meta.get("preprocess")
     if preprocess is not None and preprocess is not False:
@@ -183,8 +193,33 @@ def load_predictor(export_dir, builder=None, use_cache=True,
 # ----------------------------------------------------------------------
 
 
-def _stack_column(values):
-    return np.stack([np.asarray(v) for v in values])
+def _stack_column(values, column=None):
+    """Stack uniform rows into one batch array.  Ragged rows used to
+    die deep inside ``np.stack`` with a shapeless error; now the
+    ValueError NAMES the offending rows — the common trip-wire is the
+    speculative generation predictor, which takes uniform-length
+    batches only (no ``column_padding`` — see docs/inference.md
+    "Speculative decoding")."""
+    arrs = [np.asarray(v) for v in values]
+    shapes = {a.shape for a in arrs}
+    if len(shapes) > 1:
+        majority = max(shapes, key=lambda s: sum(
+            1 for a in arrs if a.shape == s
+        ))
+        ragged = [
+            (i, a.shape) for i, a in enumerate(arrs)
+            if a.shape != majority
+        ][:8]
+        raise ValueError(
+            "cannot stack ragged rows for input {0}: batch majority "
+            "shape is {1} but row(s) {2} differ.  This predictor "
+            "declares no padding for this input — uniform-length rows "
+            "only (speculative generation serving is the usual case; "
+            "see docs/inference.md)".format(
+                repr(column) if column else "batch", majority, ragged
+            )
+        )
+    return np.stack(arrs)
 
 
 def _stack_ragged_left(values, pad_value, multiple=1, cap=None):
@@ -258,7 +293,13 @@ def predict_rows(
       stats: optional dict the continuous scheduler fills with
         per-request latency accounting (``latency_sec`` in input
         order, plus admitted/evicted and robustness counters) — the
-        serving bench's p50/p99 source.
+        serving bench's p50/p99 source.  Cross-request reuse counters
+        land here too: ``prefix_hits`` / ``prefix_tokens_saved`` /
+        ``evictions`` / ``pressure_evictions`` when the export enables
+        the prefix cache, and ``spec_accepted`` / ``spec_proposed`` /
+        ``spec_accept_rate`` when a draft model drives speculative
+        chunks (docs/serving.md "Prefix cache & speculative
+        decoding").
       on_error: ``"raise"`` (fail fast; admission errors name the
         request index and offending column) or ``"record"`` (poison
         isolation: a bad row yields a typed error record at its input
@@ -319,7 +360,7 @@ def predict_rows(
                     cap=getattr(predict, "pad_cap", None),
                 )
             else:
-                batch[name] = _stack_column(values)
+                batch[name] = _stack_column(values, column=name)
         n = len(chunk_rows)
         if pad_to_batch and n < n_pad:
             batch = {

@@ -330,7 +330,17 @@ class ServingEngine(object):
             # admitted requests as they cross to the device — int32
             # today; narrower token dtypes would show up here
             "request_wire_bytes": 0,
+            # cross-request reuse counters (docs/serving.md "Prefix
+            # cache & speculative decoding"): prefix-cache hits /
+            # prompt tokens not re-prefilled / blocks evicted, and
+            # draft-model accept accounting.  Per-JOB deltas — the
+            # decoder's prefix cache and counters are shared across
+            # jobs, so the engine snapshots them here and subtracts.
+            "prefix_hits": 0, "prefix_tokens_saved": 0, "evictions": 0,
+            "pressure_evictions": 0,
+            "spec_accepted": 0, "spec_proposed": 0, "spec_accept_rate": 0.0,
         })
+        self._reuse_base = dict(self._decoder_reuse_stats())
         # scheduler state
         self._pending = []      # validated, waiting for a slot
         self._slot_req = {}     # slot -> in-flight request record
@@ -340,6 +350,30 @@ class ServingEngine(object):
         self._exhausted = False
         self._chunk_index = 0
         self._t0 = self._clock()
+
+    # -- cross-request reuse accounting --------------------------------
+
+    def _decoder_reuse_stats(self):
+        """The decoder's cumulative reuse counters (prefix cache +
+        speculative accepts); zeros for decoders without the surface
+        (test fakes, older builders)."""
+        fn = getattr(self.decoder, "reuse_stats", None)
+        return fn() if callable(fn) else {}
+
+    def _update_reuse_stats(self):
+        """Fold the decoder's reuse counters into ``stats`` as
+        per-job deltas (the decoder outlives the job)."""
+        cur = self._decoder_reuse_stats()
+        base = self._reuse_base
+        for key in ("prefix_hits", "prefix_tokens_saved", "evictions",
+                    "spec_accepted", "spec_proposed"):
+            if key in cur:
+                self.stats[key] = int(cur[key]) - int(base.get(key, 0))
+        prop = self.stats.get("spec_proposed", 0)
+        self.stats["spec_accept_rate"] = (
+            self.stats.get("spec_accepted", 0) / float(prop)
+            if prop else 0.0
+        )
 
     # -- admission ------------------------------------------------------
 
@@ -530,6 +564,17 @@ class ServingEngine(object):
                 # committed prefix already counts against the budget
                 backlog = len(self._pending)
                 if backlog > self.queue_depth:
+                    # backlog pressure gives back the cheapest memory
+                    # FIRST: cold prefix-cache branches (unpinned LRU
+                    # leaves, down to half the cache budget) are
+                    # evicted before any request's token budget is
+                    # shrunk — hot shared prefixes survive, and the
+                    # freed HBM belongs to the slot table again
+                    pc = getattr(self.decoder, "prefix_cache", None)
+                    if pc is not None:
+                        self.stats["pressure_evictions"] += pc.evict_cold(
+                            pc.mem_budget_bytes // 2
+                        )
                     shrunk = max(
                         self.degrade_floor,
                         (req["budget"] * self.queue_depth) // backlog,
@@ -566,9 +611,13 @@ class ServingEngine(object):
     # -- decode + recovery ---------------------------------------------
 
     def _run_chunk(self):
-        """One decode chunk under the watchdog; returns the token
-        block, or None when the watchdog fired (state already
-        recovered)."""
+        """One decode chunk under the watchdog; returns a
+        ``(tokens [B, T], valid [B])`` pair — row ``r``'s tokens are
+        ``tokens[r, :valid[r]]`` — or None when the watchdog fired
+        (state already recovered).  SlotDecoder chunks return the
+        pair natively (speculative chunks accept a VARIABLE token
+        count per slot); bare ``[B, T]`` blocks from legacy/test
+        decoders normalize to fully-valid rows."""
         idx = self._chunk_index
         self._chunk_index += 1
         wedge = self._wedge
@@ -597,7 +646,10 @@ class ServingEngine(object):
                 self._recover()
                 return None
         self.stats["chunks"] += 1
-        return toks
+        self._update_reuse_stats()
+        if isinstance(toks, tuple):
+            return toks
+        return toks, None
 
     def _recover(self):
         """Tear the engine down after a wedged dispatch and re-admit
@@ -728,12 +780,17 @@ class ServingEngine(object):
                     for r in self._drain_ready():
                         yield r
                     return
-                toks = self._run_chunk()
-                if toks is None:
+                block = self._run_chunk()
+                if block is None:
                     continue  # watchdog fired; state already recovered
+                toks, valid = block
                 t_chunk = self._clock()
                 for slot, req in list(self._slot_req.items()):
-                    if self._consume(req, toks[slot]):
+                    row = (
+                        toks[slot] if valid is None
+                        else toks[slot][:int(valid[slot])]
+                    )
+                    if self._consume(req, row):
                         self._finalize(req, t_chunk)
                         self.decoder.evict(slot)
                         del self._slot_req[slot]
@@ -743,5 +800,6 @@ class ServingEngine(object):
                 for r in self._drain_ready():
                     yield r
         finally:
+            self._update_reuse_stats()
             if self._watchdog is not None:
                 self._watchdog.close()

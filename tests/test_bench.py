@@ -83,6 +83,24 @@ def _full_record():
                         "degraded": 31, "latency_p50_ms": 900.0,
                         "latency_p99_ms": 2200.0},
         },
+        "serving_prefix": {
+            "rows": 32, "slots": 8, "prefix_len": 320,
+            "cold_rows_per_sec": 33.5,
+            "shared80": {"rows_per_sec": 55.3, "hit_rate": 0.781,
+                         "prefix_tokens_saved": 8000,
+                         "latency_p50_ms": 93.3,
+                         "latency_p99_ms": 160.1},
+            "shared0": {"rows_per_sec": 29.3, "hit_rate": 0.0},
+            "prefix_gain": 1.653, "outputs_match": True,
+        },
+        "serving_speculative": {
+            "batch": 4, "max_new_tokens": 64, "draft_len": 4,
+            "plain_tokens_per_sec": 457.5,
+            "spec_tokens_per_sec": 382.7,
+            "speedup_vs_greedy": 0.837, "accept_rate": 0.918,
+            "rounds": 13, "tokens_per_verify": 4.92,
+            "token_exact": True,
+        },
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
                         "resnet50": {"rows_per_sec": 51.5,
                                      "wire_mb_per_batch": 38.535},
@@ -122,6 +140,8 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["serving_generate_rows_s"] == 59.77
     assert parsed["serving_continuous_rows_s"] == 78.41
     assert parsed["serving_overload_goodput"] == 11.8  # reject-policy row
+    assert parsed["serving_prefix_gain"] == 1.653  # 80%-shared vs cold
+    assert parsed["spec_accept_rate"] == 0.918
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
     assert parsed["feed_wire_mb_per_step"] == 0.0512  # narrowed wire
@@ -138,6 +158,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "resnet50_img_s", "vs_baseline", "lm_tok_s", "lm_mfu",
         "spark_feed_steps_s", "moe_tok_s", "serving_generate_rows_s",
         "serving_continuous_rows_s", "serving_overload_goodput",
+        "serving_prefix_gain", "spec_accept_rate",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "feed_wire_mb_per_step", "serving_u8_vs_f32",
         "decode_overlap_gain", "wall_sec", "full_record",

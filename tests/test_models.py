@@ -350,6 +350,61 @@ class TestTransformer:
         with _pytest.raises(ValueError, match="ngram"):
             tr.generate_speculative(model, params, prompt, 8, ngram=0)
 
+    def test_speculative_draft_model_is_lossless_with_stats(self):
+        # a DRAFT MODEL replaces prompt lookup: outputs must still be
+        # the exact greedy chain whatever the draft proposes, and the
+        # accept accounting must calibrate (self-draft -> rate 1.0)
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(max_seq_len=64)
+        draft_model, _ = self._tiny(max_seq_len=64)
+        prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 10), 0, 64)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        dparams = draft_model.init(jax.random.PRNGKey(9), prompt)["params"]
+        ref = tr.generate(model, params, prompt, max_new_tokens=12)
+        st = {}
+        got, rounds = tr.generate_speculative(
+            model, params, prompt, 12, draft_len=4,
+            draft_model=draft_model, draft_params=dparams,
+            return_stats=True, stats=st,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+        assert st["rounds"] == int(rounds)
+        assert st["proposed"] == 4 * st["rounds"]
+        assert 0.0 <= st["accept_rate"] <= 1.0
+        # self-draft: every proposal verifies
+        st = {}
+        got = tr.generate_speculative(
+            model, params, prompt, 12, draft_len=4,
+            draft_model=model, draft_params=params, stats=st,
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+        assert st["accept_rate"] == 1.0
+        assert st["rounds"] < 12  # strictly fewer verifies than tokens
+
+    def test_speculative_draft_vocab_mismatch_raises(self):
+        import pytest as _pytest
+
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model, _ = self._tiny(max_seq_len=64)
+        bad = tr.Transformer(tr.TransformerConfig(
+            vocab_size=32, num_layers=1, num_heads=2, head_dim=8,
+            embed_dim=16, mlp_dim=32, max_seq_len=64, dtype="float32",
+        ))
+        prompt = jnp.zeros((1, 8), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        bparams = bad.init(jax.random.PRNGKey(1), prompt)["params"]
+        with _pytest.raises(ValueError, match="vocab"):
+            tr.generate_speculative(
+                model, params, prompt, 8, draft_model=bad,
+                draft_params=bparams,
+            )
+        with _pytest.raises(ValueError, match="draft_params"):
+            tr.generate_speculative(
+                model, params, prompt, 8, draft_model=bad,
+            )
+
     def test_speculative_composes_with_quantized_weights(self):
         from tensorflowonspark_tpu import quantize as qz
         from tensorflowonspark_tpu.models import transformer as tr

@@ -57,6 +57,25 @@ def test_param_setters_chain_and_merge():
     assert args.num_ps == 0 and args.reservation_timeout == 600
 
 
+def test_model_schedule_and_model_config_params():
+    # ISSUE 6 wiring: the serving reuse knobs ride the pipeline as
+    # TFModel params — setSchedule selects the continuous slot
+    # scheduler, setModelConfig lays deployment-time overrides
+    # (prefix cache, draft model, chunk sizing) over the export's
+    # model_config at load (serving.load_predictor config_overrides)
+    from tensorflowonspark_tpu.pipeline import TFModel
+
+    m = TFModel({})
+    assert m.getSchedule() == "static"  # reference-parity default
+    assert m.getModelConfig() is None
+    m.setSchedule("continuous").setModelConfig(
+        {"prefix_cache": True, "prefix_mem_mb": 64.0}
+    )
+    args = m.merge_args_params()
+    assert args.schedule == "continuous"
+    assert args.model_config["prefix_cache"] is True
+
+
 def test_merge_does_not_mutate_source_args():
     est = TFEstimator(lambda a, c: None, {"epochs": 99})
     est.setEpochs(5)

@@ -321,6 +321,133 @@ class TestContinuous:
             ))
 
 
+class TestDraftSpeculative:
+    """Draft-model speculative decoding through the serving surface
+    (ISSUE 6): greedy parity vs plain generate, accept-rate stats, and
+    the uniform-length contract's named error."""
+
+    def _draft_bits(self, tiny=None, draft_layers=1):
+        import jax
+        import jax.numpy as jnp
+
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        tiny = dict(tiny or TINY)
+        dcfg = dict(tiny, num_layers=draft_layers)
+        draft = tr.Transformer(tr.TransformerConfig(**dcfg))
+        dparams = draft.init(
+            jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        return dcfg, jax.tree.map(np.asarray, dparams)
+
+    def test_continuous_draft_parity_and_accept_stats(self):
+        # a random draft proposes garbage — acceptance ~0 — but the
+        # outputs must STILL be token-identical to plain greedy decode
+        # (speculation is lossless by construction)
+        model, params, plain = _gen_predict(max_new=6)
+        prompts, rows = _prompts([4, 7, 11, 2, 9]), None
+        rows = [{"prompt": p} for p in prompts]
+        ref = list(serving.predict_rows(
+            plain, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous",
+        ))
+        dcfg, dparams = self._draft_bits()
+        _, _, spec = _gen_predict(max_new=6, extra={
+            "draft_config": dcfg, "draft_params": dparams,
+            "draft_len": 3,
+        })
+        stats = {}
+        got = list(serving.predict_rows(
+            spec, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", stats=stats,
+        ))
+        for i in range(len(rows)):
+            np.testing.assert_array_equal(
+                np.asarray(got[i]["generated"]),
+                np.asarray(ref[i]["generated"]), err_msg=str(i),
+            )
+        assert stats["spec_proposed"] > 0
+        assert 0.0 <= stats["spec_accept_rate"] <= 1.0
+
+    def test_continuous_self_draft_accepts_everything(self):
+        # draft == flagship: every proposal verifies, accept rate 1.0
+        # — the accept accounting's calibration point
+        import jax
+
+        model, params, plain = _gen_predict(max_new=6)
+        rows = [{"prompt": p} for p in _prompts([4, 7, 11, 2])]
+        ref = list(serving.predict_rows(
+            plain, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous",
+        ))
+        _, _, spec = _gen_predict(max_new=6, extra={
+            "draft_config": dict(TINY),
+            "draft_params": jax.tree.map(np.asarray, params),
+            "draft_len": 3,
+        })
+        stats = {}
+        got = list(serving.predict_rows(
+            spec, rows, {"prompt": "tokens"}, batch_size=2,
+            schedule="continuous", stats=stats,
+        ))
+        for i in range(len(rows)):
+            np.testing.assert_array_equal(
+                np.asarray(got[i]["generated"]),
+                np.asarray(ref[i]["generated"]), err_msg=str(i),
+            )
+        assert stats["spec_accept_rate"] == 1.0
+        assert stats["spec_accepted"] == stats["spec_proposed"]
+
+    def test_static_speculative_draft_reports_accept_rate(self):
+        # the uniform-batch static path: accept_rate comes back as an
+        # output column when a draft model drives the speculation
+        import jax
+
+        model, params, plain = _gen_predict(max_new=6)
+        rows = [{"prompt": p} for p in _prompts([8, 8, 8])]
+        ref = list(serving.predict_rows(
+            plain, [dict(r) for r in rows], {"prompt": "tokens"},
+            batch_size=3,
+        ))
+        _, _, spec = _gen_predict(max_new=6, extra={
+            "speculative": True, "draft_config": dict(TINY),
+            "draft_params": jax.tree.map(np.asarray, params),
+            "draft_len": 3,
+        })
+        out = list(serving.predict_rows(
+            spec, rows, {"prompt": "tokens"}, batch_size=3,
+            pad_to_batch=False,
+        ))
+        for i in range(len(rows)):
+            np.testing.assert_array_equal(
+                np.asarray(out[i]["generated"]),
+                np.asarray(ref[i]["generated"]), err_msg=str(i),
+            )
+            assert float(out[i]["accept_rate"]) == 1.0  # self-draft
+
+    def test_static_speculative_ragged_rows_named_error(self):
+        # satellite: generate_speculative assumes uniform-length
+        # batches — ragged rows must fail AT ENTRY with an error that
+        # names the offending rows, not np.stack's shapeless one
+        _, _, spec = _gen_predict(max_new=6, extra={"speculative": True})
+        rows = [{"prompt": p} for p in _prompts([8, 5, 8])]
+        with pytest.raises(ValueError, match=r"row\(s\) \[\(1,"):
+            list(serving.predict_rows(
+                spec, rows, {"prompt": "tokens"}, batch_size=3,
+                pad_to_batch=False,
+            ))
+
+    def test_draft_requires_weights_and_greedy(self):
+        dcfg, dparams = self._draft_bits()
+        with pytest.raises(ValueError, match="draft"):
+            _gen_predict(max_new=6, extra={"draft_config": dcfg})
+        with pytest.raises(ValueError, match="greedy"):
+            _gen_predict(max_new=6, extra={
+                "draft_config": dcfg, "draft_params": dparams,
+                "temperature": 0.7,
+            })
+
+
 def test_infer_output_schema_and_export_metadata(tmp_path):
     # export-time schema derivation (satellite of the probe-waste fix:
     # pipeline's native transform reads output_schema from metadata
