@@ -676,9 +676,12 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
     scans and admits the next prompt into the freed KV slot
     (token-identical outputs up to each budget, parity-tested in
     tests/test_serving.py).  Both paths report per-request latency
-    p50/p99 (all requests submitted at t0; a request's latency ends
-    when ITS tokens are done — for static that is its whole batch's
-    scan end)."""
+    p50/p99 sourced from the SHARED telemetry histogram
+    (serving.latency_summary — ISSUE 7): a request's latency runs from
+    the scheduler pulling it off the source to its row being emitted,
+    with IDENTICAL semantics on both schedules — for static that is
+    its batch's assembly + full decode scan, for continuous its own
+    slot's lifetime."""
     import numpy as np
 
     import jax
@@ -729,6 +732,14 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
     def _pct(lat_ms, q):
         return round(float(np.percentile(np.asarray(lat_ms), q)), 1)
 
+    def _latency(summary, fallback_ms, q):
+        # both schedules source p50/p99 from the SHARED telemetry
+        # histogram (identical submit->finish semantics, ISSUE 7);
+        # the raw-list fallback only fires with TFOS_TELEMETRY=0
+        if summary["count"]:
+            return round(summary["p50_ms" if q == 50 else "p99_ms"], 1)
+        return _pct(fallback_ms, q)
+
     # warm both length buckets (128 and 256) outside the timed region
     list(serving.predict_rows(
         predict,
@@ -736,6 +747,7 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
         + [{"prompt": rows[0]["prompt"]} for _ in range(batch)],
         mapping, batch_size=batch,
     ))
+    lat_base = serving.latency_histogram().snapshot()
     t0 = time.perf_counter()
     n_out = 0
     lat_static = []
@@ -747,6 +759,7 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
         n_out += 1
     dt = time.perf_counter() - t0
     assert n_out == rows_n
+    static_summary = serving.latency_summary(since=lat_base)
 
     # continuous: warm the slot engine's prefill buckets + chunk
     # program outside the timed region (tiny budgets — two chunks)
@@ -759,6 +772,7 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
         mapping_cont, batch_size=batch, schedule="continuous",
     ))
     sched = {}
+    lat_base_cont = serving.latency_histogram().snapshot()
     t0c = time.perf_counter()
     n_out = 0
     for r in serving.predict_rows(
@@ -770,13 +784,14 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
     dt_cont = time.perf_counter() - t0c
     assert n_out == rows_n
     lat_cont = [1e3 * v for v in sched["latency_sec"].values()]
+    cont_summary = serving.latency_summary(since=lat_base_cont)
 
     out = {
         "rows_per_sec": round(rows_n / dt, 2),
         "generated_tokens_per_sec": round(rows_n * max_new / dt, 1),
         "delivered_tokens_per_sec": round(int(budgets.sum()) / dt, 1),
-        "latency_p50_ms": _pct(lat_static, 50),
-        "latency_p99_ms": _pct(lat_static, 99),
+        "latency_p50_ms": _latency(static_summary, lat_static, 50),
+        "latency_p99_ms": _latency(static_summary, lat_static, 99),
         "rows": rows_n,
         "batch_size": batch,
         "max_new_tokens": max_new,
@@ -796,8 +811,8 @@ def serving_generate_bench(rows_n=64, batch=8, max_new=64, chunk=16):
             "delivered_tokens_per_sec": round(
                 int(budgets.sum()) / dt_cont, 1
             ),
-            "latency_p50_ms": _pct(lat_cont, 50),
-            "latency_p99_ms": _pct(lat_cont, 99),
+            "latency_p50_ms": _latency(cont_summary, lat_cont, 50),
+            "latency_p99_ms": _latency(cont_summary, lat_cont, 99),
             "slots": batch,
             "chunk_size": chunk,
             "admitted": sched["admitted"],
@@ -1143,6 +1158,142 @@ def serving_overload_bench(rows_n=32, slots=4, max_new=24, chunk=8,
             "wall_sec": round(wall, 3),
         }
     return out
+
+
+class _ListFeed(object):
+    """Minimal in-memory DataFeed stand-in for the telemetry-overhead
+    row: serves pre-built row batches, then reports exhaustion."""
+
+    def __init__(self, batches):
+        self._batches = list(batches)
+        self._i = 0
+
+    def next_batch(self, batch_size):
+        if self._i >= len(self._batches):
+            return []
+        b = self._batches[self._i]
+        self._i += 1
+        return b
+
+    def should_stop(self):
+        return self._i >= len(self._batches)
+
+    def terminate(self):
+        pass
+
+    def commit_partitions(self):
+        return 0
+
+
+def telemetry_overhead_bench(train_steps=160, rows_n=24, slots=4,
+                             max_new=8, chunk=4):
+    """Instrumentation cost of the fleet telemetry plane (ISSUE 7
+    acceptance: <= 2% on the lm training path, and disabled mode adds
+    no measurable cost).
+
+    Runs the SAME tiny-LM ``train_on_feed`` loop (the instrumented
+    feed_wait -> h2d -> dispatch path the lm_tok_s flagship rides) and
+    the SAME continuous-serving path twice — telemetry enabled vs
+    ``set_enabled(False)`` — and reports the relative difference.  The
+    models are deliberately small: overhead is per-STEP host work, so
+    a small fast-stepping model is the worst case for the percentage,
+    making this an upper bound on the flagship's cost.
+    """
+    import numpy as np
+
+    import jax
+    import optax
+
+    from tensorflowonspark_tpu import serving, telemetry
+    from tensorflowonspark_tpu.models import transformer as tr
+    from tensorflowonspark_tpu.parallel import dp
+
+    cfg = dict(
+        vocab_size=256, num_layers=2, num_heads=4, head_dim=16,
+        embed_dim=64, mlp_dim=128, max_seq_len=64, dtype="float32",
+        attention_impl="dot",
+    )
+    B, S = 4, cfg["max_seq_len"]
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    import jax.numpy as jnp
+
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, S), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    # host copy: create_state's device_put must mint FRESH buffers per
+    # run (the jitted step donates them), never alias a shared one
+    params = jax.tree.map(np.asarray, params)
+    trainer = dp.SyncTrainer(tr.loss_fn(model), optax.adamw(1e-4))
+    rng_np = np.random.RandomState(0)
+    rows = [
+        {"tokens": rng_np.randint(0, 256, (S,)).astype(np.int32)}
+        for _ in range(B)
+    ]
+
+    def run_train():
+        # fresh state per run: the jitted step DONATES its input state,
+        # so a shared one would be dead after the first run
+        state = trainer.create_state(params)
+        # one spare batch: the global-stop barrier drops the batch
+        # pulled in the round that discovers exhaustion, so max_steps
+        # (not the feed) must be the limiter for an exact step count
+        feed = _ListFeed([list(rows)] * (train_steps + 1))
+        t0 = time.perf_counter()
+        out = trainer.train_on_feed(
+            state, feed, B, max_steps=train_steps, log_every=0,
+            terminate_on_max_steps=False,
+        )
+        jax.block_until_ready(out.params)
+        return time.perf_counter() - t0
+
+    predict = tr.serving_builder(
+        params,
+        dict(cfg, mode="generate", max_new_tokens=max_new,
+             pad_multiple=16, chunk_size=chunk),
+    )
+    srows = [
+        {"prompt": rng_np.randint(0, 256, (n,)).astype(np.int32)}
+        for n in rng_np.randint(8, 17, size=rows_n)
+    ]
+
+    def run_serving():
+        t0 = time.perf_counter()
+        n = sum(
+            1 for _ in serving.predict_rows(
+                predict, srows, {"prompt": "tokens"}, batch_size=slots,
+                schedule="continuous",
+            )
+        )
+        assert n == rows_n
+        return time.perf_counter() - t0
+
+    was_enabled = telemetry.enabled()
+    try:
+        run_train()     # compile warmup (shared across both modes)
+        run_serving()
+        telemetry.set_enabled(False)
+        train_off = min(run_train(), run_train())
+        serve_off = min(run_serving(), run_serving())
+        telemetry.set_enabled(True)
+        train_on = min(run_train(), run_train())
+        serve_on = min(run_serving(), run_serving())
+    finally:
+        telemetry.set_enabled(was_enabled)
+
+    def pct(on, off):
+        return round(100.0 * (on - off) / off, 2)
+
+    return {
+        "train_steps": train_steps,
+        "train_steps_s_instrumented": round(train_steps / train_on, 1),
+        "train_steps_s_disabled": round(train_steps / train_off, 1),
+        # the lm_tok_s path's number: the compact-summary key
+        "overhead_pct": pct(train_on, train_off),
+        "serving_rows_s_instrumented": round(rows_n / serve_on, 1),
+        "serving_rows_s_disabled": round(rows_n / serve_off, 1),
+        "serving_overhead_pct": pct(serve_on, serve_off),
+        "platform": __import__("jax").devices()[0].platform,
+    }
 
 
 def _decode_step_ms(model, params, prompt, new_tokens):
@@ -2354,6 +2505,11 @@ def bench_summary(record):
         "decode_overlap_gain": _pluck(
             record, "dataplane", "overlap_gain"
         ),
+        # fleet telemetry plane (docs/observability.md): measured
+        # instrumented-vs-disabled cost on the training loop
+        "telemetry_overhead_pct": _pluck(
+            record, "telemetry_overhead", "overhead_pct"
+        ),
         "wall_sec": record.get("bench_wall_sec"),
     }
 
@@ -2365,6 +2521,16 @@ def emit_record(record, full_path=None):
     finished section instead of nulling it — and the last stdout line
     is always standalone-parseable and <= 1500 chars."""
     path = full_path or BENCH_FULL_PATH
+    try:
+        # the final metrics-registry snapshot rides the FULL record
+        # only (never the summary line — its size is bounded by the
+        # headline keys); what the instrumented paths counted during
+        # the run is part of the run's evidence
+        from tensorflowonspark_tpu import telemetry
+
+        record = dict(record, telemetry=telemetry.get_registry().snapshot())
+    except Exception:  # noqa: BLE001 - the record must land regardless
+        pass
     try:
         with open(path, "w") as f:
             json.dump(record, f)
@@ -2447,6 +2613,9 @@ def main(model_name="resnet50", with_feed=True):
             ("decode_long", decode_long_bench, 160),
             ("async_ps_tpu", ps_tpu_bench, 100),
             ("serving_tpu", serving_tpu_bench, 120),
+            # telemetry-plane instrumentation cost (ISSUE 7: <= 2% on
+            # the train loop; tiny models, so mostly compile time)
+            ("telemetry_overhead", telemetry_overhead_bench, 90),
         ):
             if est_sec and _remaining() < est_sec:
                 out.setdefault("skipped", {})[name] = (
@@ -2501,6 +2670,8 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(serving_prefix_bench)))
     elif "serving_speculative" in sys.argv:
         print(json.dumps(with_retry(serving_speculative_bench)))
+    elif "telemetry_overhead" in sys.argv:
+        print(json.dumps(with_retry(telemetry_overhead_bench)))
     elif "serving" in sys.argv:
         print(json.dumps(with_retry(serving_bench)))
     elif "long_context" in sys.argv:

@@ -113,6 +113,18 @@ class ClusterMonitor(object):
                     "monitor: executor %d reborn at generation %d",
                     eid, rec["generation"],
                 )
+                # driver-side restart marker: chaos/ops tooling reads
+                # restarts out of the trace alongside watchdog/shed
+                # events (tests/test_telemetry.py)
+                from tensorflowonspark_tpu import telemetry
+
+                telemetry.get_registry().counter(
+                    "cluster.restart_events"
+                ).inc(rec["generation"] - known)
+                telemetry.get_tracer().mark(
+                    "executor_restart", trace="executor%d" % eid,
+                    executor_id=eid, generation=rec["generation"],
+                )
         dead = self.server.liveness.dead()
         now = time.monotonic()
         for eid in list(self._first_dead):
@@ -165,6 +177,30 @@ class ClusterMonitor(object):
         detected; no-op otherwise.  Feed loops call this every poll."""
         if self.error is not None:
             raise DeadExecutorError(self.error, self.dead_executor_id)
+
+    def metrics(self):
+        """Per-executor telemetry snapshots merged with liveness (the
+        in-process half of ``TFCluster.metrics()`` — usable on a bare
+        monitor too).  Returns ``{executor_id: {"metrics": snapshot?,
+        "metrics_age": secs?, "heartbeat_age": secs?, "generation",
+        "compute_alive", "host"}}``."""
+        store = self.server.metrics.snapshot()
+        liveness = self.server.liveness.snapshot()
+        per = {}
+        for eid_s in set(store) | set(liveness):
+            rec = {}
+            s = store.get(eid_s)
+            if s is not None:
+                rec["metrics"] = s["metrics"]
+                rec["metrics_age"] = s["age"]
+            lv = liveness.get(eid_s)
+            if lv is not None:
+                rec["heartbeat_age"] = lv["age"]
+                rec["generation"] = lv["generation"]
+                rec["compute_alive"] = lv["compute_alive"]
+                rec["host"] = lv["host"]
+            per[int(eid_s)] = rec
+        return per
 
     def stop(self):
         self._stop.set()
@@ -723,6 +759,55 @@ class TPUCluster(object):
                         n["tb_pid"],
                     )
 
+    def metrics(self, include_ledger=True):
+        """Driver-side fleet telemetry view (docs/observability.md).
+
+        Pulls every executor's newest registry snapshot out of the
+        reservation server's :class:`~tensorflowonspark_tpu.cluster.reservation.MetricsStore`
+        (snapshots arrive piggybacked on heartbeats), merges in the
+        liveness fields (heartbeat age, generation, compute_alive) and
+        — with ``include_ledger`` — each worker's partition-ledger
+        committed/pending counts, then folds everything into ONE fleet
+        snapshot via
+        :func:`~tensorflowonspark_tpu.telemetry.aggregate.merge_snapshots`.
+
+        Returns ``{"executors": {executor_id: {...}}, "fleet": merged
+        snapshot, "restart_events": int, "generation": int}``.  Works
+        in-process against the driver-resident server; a remote
+        observer gets the same data through
+        ``reservation.Client(addr).get_metrics()``.
+        """
+        from tensorflowonspark_tpu.telemetry import aggregate
+
+        per = (
+            self.monitor.metrics() if self.monitor is not None
+            else ClusterMonitor(
+                self.server, self.cluster_info
+            ).metrics()
+        )
+        if include_ledger:
+            for n in self.cluster_info:
+                if n["job_name"] not in ("worker", "chief", "master"):
+                    continue
+                eid = n["executor_id"]
+                try:
+                    m = self._connect(n)
+                    rec = per.setdefault(eid, {})
+                    rec["ledger"] = {
+                        "committed": len(
+                            m.ledger("committed")._getvalue()
+                        ),
+                        "pending": len(m.ledger("pending")._getvalue()),
+                    }
+                except Exception:  # noqa: BLE001 - node mid-restart /
+                    pass  # gone: its snapshot simply lacks the ledger
+        view = aggregate.fleet_view(per)
+        view["restart_events"] = (
+            self.monitor.restart_events if self.monitor is not None else 0
+        )
+        view["generation"] = self.server.generation
+        return view
+
     def tensorboard_url(self):
         """URL of the cluster's tensorboard, if one was launched
         (reference: TFCluster.py:207-212)."""
@@ -736,6 +821,11 @@ class TPUCluster(object):
         """JAX coordination address (chief/worker:0) for this cluster."""
         _, coordinator, _ = node.build_cluster_spec(self.cluster_info)
         return coordinator
+
+
+#: Reference-parity alias (the reference called its handle TFCluster);
+#: ``TFCluster.metrics()`` in docs refers to this class.
+TFCluster = TPUCluster
 
 
 def _with_partition_marker(pid, partition):
@@ -769,6 +859,8 @@ def run(
     max_restarts=3,
     heartbeat_interval=None,
     recovery_timeout=120.0,
+    profile_dir=None,
+    profile_steps=None,
 ):
     """Start a cluster over an executor fleet (reference: TFCluster.py:215-383).
 
@@ -813,8 +905,24 @@ def run(
         dead after 3 missed intervals).
       recovery_timeout: under ``elastic``, seconds a dead node may take
         to come back before the failure is permanent.
+      profile_dir: capture a ``jax.profiler`` device trace from every
+        compute process into ``profile_dir/<pid>`` (exported via
+        ``TFOS_PROFILE_DIR`` — compute processes inherit the driver's
+        environment; a build without the profiler no-ops gracefully,
+        see tensorboard.start_profile and docs/observability.md).
+      profile_steps: stop each capture after this many train steps
+        (None = capture until the compute process exits).
     """
     from tensorflowonspark_tpu.engine import Engine, LocalEngine, SparkEngine
+
+    if profile_dir:
+        import os as _os
+
+        from tensorflowonspark_tpu import tensorboard as _tb
+
+        _os.environ[_tb.PROFILE_DIR_ENV] = str(profile_dir)
+        if profile_steps:
+            _os.environ[_tb.PROFILE_STEPS_ENV] = str(int(profile_steps))
 
     owns_engine = False
     if isinstance(engine, int):

@@ -379,6 +379,18 @@ def _compute_process_main(fn_bytes, args, ctx):
         deadline=30.0,
         base=0.1,
     )
+    # fleet telemetry: ship this process's registry snapshot into the
+    # manager kv so the supervisor's heartbeats carry it to the driver
+    # (telemetry/aggregate.py; returns None when TFOS_TELEMETRY=0)
+    from tensorflowonspark_tpu import telemetry as _telemetry
+
+    _publisher = _telemetry.start_node_publisher(ctx.mgr)
+    # on-demand device profiling: TFOS_PROFILE_DIR / TFOS_PROFILE_STEPS
+    # start a jax.profiler trace for this compute process (graceful
+    # no-op when the build lacks the profiler — see tensorboard.py)
+    from tensorflowonspark_tpu import tensorboard as _tb
+
+    _profile = _tb.maybe_start_profile_from_env()
     try:
         fn = _cp.loads(fn_bytes)
         fn(args, ctx)
@@ -391,6 +403,11 @@ def _compute_process_main(fn_bytes, args, ctx):
         except Exception:  # noqa: BLE001 - best effort error reporting
             logger.exception("unable to report error to manager")
         raise
+    finally:
+        if _profile is not None:
+            _profile.stop()
+        if _publisher is not None:
+            _publisher.stop()
     # Completion signal: shutdown() polls this instead of the reference's
     # blind grace_secs sleep (TFCluster.py:125), so the chief's post-feed
     # export always finishes before teardown.  Outside the user-fn try: a
@@ -710,11 +727,19 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
             # (reference: TFSparkNode.py:427-431).  A heartbeater runs
             # for the duration so the driver monitor sees this node too.
             ctx.mgr = mgr
+            from tensorflowonspark_tpu import telemetry as _telemetry
+
             hb = reservation.Heartbeater(
                 cluster_meta["server_addr"],
                 executor_id,
                 interval=cluster_meta.get("heartbeat_interval"),
                 host=host,
+                # foreground mode: the user fn runs IN this process, so
+                # its registry snapshot ships directly on the beats
+                metrics_fn=(
+                    _telemetry.get_registry().snapshot
+                    if _telemetry.enabled() else None
+                ),
             ).start()
             try:
                 fn(args, ctx)

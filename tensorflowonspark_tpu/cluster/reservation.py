@@ -210,6 +210,45 @@ class Liveness(object):
             }
 
 
+class MetricsStore(object):
+    """Server-side store of the newest telemetry snapshot per executor
+    (the cluster half of the fleet telemetry plane — see
+    telemetry/aggregate.py).  Snapshots arrive piggybacked on
+    HEARTBEAT frames and are answered back out through the METRICS
+    wire op; each record keeps its arrival time so the driver can
+    judge staleness."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._snaps = {}  # executor_id -> {"metrics": dict, "t": monotonic}
+
+    def update(self, executor_id, snapshot):
+        if not isinstance(snapshot, dict):
+            return
+        with self._lock:
+            self._snaps[int(executor_id)] = {
+                "metrics": snapshot,
+                "t": time.monotonic(),
+            }
+
+    def forget(self, executor_id):
+        with self._lock:
+            self._snaps.pop(int(executor_id), None)
+
+    def snapshot(self):
+        """``{executor_id(str): {"metrics": dict, "age": secs}}`` (str
+        keys — JSON wire format, matching the liveness snapshot)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                str(eid): {
+                    "metrics": rec["metrics"],
+                    "age": now - rec["t"],
+                }
+                for eid, rec in self._snaps.items()
+            }
+
+
 class MessageSocket(object):
     """Length-prefixed JSON framing over a TCP socket
     (reference: reservation.py:68-97, re-done without pickle)."""
@@ -251,6 +290,7 @@ class Server(MessageSocket):
         assert count > 0
         self.reservations = Reservations(count)
         self.liveness = Liveness(heartbeat_interval, miss_threshold)
+        self.metrics = MetricsStore()
         self.done = threading.Event()
         self._stop_requested = threading.Event()
         self._listener = None
@@ -369,6 +409,12 @@ class Server(MessageSocket):
                 compute_alive=msg.get("compute_alive", True),
                 host=msg.get("host", ""),
             )
+            # telemetry snapshots piggyback on beats (the node never
+            # opens a second connection just for observability)
+            if msg.get("metrics") is not None:
+                self.metrics.update(
+                    msg.get("executor_id", -1), msg["metrics"]
+                )
             # stop flag + cluster generation piggyback on the reply, so
             # heartbeaters double as the survivors' rebirth signal
             self.send(
@@ -389,6 +435,18 @@ class Server(MessageSocket):
                 msg.get("executor_id", -1), msg.get("generation", 0)
             )
             self.send(sock, {"type": "REBIRTH_RESP", "generation": gen})
+        elif mtype == "METRICS":
+            # the fleet-telemetry pull: per-executor snapshots plus the
+            # liveness fields the driver merges into its fleet view
+            self.send(
+                sock,
+                {
+                    "type": "METRICS_RESP",
+                    "executors": self.metrics.snapshot(),
+                    "liveness": self.liveness.snapshot(),
+                    "generation": self.generation,
+                },
+            )
         elif mtype == "LIVENESS":
             self.send(
                 sock,
@@ -560,19 +618,30 @@ class Client(MessageSocket):
         return self._request({"type": "STOP"})
 
     def heartbeat(self, executor_id, generation=0, compute_alive=True,
-                  host=""):
+                  host="", metrics=None):
         """Send one HEARTBEAT frame; returns the server's reply (which
         carries the cluster-wide ``stop`` flag, so heartbeaters double
-        as stop-signal listeners)."""
-        return self._request(
-            {
-                "type": "HEARTBEAT",
-                "executor_id": int(executor_id),
-                "generation": int(generation),
-                "compute_alive": bool(compute_alive),
-                "host": host,
-            }
-        )
+        as stop-signal listeners).  ``metrics`` optionally piggybacks a
+        telemetry registry snapshot (plain dict) for the server's
+        :class:`MetricsStore`."""
+        frame = {
+            "type": "HEARTBEAT",
+            "executor_id": int(executor_id),
+            "generation": int(generation),
+            "compute_alive": bool(compute_alive),
+            "host": host,
+        }
+        if metrics is not None:
+            frame["metrics"] = metrics
+        return self._request(frame)
+
+    def get_metrics(self):
+        """Fetch the server's per-executor telemetry snapshots:
+        ``(executors, liveness)`` dicts keyed by executor id (string
+        keys — JSON wire format).  Merge with
+        :func:`tensorflowonspark_tpu.telemetry.aggregate.merge_snapshots`."""
+        resp = self._request({"type": "METRICS"})
+        return resp["executors"], resp.get("liveness", {})
 
     def get_liveness(self):
         """Fetch the server's liveness snapshot: ``(executors, dead)``
@@ -626,6 +695,11 @@ class Heartbeater(object):
         (the chaos harness's heartbeat-delay/drop injection point —
         dropping frames here exercises exactly the miss-threshold path
         a real network partition would).
+      metrics_fn: optional zero-arg callable returning a telemetry
+        registry snapshot (plain dict) to piggyback on the beat — the
+        node half of the fleet telemetry plane (telemetry/aggregate.py).
+        A None/falsy return or a raising fn simply ships a bare beat:
+        liveness must never depend on observability.
 
     A beat that cannot reach the server is logged and *dropped* — the
     next interval retries with a fresh connection.  Missing frames is
@@ -634,7 +708,8 @@ class Heartbeater(object):
     """
 
     def __init__(self, server_addr, executor_id, interval=None,
-                 alive_fn=None, generation_fn=None, host="", chaos_fn=None):
+                 alive_fn=None, generation_fn=None, host="", chaos_fn=None,
+                 metrics_fn=None):
         self.server_addr = tuple(server_addr)
         self.executor_id = int(executor_id)
         self.interval = (
@@ -644,6 +719,7 @@ class Heartbeater(object):
         self.generation_fn = generation_fn
         self.host = host
         self.chaos_fn = chaos_fn
+        self.metrics_fn = metrics_fn
         self.stop_seen = False  # server's stop flag, piggybacked on beats
         #: newest cluster generation seen in a reply — supervisors poll
         #: this to learn a peer was reborn (their cue to park/respawn)
@@ -669,6 +745,12 @@ class Heartbeater(object):
     def _send_beat(self):
         alive = True if self.alive_fn is None else bool(self.alive_fn())
         gen = 0 if self.generation_fn is None else int(self.generation_fn())
+        metrics = None
+        if self.metrics_fn is not None:
+            try:
+                metrics = self.metrics_fn()
+            except Exception:  # noqa: BLE001 - see metrics_fn docstring
+                metrics = None
         if self._client is None:
             self._client = Client(
                 self.server_addr,
@@ -676,7 +758,7 @@ class Heartbeater(object):
             )
         resp = self._client.heartbeat(
             self.executor_id, generation=gen, compute_alive=alive,
-            host=self.host,
+            host=self.host, metrics=metrics,
         )
         if resp.get("stop"):
             self.stop_seen = True

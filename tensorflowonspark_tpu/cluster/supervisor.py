@@ -135,6 +135,7 @@ class Supervisor(object):
             generation_fn=lambda: self.generation,
             host=self.node_meta.get("host", ""),
             chaos_fn=self._chaos_fn,
+            metrics_fn=self._node_metrics,
         )
         try:
             # prime: death-by-silence is measured from "now", and the
@@ -157,6 +158,26 @@ class Supervisor(object):
     @property
     def pid(self):
         return self.proc.pid if self.proc is not None else None
+
+    def _node_metrics(self):
+        """The telemetry snapshot piggybacked on this node's beats: the
+        compute process's registry snapshot (published into the manager
+        kv by its :class:`~tensorflowonspark_tpu.telemetry.aggregate.NodePublisher`)
+        with the supervisor's own restart accounting folded in — so the
+        driver's fleet view carries restarts even for a compute process
+        too dead to publish."""
+        snap = None
+        try:
+            snap = self.mgr.get("metrics")._getvalue()
+        except Exception:  # noqa: BLE001 - manager kv is best effort
+            snap = None
+        if not isinstance(snap, dict):
+            snap = {"counters": {}, "gauges": {}, "histograms": {}}
+        counters = snap.setdefault("counters", {})
+        counters["cluster.restarts"] = self.restarts
+        gauges = snap.setdefault("gauges", {})
+        gauges["cluster.generation"] = self.generation
+        return snap
 
     def _proc_alive(self):
         """What the heartbeat's ``compute_alive`` flag reports.  A
@@ -295,6 +316,13 @@ class Supervisor(object):
             "rebirth %d/%d",
             self.ctx.executor_id, exitcode, self.restarts,
             self.max_restarts,
+        )
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "restart", trace="executor%d" % self.ctx.executor_id,
+            executor_id=self.ctx.executor_id, exitcode=exitcode,
+            restart=self.restarts,
         )
         try:
             client = reservation.Client(self.server_addr)

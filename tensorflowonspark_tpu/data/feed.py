@@ -107,6 +107,16 @@ class DataFeed(object):
         self.wire_bytes = 0
         self.wire_records = 0
         self.wire_rows = 0
+        # fleet telemetry twins of the wire accounting (null
+        # singletons when TFOS_TELEMETRY=0): the same numbers
+        # wire_stats() reports, published into the process registry so
+        # the driver's fleet view carries feed-plane throughput
+        from tensorflowonspark_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_bytes = reg.counter("feed.wire_bytes")
+        self._m_records = reg.counter("feed.wire_records")
+        self._m_rows = reg.counter("feed.wire_rows")
 
     _RING_SENTINEL = object()  # internal: ring produced a block
 
@@ -114,6 +124,9 @@ class DataFeed(object):
         self.wire_bytes += int(nbytes)
         self.wire_records += 1
         self.wire_rows += int(nrows)
+        self._m_bytes.inc(int(nbytes))
+        self._m_records.inc()
+        self._m_rows.inc(int(nrows))
 
     def _account_item(self, item):
         """Wire accounting for a queue-delivered element (Block /

@@ -147,6 +147,16 @@ class ShmRing(object):
                 raise ValueError("segment too small: {0}".format(capacity))
         _INSTANCES.add(self)
         self._next_liveness = 0.0  # next producer probe (monotonic)
+        # fleet telemetry (record granularity — records are whole
+        # blocks, so this is NOT per-row overhead; null no-ops when
+        # TFOS_TELEMETRY=0)
+        from tensorflowonspark_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_push = reg.counter("ring.push_records")
+        self._m_push_bytes = reg.counter("ring.push_bytes")
+        self._m_pop = reg.counter("ring.pop_records")
+        self._m_pop_bytes = reg.counter("ring.pop_bytes")
 
     def _base(self):
         return self._cbase
@@ -224,6 +234,8 @@ class ShmRing(object):
         while True:
             rc = self._lib.shmring_push(base, record, len(record))
             if rc == 0:
+                self._m_push.inc()
+                self._m_push_bytes.inc(len(record))
                 return
             if rc == -2:
                 raise ValueError(
@@ -272,6 +284,8 @@ class ShmRing(object):
                     n,
                 )
                 if rc == 0:
+                    self._m_push.inc()
+                    self._m_push_bytes.inc(total)
                     return
                 if rc == -2:
                     raise ValueError(
@@ -313,6 +327,7 @@ class ShmRing(object):
                 ctypes.byref(need),
             )
             if n == 0:
+                self._m_pop.inc()
                 return b""  # zero-length record
             if n == -2:
                 buf = bytearray(int(need.value))
@@ -328,6 +343,8 @@ class ShmRing(object):
                     raise RuntimeError(
                         "ring record vanished between probe and pop"
                     )
+                self._m_pop.inc()
+                self._m_pop_bytes.inc(len(buf))
                 return buf
             if n == -3:
                 raise RuntimeError("corrupt ring segment")

@@ -1479,6 +1479,7 @@ class SlotDecoder:
         if self._use_prefix:
             first = self._admit_canonical(slot, prompt, n)
         else:
+            self.last_admit_cached_tokens = 0
             b = self.bucket_len(n)
             padded = np.zeros((1, b), np.int32)
             padded[0, b - n:] = prompt
@@ -1531,6 +1532,10 @@ class SlotDecoder:
         # at least one real token must prefill (first-token logits)
         lease = pc.acquire(prompt, limit_tokens=n - 1)
         kpref = lease.n_tokens
+        #: telemetry label: how many prompt tokens this admit served
+        #: from cache (the serving engine marks prefill spans
+        #: prefix_hit with it — docs/observability.md)
+        self.last_admit_cached_tokens = int(kpref)
         if kpref:
             segment = self._assemble_segment(lease.payloads(), blk)
             self.cache = self._install_jit(
@@ -1905,6 +1910,15 @@ def serving_builder(params, config):
         predict.make_slot_decoder = make_slot_decoder
         predict.max_new_tokens = max_new
         predict.eos_id = eos_id
+        if config.get("profile_dir"):
+            # on-demand jax.profiler capture: the serving engine starts
+            # the trace and counts decode chunks as steps
+            # (tensorboard.start_profile — graceful no-op when the
+            # build lacks the profiler)
+            predict.profile = {
+                "dir": str(config["profile_dir"]),
+                "steps": int(config.get("profile_steps", 0)) or None,
+            }
         return predict
     return base.make_serving_predict(
         base.as_variables(params),

@@ -331,6 +331,19 @@ class SyncTrainer(object):
             state = checkpointer.restore(state)
             steps = int(jax.device_get(state.step))
             logger.info("resumed from checkpoint at step %d", steps)
+        # fleet telemetry: the training-step trace (feed_wait → h2d →
+        # dispatch; the PS legs trace inside PSClient/_GradDrain) plus
+        # the step/feed-wait histograms — null-object no-ops when
+        # TFOS_TELEMETRY=0 (docs/observability.md)
+        from tensorflowonspark_tpu import telemetry
+
+        tracer = telemetry.get_tracer()
+        reg = telemetry.get_registry()
+        m_steps = reg.counter("train.steps")
+        m_step_hist = reg.histogram("train.step_sec")
+        m_feed_hist = reg.histogram("train.feed_wait_sec")
+        import time as _time
+
         stop = False
         while not stop:
             if max_steps is not None and steps >= max_steps:
@@ -344,6 +357,7 @@ class SyncTrainer(object):
             # ready host pulled in the failing round is dropped — the
             # same data the reference's '90% of steps' trick dropped).
             group, subs = [], []
+            t_feed0 = _time.perf_counter()
             for _ in range(limit):
                 if columnar:
                     batch, n = feed.next_arrays(batch_size)
@@ -371,17 +385,46 @@ class SyncTrainer(object):
                 subs.append(sub)
             if not group:
                 break
+            feed_wait = _time.perf_counter() - t_feed0
+            m_feed_hist.observe(feed_wait)
+            tracer.add(
+                "feed_wait", t_feed0, feed_wait,
+                trace="step%d" % steps, batches=len(group),
+            )
             if step_callback is not None:
                 step_callback(steps)
+            t_step0 = _time.perf_counter()
+            trace_id = "step%d" % steps
             if len(group) == 1:
-                state, metrics = self.step(state, group[0], subs[0])
+                with tracer.span("h2d", trace=trace_id):
+                    device_batch = sh.shard_batch(
+                        group[0], self.mesh, self.data_axes
+                    )
+                with tracer.span("dispatch", trace=trace_id):
+                    state, metrics = self.step_on_device(
+                        state, device_batch, subs[0]
+                    )
             else:
                 stacked = jax.tree.map(lambda *xs: np.stack(xs), *group)
-                state, metrics = self.multi_step(
-                    state, stacked, jnp.stack(subs)
-                )
+                with tracer.span("h2d", trace=trace_id):
+                    device_stacked = sh.shard_batch(
+                        stacked, self.mesh, self.data_axes, leading_dims=1
+                    )
+                with tracer.span("dispatch", trace=trace_id):
+                    state, metrics = self.multi_step_on_device(
+                        state, device_stacked, jnp.stack(subs)
+                    )
                 metrics = jax.tree.map(lambda m: m[-1], metrics)
+            m_step_hist.observe(
+                (_time.perf_counter() - t_step0) / len(group)
+            )
+            m_steps.inc(len(group))
             steps += len(group)
+            # feed the env-var-driven jax.profiler capture, if one is
+            # live in this process (tensorboard.start_profile)
+            from tensorflowonspark_tpu import tensorboard as _tb
+
+            _tb.profile_step(len(group))
             if metrics_callback is not None:
                 metrics_callback(steps, metrics)
             if (

@@ -51,6 +51,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -582,6 +583,17 @@ class PSClient(object):
         #: wire bytes this client laid on each shard connection
         #: (send-side tunnel accounting; one writer per index)
         self._sent_bytes = [0] * len(self._socks)
+        # fleet telemetry: the wire accounting that used to live only
+        # in this object now also publishes into the process registry,
+        # and push/pull round trips trace as spans (null singletons /
+        # no-op spans when TFOS_TELEMETRY=0 — docs/observability.md)
+        from tensorflowonspark_tpu import telemetry as _telemetry
+
+        _reg = _telemetry.get_registry()
+        self._m_bytes = _reg.counter("ps.bytes_sent")
+        self._m_trips = _reg.counter("ps.round_trips")
+        self._m_rt_hist = _reg.histogram("ps.round_trip_sec")
+        self._tracer = _telemetry.get_tracer()
         # persistent per-shard request workers: a round trip costs two
         # queue handoffs instead of a thread spawn per shard per step
         # (measured: thread creation dominated small-model step time)
@@ -661,10 +673,24 @@ class PSClient(object):
                 return
             header, tensors, box, ev, codec = item
             try:
-                self._sent_bytes[i] += send_msg(
-                    sock, header, tensors, codec=codec
-                )
-                h, t = recv_msg(sock)
+                op = header.get("op", "?")
+                t0 = time.perf_counter()
+                # "push" covers codec encode + the wire send; "pull"
+                # the reply wait + decode — the two halves of the
+                # training-step trace's PS leg
+                with self._tracer.span(
+                    "ps.push", trace="ps", shard=i, op=op
+                ) as sp:
+                    sent = send_msg(sock, header, tensors, codec=codec)
+                    sp.set("bytes", sent)
+                self._sent_bytes[i] += sent
+                self._m_bytes.inc(sent)
+                with self._tracer.span(
+                    "ps.pull", trace="ps", shard=i, op=op
+                ):
+                    h, t = recv_msg(sock)
+                self._m_trips.inc()
+                self._m_rt_hist.observe(time.perf_counter() - t0)
                 if h.get("op") == "error":
                     box[1] = RuntimeError(
                         "ps shard {0}: {1}".format(i, h["error"])
@@ -930,7 +956,18 @@ class _GradDrain(object):
     def _to_host(self, tree):
         import jax
 
-        return jax.device_get(tree)
+        from tensorflowonspark_tpu import telemetry
+
+        # the measured async-PS bottleneck (BENCH_r05) gets its own
+        # span + histogram so the step trace shows where the wall went
+        t0 = time.perf_counter()
+        out = jax.device_get(tree)
+        dur = time.perf_counter() - t0
+        telemetry.get_registry().histogram(
+            "ps.grad_readback_sec"
+        ).observe(dur)
+        telemetry.get_tracer().add("grad_readback", t0, dur, trace="ps")
+        return out
 
     def submit(self, device_grads):
         """Hand a device gradient tree to the drain; blocks only when

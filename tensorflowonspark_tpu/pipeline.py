@@ -541,6 +541,27 @@ class TFModel(TFParams, *_MODEL_MIXINS):
     def transform(self, dataset, num_partitions=None):
         return self._transform(dataset, num_partitions)
 
+    # -- telemetry accessors (ISSUE 7: the pipeline layer's window
+    # into the fleet telemetry plane, docs/observability.md) ----------
+
+    def telemetrySnapshot(self):
+        """This process's metrics-registry snapshot (plain dicts): the
+        serving counters/latency histogram a local transform published.
+        Executor-side transforms publish into THEIR processes — pull
+        those through the cluster plane (``TFCluster.metrics()``) or a
+        ``reservation.Client(addr).get_metrics()``."""
+        from tensorflowonspark_tpu import telemetry
+
+        return telemetry.get_registry().snapshot()
+
+    def traceEvents(self):
+        """This process's recorded spans as Chrome-trace JSON (load in
+        chrome://tracing or Perfetto); same process scope as
+        :meth:`telemetrySnapshot`."""
+        from tensorflowonspark_tpu import telemetry
+
+        return telemetry.get_tracer().export_chrome()
+
     def _transform(self, dataset, num_partitions=None):
         from tensorflowonspark_tpu.engine import Engine, LocalEngine, SparkEngine
 

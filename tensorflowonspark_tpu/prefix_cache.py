@@ -129,6 +129,17 @@ class PrefixCache(object):
         self.tokens_saved = 0  # prompt tokens NOT re-prefilled
         self.evictions = 0     # blocks evicted (budget or pressure)
         self.insert_drops = 0  # inserts dropped: budget full of pins
+        # fleet telemetry twins (null singletons when disabled): same
+        # counts, published into the process registry so the driver's
+        # cluster view sees cache behavior (docs/observability.md)
+        from tensorflowonspark_tpu import telemetry
+
+        reg = telemetry.get_registry()
+        self._m_hits = reg.counter("prefix_cache.hits")
+        self._m_misses = reg.counter("prefix_cache.misses")
+        self._m_tokens_saved = reg.counter("prefix_cache.tokens_saved")
+        self._m_evictions = reg.counter("prefix_cache.evictions")
+        self._m_bytes = reg.gauge("prefix_cache.bytes_used")
 
     # -- lookup / pin ---------------------------------------------------
 
@@ -166,8 +177,11 @@ class PrefixCache(object):
         if nodes:
             self.hits += 1
             self.tokens_saved += matched
+            self._m_hits.inc()
+            self._m_tokens_saved.inc(matched)
         else:
             self.misses += 1
+            self._m_misses.inc()
         return Lease(nodes, matched)
 
     def release(self, lease):
@@ -212,6 +226,7 @@ class PrefixCache(object):
                 self.bytes_used += child.nbytes
                 self.n_nodes += 1
                 inserted += 1
+                self._m_bytes.set(self.bytes_used)
             cur = child
         return inserted
 
@@ -248,6 +263,8 @@ class PrefixCache(object):
         self.bytes_used -= victim.nbytes
         self.n_nodes -= 1
         self.evictions += 1
+        self._m_evictions.inc()
+        self._m_bytes.set(self.bytes_used)
         return True
 
     def evict_cold(self, target_bytes):

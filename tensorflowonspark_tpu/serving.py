@@ -31,19 +31,25 @@ import importlib
 import json
 import logging
 import os
+import time
 
 import numpy as np
 
 from tensorflowonspark_tpu import serving_engine
 # re-exported robustness surface (see serving_engine / docs/serving.md
-# "Robustness & overload")
+# "Robustness & overload") + the shared latency accounting (ISSUE 7:
+# BOTH schedules observe submit→finish into ONE telemetry histogram,
+# so p50/p99 report identical semantics — docs/observability.md)
 from tensorflowonspark_tpu.serving_engine import (  # noqa: F401
+    LATENCY_METRIC,
     RequestError,
     RequestValidationError,
     ServingEngine,
     ServingError,
     WatchdogTimeout,
     error_record,
+    latency_histogram,
+    latency_summary,
 )
 
 logger = logging.getLogger(__name__)
@@ -342,6 +348,15 @@ def predict_rows(
     cols = sorted(input_mapping)
     buf = []  # ("ok", row) | ("rec", error_record) entries, input order
     n_seen = 0
+    # static-schedule latency accounting: a request's latency is
+    # submit (pulled from the source) → its row emitted — the SAME
+    # semantics the continuous engine reports, observed into the
+    # shared histogram (serving_engine.LATENCY_METRIC) and mirrored
+    # into stats["latency_sec"] like the continuous scheduler's
+    lat_hist = latency_histogram()
+    submit_t = {}
+    if stats is not None:
+        stats.setdefault("latency_sec", {})
     # generation predictors declare ragged columns (prompts of varying
     # length) via ``predict.column_padding = {input_name: pad_value}``;
     # those stack left-padded and ship a ``<input>_pad`` count column
@@ -410,24 +425,35 @@ def predict_rows(
         ok_pos = {p: i for i, (p, _) in enumerate(ok)}
         for pos, (tag, payload, _idx) in enumerate(chunk):
             if tag == "rec":
-                yield payload
+                yield _idx, payload
             elif out is not None:
                 i = ok_pos[pos]
-                yield _apply_output_mapping(
+                yield _idx, _apply_output_mapping(
                     {k: v[i] for k, v in out.items()}, output_mapping
                 )
             else:
                 kind, o = per_row[pos]
                 if kind == "rec":
-                    yield o
+                    yield _idx, o
                 else:
-                    yield _apply_output_mapping(
+                    yield _idx, _apply_output_mapping(
                         {k: v[0] for k, v in o.items()}, output_mapping
                     )
+
+    def _emit(flushed):
+        for idx, r in flushed:
+            t_sub = submit_t.pop(idx, None)
+            if t_sub is not None:
+                lat = time.monotonic() - t_sub
+                lat_hist.observe(lat)
+                if stats is not None:
+                    stats["latency_sec"][idx] = lat
+            yield r
 
     for row in rows:
         idx = n_seen
         n_seen += 1
+        submit_t[idx] = time.monotonic()
         try:
             _validate_static_row(row, idx, input_mapping)
             buf.append(("ok", row, idx))
@@ -438,11 +464,11 @@ def predict_rows(
                 "rec", serving_engine.error_record(e.kind, idx, e), idx
             ))
         if len(buf) == batch_size:
-            for r in _flush(buf):
+            for r in _emit(_flush(buf)):
                 yield r
             buf = []
     if buf:
-        for r in _flush(buf):
+        for r in _emit(_flush(buf)):
             yield r
 
 
@@ -641,6 +667,7 @@ def main(argv=None):
     out_path = fs_utils.join(args.output, "part-00000.jsonl")
     count = 0
     sched_stats = {}
+    lat_base = latency_histogram().snapshot()
     with fs_utils.open_file(out_path, "w") as f:
         kwargs = {}
         if args.schedule == "continuous":
@@ -664,14 +691,18 @@ def main(argv=None):
             sched_stats.get("errors", 0),
             sched_stats.get("watchdog_fires", 0),
         )
-    if sched_stats.get("latency_sec"):
-        lat = sorted(sched_stats["latency_sec"].values())
+    # p50/p99 come from the SHARED telemetry histogram, scoped to this
+    # run — identical semantics on both schedules (the old code
+    # computed continuous-only percentiles from a raw list)
+    summ = latency_summary(since=lat_base)
+    if summ["count"]:
         logger.info(
-            "continuous schedule: %d admitted over %d chunks, "
-            "per-request latency p50=%.1fms p99=%.1fms",
-            sched_stats["admitted"], sched_stats["chunks"],
-            1e3 * lat[len(lat) // 2],
-            1e3 * lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+            "%s schedule: %d request(s)%s, per-request latency "
+            "p50=%.1fms p99=%.1fms",
+            args.schedule, summ["count"],
+            " over %d chunks" % sched_stats["chunks"]
+            if sched_stats.get("chunks") else "",
+            summ["p50_ms"], summ["p99_ms"],
         )
     logger.info("wrote %d predictions to %s", count, out_path)
     return count

@@ -59,7 +59,46 @@ import time
 
 import numpy as np
 
+from tensorflowonspark_tpu import telemetry
+
 logger = logging.getLogger(__name__)
+
+#: Shared request-latency histogram name: BOTH schedules (static
+#: predict_rows batches and this engine) observe submit→finish wall
+#: time here, so p50/p99 report identical semantics everywhere
+#: (ISSUE 7 satellite; bench + CLI source their percentiles from it).
+LATENCY_METRIC = "serving.request_latency_sec"
+
+
+def latency_histogram():
+    """The process-wide request-latency histogram (see
+    :data:`LATENCY_METRIC`)."""
+    return telemetry.get_registry().histogram(LATENCY_METRIC)
+
+
+def latency_summary(since=None):
+    """p50/p99/count of the shared request-latency histogram, in ms.
+
+    ``since`` is a prior ``latency_histogram().snapshot()`` — pass it
+    to scope the summary to one job/bench window (the histogram is
+    cumulative across jobs).  Returns zeros when telemetry is disabled
+    or nothing was observed.
+    """
+    snap = latency_histogram().snapshot()
+    if since:
+        snap = telemetry.snapshot_delta(
+            {"histograms": {LATENCY_METRIC: snap}},
+            {"histograms": {LATENCY_METRIC: since}},
+        )["histograms"][LATENCY_METRIC]
+    return {
+        "count": int(snap.get("count", 0)),
+        "p50_ms": round(
+            1e3 * telemetry.histogram_percentile(snap, 50), 3
+        ),
+        "p99_ms": round(
+            1e3 * telemetry.histogram_percentile(snap, 99), 3
+        ),
+    }
 
 #: reserved input name: a row column mapped to it carries that
 #: request's token budget — the scheduler evicts the row after
@@ -341,6 +380,33 @@ class ServingEngine(object):
             "spec_accepted": 0, "spec_proposed": 0, "spec_accept_rate": 0.0,
         })
         self._reuse_base = dict(self._decoder_reuse_stats())
+        # telemetry: metrics resolved ONCE (null singletons when
+        # disabled — the hot path then costs nothing), spans per
+        # request under trace id "req<idx>" (docs/observability.md)
+        reg = telemetry.get_registry()
+        self._tracer = telemetry.get_tracer()
+        self._m_lat = reg.histogram(LATENCY_METRIC)
+        self._m_queue_wait = reg.histogram("serving.queue_wait_sec")
+        self._m = {
+            name: reg.counter("serving." + name)
+            for name in (
+                "admitted", "completed", "errors", "shed", "expired",
+                "degraded", "chunks", "watchdog_fires", "recovered",
+                "prefix_hit_admits",
+            )
+        }
+        # on-demand device profiling: serving_builder config keys
+        # profile_dir/profile_steps ride the predictor; decode chunks
+        # count as steps (tensorboard.start_profile is a graceful
+        # no-op on builds without the profiler)
+        self._profile = None
+        prof = getattr(predict, "profile", None)
+        if prof and prof.get("dir"):
+            from tensorflowonspark_tpu import tensorboard
+
+            self._profile = tensorboard.start_profile(
+                prof["dir"], prof.get("steps")
+            )
         # scheduler state
         self._pending = []      # validated, waiting for a slot
         self._slot_req = {}     # slot -> in-flight request record
@@ -480,11 +546,13 @@ class ServingEngine(object):
             idx = self._n_in
             self._n_in += 1
             try:
-                return self._validate(row, idx)
+                with self._tracer.span("admission", trace="req%d" % idx):
+                    return self._validate(row, idx)
             except RequestValidationError as e:
                 if self.on_error == "raise":
                     raise
                 self.stats["errors"] += 1
+                self._m["errors"].inc()
                 self._record(idx, e.kind, e)
         return None
 
@@ -510,6 +578,12 @@ class ServingEngine(object):
                 if req is None:
                     return
                 self.stats["shed"] += 1
+                self._m["shed"].inc()
+                self._tracer.mark(
+                    "shed", trace="req%d" % req["idx"],
+                    request_index=req["idx"],
+                    queue_depth=self.queue_depth,
+                )
                 self._record(
                     req["idx"], "shed",
                     "request {0} shed: admission queue full "
@@ -531,6 +605,7 @@ class ServingEngine(object):
         for req in self._pending:
             if req["deadline_at"] is not None and now > req["deadline_at"]:
                 self.stats["expired"] += 1
+                self._m["expired"].inc()
                 self._record(
                     req["idx"], "deadline",
                     "request {0} expired after {1:.3f}s waiting for a "
@@ -582,12 +657,31 @@ class ServingEngine(object):
                     if shrunk < req["budget"]:
                         req["budget"] = shrunk
                         self.stats["degraded"] += 1
+                        self._m["degraded"].inc()
             prompt = req.get("resume_prompt", req["prompt"])
+            rid = "req%d" % req["idx"]
+            wait = self._clock() - req["submit"]
+            self._m_queue_wait.observe(wait)
+            if self._tracer.enabled:
+                # queue wait ended the instant this admit pass reached
+                # the request — record the interval just spent waiting
+                self._tracer.add(
+                    "queue_wait", time.perf_counter() - wait, wait,
+                    trace=rid,
+                )
             try:
                 # admit is a single ASYNC dispatch; the first token
                 # comes back as an unsynchronized device scalar,
                 # resolved at the next chunk boundary
-                first = self.decoder.admit(slot, prompt)
+                with self._tracer.span("prefill", trace=rid) as sp:
+                    first = self.decoder.admit(slot, prompt)
+                    cached = int(getattr(
+                        self.decoder, "last_admit_cached_tokens", 0
+                    ))
+                    sp.set("prefix_hit", cached > 0)
+                    if cached:
+                        sp.set("prefix_tokens", cached)
+                        self._m["prefix_hit_admits"].inc()
             except Exception as e:  # noqa: BLE001 - per-request capture
                 if self.on_error == "raise":
                     raise RequestError(
@@ -597,11 +691,13 @@ class ServingEngine(object):
                         kind="admit", request_index=req["idx"],
                     ) from e
                 self.stats["errors"] += 1
+                self._m["errors"].inc()
                 self._record(req["idx"], "admit", e)
                 continue  # the slot stays free for the next request
             committed = req["out"] or []
             req["out"] = list(committed) + [first]
             self.stats["admitted"] += 1
+            self._m["admitted"].inc()
             self.stats["request_wire_bytes"] += int(
                 getattr(prompt, "nbytes", 0)
             )
@@ -620,6 +716,7 @@ class ServingEngine(object):
         decoders normalize to fully-valid rows."""
         idx = self._chunk_index
         self._chunk_index += 1
+        t_chunk0 = time.perf_counter()
         wedge = self._wedge
         wd = self._watchdog
         if wd is None:
@@ -646,6 +743,19 @@ class ServingEngine(object):
                 self._recover()
                 return None
         self.stats["chunks"] += 1
+        self._m["chunks"].inc()
+        if self._profile is not None:
+            self._profile.step()
+        if self._tracer.enabled:
+            # one dispatch serves every in-flight lane: attribute the
+            # SAME interval to each request's trace so a single
+            # request's trace stays connected admission→…→emit
+            dur = time.perf_counter() - t_chunk0
+            for req in self._slot_req.values():
+                self._tracer.add(
+                    "decode_chunk", t_chunk0, dur,
+                    trace="req%d" % req["idx"], chunk=idx,
+                )
         self._update_reuse_stats()
         if isinstance(toks, tuple):
             return toks
@@ -664,6 +774,11 @@ class ServingEngine(object):
         parity tests pin down).  Re-admitted requests go to the FRONT
         of the queue in input order; their deadlines keep running."""
         self.stats["watchdog_fires"] += 1
+        self._m["watchdog_fires"].inc()
+        self._tracer.mark(
+            "watchdog_fire", trace="serve",
+            inflight=len(self._slot_req), chunk=self._chunk_index - 1,
+        )
         inflight = sorted(
             self._slot_req.values(), key=lambda r: r["idx"]
         )
@@ -680,6 +795,11 @@ class ServingEngine(object):
                 ) if committed else req["prompt"]
             )
             self.stats["recovered"] += 1
+            self._m["recovered"].inc()
+            self._tracer.mark(
+                "watchdog_recover", trace="req%d" % req["idx"],
+                request_index=req["idx"], tokens_committed=len(committed),
+            )
         self._pending[:0] = inflight
         self._watchdog = _DispatchWatchdog()
 
@@ -721,12 +841,19 @@ class ServingEngine(object):
         self.stats["completed"] += 1
         self.stats["latency_sec"][req["idx"]] = t_done - req["submit"]
         self.stats["done_at"][req["idx"]] = t_done - self._t0
+        self._m["completed"].inc()
+        self._m_lat.observe(t_done - req["submit"])
 
     def _expire_slot(self, slot, req, now):
         """Cancel an expired in-flight lane between chunks; neighbors
         keep decoding undisturbed and nothing recompiles."""
         committed = [t for t in req["out"] if isinstance(t, int)]
         self.stats["expired"] += 1
+        self._m["expired"].inc()
+        self._tracer.mark(
+            "deadline_cancel", trace="req%d" % req["idx"],
+            request_index=req["idx"], tokens_done=len(committed),
+        )
         self._record(
             req["idx"], "deadline",
             "request {0} cancelled after {1:.3f}s (deadline "
@@ -743,6 +870,7 @@ class ServingEngine(object):
         """Stream completed rows in input order as soon as the head of
         the reorder buffer is ready."""
         while self._emit_next in self._finished:
+            self._tracer.mark("emit", trace="req%d" % self._emit_next)
             yield self._finished.pop(self._emit_next)
             self._emit_next += 1
 
@@ -801,5 +929,7 @@ class ServingEngine(object):
                     yield r
         finally:
             self._update_reuse_stats()
+            if self._profile is not None:
+                self._profile.stop()
             if self._watchdog is not None:
                 self._watchdog.close()

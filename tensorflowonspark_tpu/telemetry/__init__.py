@@ -1,0 +1,54 @@
+"""Fleet telemetry plane: metrics registry, span tracing, aggregation.
+
+Every subsystem used to invent its own counters — ``serving.py`` kept
+p50/p99 in a local list, ``prefix_cache.stats()``, ``PSClient.
+bytes_sent`` and ``DataFeed.wire_stats()`` were four incompatible
+ad-hoc surfaces, and none of it crossed a process boundary to the
+driver.  This package is the one place they all publish now
+(docs/observability.md):
+
+- :mod:`~tensorflowonspark_tpu.telemetry.registry` — a low-overhead
+  process-wide metrics registry (counters, gauges, fixed-bucket
+  histograms with interpolated p50/p99), lock-light, exported as
+  plain dicts (``snapshot`` / ``snapshot_delta``);
+- :mod:`~tensorflowonspark_tpu.telemetry.tracing` — structured span
+  tracing with trace/parent-id propagation, exported as Chrome-trace
+  (Perfetto-loadable) JSON;
+- :mod:`~tensorflowonspark_tpu.telemetry.aggregate` — snapshot
+  merging for the driver's fleet view (counters summed, histograms
+  merged bucket-wise, percentiles recomputed) plus the node-side
+  publisher that ships snapshots over the heartbeat plane to the
+  reservation server, where ``TFCluster.metrics()`` pulls them.
+
+**Zero-cost-when-disabled**: ``TFOS_TELEMETRY=0`` (or
+``set_enabled(False)``) makes every registry accessor return a shared
+null singleton whose ``inc``/``observe`` are no-ops and makes
+``tracer.span(...)`` return a shared null context manager — no
+allocation, no locking, no span storage on the hot path (asserted in
+tests/test_telemetry.py).
+"""
+
+from tensorflowonspark_tpu.telemetry.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    NULL_COUNTER,
+    NULL_GAUGE,
+    NULL_HISTOGRAM,
+    enabled,
+    get_registry,
+    histogram_percentile,
+    set_enabled,
+    snapshot_delta,
+)
+from tensorflowonspark_tpu.telemetry.tracing import (  # noqa: F401
+    Tracer,
+    get_tracer,
+)
+from tensorflowonspark_tpu.telemetry.aggregate import (  # noqa: F401
+    NodePublisher,
+    fleet_view,
+    merge_snapshots,
+    start_node_publisher,
+)

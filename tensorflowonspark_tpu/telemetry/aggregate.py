@@ -1,0 +1,166 @@
+"""Cluster aggregation: node snapshots → one driver-side fleet view.
+
+The wire path (docs/observability.md "Fleet aggregation"):
+
+1. each compute process runs a :class:`NodePublisher` (started by
+   ``cluster.node._compute_process_main``) that periodically writes
+   the default registry's snapshot into the node manager's kv store
+   (``mgr.set("metrics", snap)``);
+2. the node :class:`~tensorflowonspark_tpu.cluster.supervisor.Supervisor`'s
+   heartbeater reads that kv entry and piggybacks it on HEARTBEAT
+   frames, stamped with supervisor-side fields (restarts, generation);
+3. the reservation :class:`~tensorflowonspark_tpu.cluster.reservation.Server`
+   stores the newest snapshot per executor and answers the ``METRICS``
+   wire op with all of them;
+4. ``TFCluster.metrics()`` (or a bare
+   ``reservation.Client.get_metrics()``) pulls the per-executor
+   snapshots and :func:`merge_snapshots` folds them into one fleet
+   view — counters summed, histograms merged bucket-wise with
+   percentiles recomputed, gauges kept per-executor.
+
+Everything on the wire is the plain-dict snapshot format from
+:mod:`~tensorflowonspark_tpu.telemetry.registry` — JSON all the way.
+"""
+
+import logging
+import os
+import threading
+
+from tensorflowonspark_tpu.telemetry import registry as _registry
+
+logger = logging.getLogger(__name__)
+
+#: Seconds between node-side snapshot publications into the manager kv
+#: (env-tunable: TFOS_TELEMETRY_PUBLISH_INTERVAL).
+PUBLISH_INTERVAL = float(
+    os.environ.get("TFOS_TELEMETRY_PUBLISH_INTERVAL", "2.0")
+)
+
+
+def merge_snapshots(snapshots):
+    """Fold per-executor registry snapshots into ONE fleet snapshot.
+
+    Counters sum; histograms merge bucket-wise (the fixed geometric
+    bucket scheme makes this exact) with ``p50``/``p99`` recomputed
+    over the merged counts; gauges take the max (a per-executor gauge
+    summed across the fleet would be meaningless — the per-executor
+    values stay available in the unmerged view).
+    """
+    counters = {}
+    gauges = {}
+    hists = {}  # name -> {"count","sum","buckets": {le: count}, min, max}
+    for snap in snapshots:
+        if not snap:
+            continue
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in (snap.get("gauges") or {}).items():
+            gauges[name] = max(gauges.get(name, v), v)
+        for name, h in (snap.get("histograms") or {}).items():
+            agg = hists.setdefault(
+                name,
+                {"count": 0, "sum": 0.0, "buckets": {},
+                 "min": None, "max": None},
+            )
+            agg["count"] += h.get("count", 0)
+            agg["sum"] += h.get("sum", 0.0)
+            for lo, hi, c in h.get("buckets", []):
+                key = (lo, hi)
+                agg["buckets"][key] = agg["buckets"].get(key, 0) + c
+            for k, pick in (("min", min), ("max", max)):
+                v = h.get(k)
+                if v is not None:
+                    agg[k] = v if agg[k] is None else pick(agg[k], v)
+    merged_h = {}
+    for name, agg in hists.items():
+        triples = sorted(
+            ([lo, hi, c] for (lo, hi), c in agg["buckets"].items()),
+            key=lambda t: t[0],
+        )
+        h = {
+            "count": agg["count"], "sum": round(agg["sum"], 9),
+            "min": agg["min"], "max": agg["max"], "buckets": triples,
+        }
+        h["p50"] = _registry.histogram_percentile(h, 50)
+        h["p99"] = _registry.histogram_percentile(h, 99)
+        if h["count"]:
+            h["mean"] = h["sum"] / h["count"]
+        merged_h[name] = h
+    return {"counters": counters, "gauges": gauges,
+            "histograms": merged_h}
+
+
+def fleet_view(per_executor):
+    """``{executor_id: {"metrics": snapshot, ...liveness fields}}`` →
+    ``{"executors": <input>, "fleet": merged snapshot}`` — the shape
+    ``TFCluster.metrics()`` returns."""
+    return {
+        "executors": per_executor,
+        "fleet": merge_snapshots(
+            rec.get("metrics") for rec in per_executor.values()
+        ),
+    }
+
+
+class NodePublisher(object):
+    """Background thread shipping the default registry's snapshot into
+    the node manager kv every ``interval`` seconds (step 1 of the
+    module-docstring pipeline).  Publication is best-effort: a manager
+    hiccup is logged once and retried next interval — telemetry must
+    never take a node down."""
+
+    KV_KEY = "metrics"
+
+    def __init__(self, mgr, interval=None, registry=None):
+        self.mgr = mgr
+        self.interval = PUBLISH_INTERVAL if interval is None else float(
+            interval
+        )
+        self.registry = registry
+        self._stop = threading.Event()
+        self._warned = False
+        self._thread = None
+
+    def _snapshot(self):
+        reg = self.registry or _registry.get_registry()
+        return reg.snapshot()
+
+    def publish_once(self):
+        """One synchronous publication (also called at loop exit so the
+        final state of a finished compute process is visible)."""
+        try:
+            self.mgr.set(self.KV_KEY, self._snapshot())
+            return True
+        except Exception as e:  # noqa: BLE001 - observability best effort
+            if not self._warned:
+                self._warned = True
+                logger.warning(
+                    "telemetry publication to the node manager failed "
+                    "(%s); will keep retrying quietly", e,
+                )
+            return False
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self.publish_once()
+        self.publish_once()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="telemetry-publisher"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+
+
+def start_node_publisher(mgr, interval=None):
+    """Start a :class:`NodePublisher` when telemetry is enabled;
+    returns it (or None when disabled — zero threads, zero cost)."""
+    if not _registry.get_registry().enabled:
+        return None
+    return NodePublisher(mgr, interval=interval).start()

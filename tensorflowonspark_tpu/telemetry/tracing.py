@@ -1,0 +1,231 @@
+"""Structured span tracing with id propagation + Chrome-trace export.
+
+A *span* is one timed region with a name, a trace id (the request /
+step it belongs to), a span id, and the enclosing span's id — enough
+to reconstruct the tree.  Two recording styles:
+
+- ``with tracer.span("prefill", trace="req3", prefix_hit=True): ...``
+  — context-managed, parent id propagated through a thread-local
+  stack;
+- ``tracer.add("decode_chunk", t0, dur, trace="req3", chunk=2)`` —
+  post-hoc, for hot loops that time once and attribute the SAME
+  interval to several traces (the serving engine labels one chunk
+  dispatch onto every in-flight request's trace this way).
+
+Export is Chrome-trace JSON (``{"traceEvents": [...]}``) loadable in
+``chrome://tracing`` / Perfetto; ``ts``/``dur`` are microseconds since
+the tracer's epoch.  On-demand *device* traces (XLA timelines) are the
+:mod:`tensorflowonspark_tpu.tensorboard` profiler hook's job — this
+module covers the host-side scheduling story those traces lack.
+
+Disabled mode (``TFOS_TELEMETRY=0`` or ``set_enabled(False)``):
+``span()`` returns a shared null context manager and ``add`` is a
+no-op — nothing allocates, nothing is retained.
+"""
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+
+from tensorflowonspark_tpu.telemetry import registry as _registry
+
+#: Bounded span store per tracer: keeps the newest spans, drops the
+#: oldest — a serving process must never grow without bound.
+MAX_SPANS = int(os.environ.get("TFOS_TRACE_MAX_SPANS", "20000"))
+
+
+class _NullSpan(object):
+    """Shared no-op context manager for disabled tracing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, key, value):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx(object):
+    """Live span context: records on ``__exit__``."""
+
+    __slots__ = ("_tracer", "name", "trace", "attrs", "_t0", "_id",
+                 "_parent")
+
+    def __init__(self, tracer, name, trace, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.trace = trace
+        self.attrs = attrs
+
+    def set(self, key, value):
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+        return self
+
+    def __enter__(self):
+        tr = self._tracer
+        stack = tr._stack()
+        if self.trace is None and stack:
+            self.trace = stack[-1][0]
+        self._parent = stack[-1][1] if stack else None
+        self._id = next(tr._ids)
+        stack.append((self.trace, self._id))
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        tr = self._tracer
+        stack = tr._stack()
+        if stack:
+            stack.pop()
+        tr._record(
+            self.name, self.trace, self._id, self._parent,
+            self._t0, dur, self.attrs,
+        )
+        return False
+
+
+class Tracer(object):
+    """Bounded in-process span store (see module docstring)."""
+
+    def __init__(self, enabled=None, max_spans=None):
+        self._enabled = (
+            _registry._env_enabled() if enabled is None else bool(enabled)
+        )
+        self._spans = collections.deque(
+            maxlen=max_spans if max_spans else MAX_SPANS
+        )
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        #: perf_counter at construction — span timestamps are relative
+        #: to this epoch (Chrome-trace ``ts`` microseconds)
+        self.epoch = time.perf_counter()
+
+    # -- enable/disable -------------------------------------------------
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def set_enabled(self, flag):
+        self._enabled = bool(flag)
+
+    # -- recording ------------------------------------------------------
+
+    def _stack(self):
+        s = getattr(self._local, "stack", None)
+        if s is None:
+            s = self._local.stack = []
+        return s
+
+    def span(self, name, trace=None, **attrs):
+        """Context manager timing a region.  ``trace`` names the
+        request/step the span belongs to (inherited from the enclosing
+        span when omitted); extra kwargs become span attributes."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _SpanCtx(self, name, trace, attrs or None)
+
+    def add(self, name, t0, dur, trace=None, **attrs):
+        """Record an already-measured interval (``t0`` from
+        ``time.perf_counter()``)."""
+        if not self._enabled:
+            return
+        self._record(
+            name, trace, next(self._ids), None, t0, dur, attrs or None
+        )
+
+    def mark(self, name, trace=None, **attrs):
+        """Record an instantaneous event (zero-duration span) — shed /
+        watchdog / restart markers the chaos tests assert on."""
+        if not self._enabled:
+            return
+        self._record(
+            name, trace, next(self._ids), None, time.perf_counter(),
+            0.0, attrs or None,
+        )
+
+    def _record(self, name, trace, span_id, parent, t0, dur, attrs):
+        rec = {
+            "name": name,
+            "trace": trace,
+            "id": span_id,
+            "t0": t0 - self.epoch,
+            "dur": dur,
+            "tid": threading.get_ident(),
+        }
+        if parent is not None:
+            rec["parent"] = parent
+        if attrs:
+            rec["attrs"] = attrs
+        self._spans.append(rec)
+
+    # -- introspection / export -----------------------------------------
+
+    def spans(self, name=None, trace=None):
+        """Snapshot of recorded spans, optionally filtered."""
+        out = list(self._spans)
+        if name is not None:
+            out = [s for s in out if s["name"] == name]
+        if trace is not None:
+            out = [s for s in out if s.get("trace") == trace]
+        return out
+
+    def clear(self):
+        self._spans.clear()
+
+    def export_chrome(self):
+        """Chrome-trace / Perfetto JSON object.  Spans map to complete
+        ('X') events; the trace id rides ``args.trace`` and the span
+        tree rides ``args.parent``."""
+        pid = os.getpid()
+        events = []
+        for s in list(self._spans):
+            args = dict(s.get("attrs") or {})
+            if s.get("trace") is not None:
+                args["trace"] = s["trace"]
+            if s.get("parent") is not None:
+                args["parent"] = s["parent"]
+            events.append({
+                "name": s["name"],
+                "ph": "X",
+                "ts": round(s["t0"] * 1e6, 3),
+                "dur": round(s["dur"] * 1e6, 3),
+                "pid": pid,
+                "tid": s["tid"],
+                "args": args,
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path):
+        """Write the Chrome-trace JSON; returns ``path``."""
+        with open(path, "w") as f:
+            json.dump(self.export_chrome(), f)
+        return path
+
+
+_GLOBAL = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_tracer():
+    """The process-wide default tracer (same enable story as the
+    default registry)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                _GLOBAL = Tracer()
+    return _GLOBAL

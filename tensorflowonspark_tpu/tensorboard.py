@@ -68,3 +68,110 @@ def start_profiler_server(port=9999):
     jax.profiler.start_server(port)
     logger.info("jax profiler server on port %d", port)
     return port
+
+
+# ----------------------------------------------------------------------
+# on-demand jax.profiler capture (ISSUE 7 satellite: the finished hook)
+# ----------------------------------------------------------------------
+
+#: Env hooks: set on the driver before ``run()`` (executor/compute
+#: processes inherit the environment) to capture a device trace from
+#: every compute process into ``$TFOS_PROFILE_DIR/<pid>``.
+PROFILE_DIR_ENV = "TFOS_PROFILE_DIR"
+PROFILE_STEPS_ENV = "TFOS_PROFILE_STEPS"
+
+
+#: The process's live capture (at most one — jax.profiler is global);
+#: ``profile_step`` feeds it from training loops without plumbing the
+#: session handle through every layer.
+_ACTIVE_SESSION = None
+
+
+def profile_step(n=1):
+    """Count ``n`` work units against the active capture (no-op when
+    none is live) — ``dp.train_on_feed`` calls this per executed
+    group, the serving engine per decode chunk."""
+    sess = _ACTIVE_SESSION
+    if sess is not None:
+        sess.step(n)
+
+
+class ProfileSession(object):
+    """One live ``jax.profiler`` trace.  ``step(n)`` counts work units
+    (train steps / decode chunks); once ``num_steps`` have passed the
+    trace stops itself.  ``stop()`` is idempotent and safe to call
+    from ``finally`` blocks."""
+
+    def __init__(self, log_dir, num_steps=None):
+        self.log_dir = log_dir
+        self.remaining = None if num_steps is None else int(num_steps)
+        self._active = True
+
+    def step(self, n=1):
+        """Count ``n`` completed work units; stops the trace when the
+        budget runs out.  Returns True while the trace is live."""
+        if not self._active:
+            return False
+        if self.remaining is not None:
+            self.remaining -= int(n)
+            if self.remaining <= 0:
+                self.stop()
+        return self._active
+
+    def stop(self):
+        global _ACTIVE_SESSION
+        if not self._active:
+            return
+        self._active = False
+        if _ACTIVE_SESSION is self:
+            _ACTIVE_SESSION = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            logger.info("jax profiler trace written to %s", self.log_dir)
+        except Exception as e:  # noqa: BLE001 - capture is best effort
+            logger.warning("stopping jax profiler trace failed: %s", e)
+
+
+def start_profile(log_dir, num_steps=None):
+    """Start a ``jax.profiler`` device trace into ``log_dir``; returns
+    a :class:`ProfileSession` (or None when the build lacks a working
+    profiler — a graceful no-op, the run proceeds unprofiled).
+
+    Reachable from three places (docs/observability.md "Profiler
+    capture"): directly; from ``cluster.run(...)`` via the
+    ``TFOS_PROFILE_DIR`` / ``TFOS_PROFILE_STEPS`` environment
+    (inherited by every compute process, each writing to its own
+    ``<log_dir>/<pid>`` subdirectory); and from
+    ``transformer.serving_builder`` config keys ``profile_dir`` /
+    ``profile_steps`` (the serving engine counts decode chunks as
+    steps).
+    """
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+    except Exception as e:  # noqa: BLE001 - unsupported build / double
+        logger.warning(  # start: profiling is never worth a crash
+            "jax profiler unavailable (%s); continuing unprofiled", e
+        )
+        return None
+    logger.info(
+        "jax profiler trace started into %s%s", log_dir,
+        "" if num_steps is None else " (%d steps)" % num_steps,
+    )
+    global _ACTIVE_SESSION
+    _ACTIVE_SESSION = ProfileSession(log_dir, num_steps)
+    return _ACTIVE_SESSION
+
+
+def maybe_start_profile_from_env():
+    """Start a capture when ``TFOS_PROFILE_DIR`` is set (compute
+    processes call this at startup); returns the session or None."""
+    log_dir = os.environ.get(PROFILE_DIR_ENV)
+    if not log_dir:
+        return None
+    steps = os.environ.get(PROFILE_STEPS_ENV)
+    sub = os.path.join(log_dir, str(os.getpid()))
+    return start_profile(sub, int(steps) if steps else None)

@@ -110,6 +110,15 @@ def _full_record():
                         "uint8_vs_float32_rows": 3.34},
         "dataplane": {"batches": 48, "sync_wall_sec": 1.62,
                       "overlap_wall_sec": 1.21, "overlap_gain": 1.34},
+        "telemetry_overhead": {
+            "train_steps": 160,
+            "train_steps_s_instrumented": 114.2,
+            "train_steps_s_disabled": 115.6,
+            "overhead_pct": 1.21,
+            "serving_rows_s_instrumented": 610.4,
+            "serving_rows_s_disabled": 618.0,
+            "serving_overhead_pct": 1.24,
+        },
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
                          "async_compressed_steps_per_sec": 61.7,
                          "async_compressed_wire_kb_per_step": 812.4,
@@ -147,6 +156,7 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["feed_wire_mb_per_step"] == 0.0512  # narrowed wire
     assert parsed["serving_u8_vs_f32"] == 3.34
     assert parsed["decode_overlap_gain"] == 1.34
+    assert parsed["telemetry_overhead_pct"] == 1.21
     assert parsed["wall_sec"] == 741.2
 
 
@@ -161,7 +171,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "serving_prefix_gain", "spec_accept_rate",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "feed_wire_mb_per_step", "serving_u8_vs_f32",
-        "decode_overlap_gain", "wall_sec", "full_record",
+        "decode_overlap_gain", "telemetry_overhead_pct", "wall_sec",
+        "full_record",
     ])
 
 
@@ -189,7 +200,13 @@ def test_full_record_lands_in_file(tmp_path):
     line = bench.emit_record(record, full_path=path)
     assert json.loads(line)["full_record"] == path
     with open(path) as f:
-        assert json.load(f) == record
+        landed = json.load(f)
+    # emit_record attaches the final metrics-registry snapshot to the
+    # FULL record (ISSUE 7 satellite) — never to the summary line
+    snap = landed.pop("telemetry")
+    assert set(snap) == {"counters", "gauges", "histograms"}
+    assert landed == record
+    assert "telemetry" not in json.loads(line)
 
 
 def test_partial_record_summarizes_to_nones(tmp_path):
