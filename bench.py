@@ -32,6 +32,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 #: wall-clock budget for the default record (``python bench.py``).  The
@@ -1158,6 +1159,142 @@ def serving_overload_bench(rows_n=32, slots=4, max_new=24, chunk=8,
             "wall_sec": round(wall, 3),
         }
     return out
+
+
+def serving_hotswap_bench(rows_n=24, slots=4, max_new=16, chunk=4,
+                          swap_after=4):
+    """Live weight hot-swap row (ISSUE 8 robustness): a mid-job
+    checkpoint swap under continuous load (docs/serving.md "Live
+    weight swap & rollback").
+
+    Workload: ``rows_n`` requests stream through the continuous
+    engine; after ``swap_after`` completions a NEW checkpoint
+    generation is published into the watched export root, validated
+    (manifest/shape/dtype + canary), and hot-swapped between decode
+    chunks.  Reported:
+
+    - ``swap_latency_ms``: the swap transaction's wall time (quiesce
+      + install + post-install canary) — decode is paused for exactly
+      this window;
+    - ``swap_dropped``: requests dropped across the swap — the
+      zero-downtime contract says this MUST be 0 (in-flight requests
+      are requeued from their committed tokens, new admissions queue
+      behind the bounded admission plane);
+    - ``goodput_dip_pct``: end-to-end goodput of the swap run vs an
+      identical no-swap baseline — what the lifecycle costs a steady
+      workload (small model: measures the scheduler+ingest plane,
+      not the chip).
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import checkpoint as ckpt
+    from tensorflowonspark_tpu import hot_swap, serving
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    cfg = dict(
+        vocab_size=512, num_layers=2, num_heads=2, head_dim=16,
+        embed_dim=32, mlp_dim=64, max_seq_len=160, dtype="float32",
+    )
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+
+    def _params(seed):
+        return jax.tree.map(np.asarray, jax.jit(
+            lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+        )(jax.random.PRNGKey(seed)))
+
+    params_a, params_b = _params(0), _params(1)
+    predict = tr.serving_builder(
+        params_a,
+        dict(cfg, mode="generate", max_new_tokens=max_new,
+             pad_multiple=32, chunk_size=chunk, max_prompt_len=64),
+    )
+    rng = np.random.RandomState(0)
+    # varied budgets stagger completions, so the swap lands with
+    # requests genuinely in flight (the requeue path, not just a
+    # quiet boundary)
+    rows = [
+        {
+            "prompt": rng.randint(
+                0, cfg["vocab_size"], (n,)
+            ).astype(np.int32),
+            "max_new": int(b),
+        }
+        for n, b in zip(
+            rng.randint(8, 49, size=rows_n),
+            rng.randint(4, max_new + 1, size=rows_n),
+        )
+    ]
+    mapping = {"prompt": "tokens", "max_new": "max_new"}
+
+    # warm prefill buckets + the chunk program (and the canary jit)
+    list(serving.predict_rows(
+        predict, [dict(r) for r in rows[:slots]], mapping,
+        batch_size=slots, schedule="continuous",
+    ))
+    predict.make_slot_decoder(slots).canary_check()
+
+    # no-swap baseline on generation A
+    t0 = time.perf_counter()
+    base = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], mapping, batch_size=slots,
+        schedule="continuous",
+    ))
+    base_wall = time.perf_counter() - t0
+    assert len(base) == rows_n
+
+    # publish + ingest OFF the measured serving window (production
+    # runs the watcher's ingest on a background thread; a sync
+    # in-window publish would bill the TRAINER's orbax save to the
+    # serving plane) — ingest cost is reported separately
+    with tempfile.TemporaryDirectory() as root:
+        step_dir = ckpt.publish_for_serving(root, 1, params_b)
+        t_ing = time.perf_counter()
+        wset = hot_swap.validate_checkpoint(
+            step_dir, 1, expect=ckpt.param_manifest(params_a)
+        )
+        ingest_ms = 1e3 * (time.perf_counter() - t_ing)
+        from tensorflowonspark_tpu import serving_engine
+
+        stats = {}
+        eng = serving_engine.ServingEngine(
+            predict, mapping, num_slots=slots, stats=stats,
+            rollback_window=4,
+        )
+        t0 = time.perf_counter()
+        out = []
+        for r in eng.serve([dict(r) for r in rows]):
+            out.append(r)
+            if len(out) == swap_after:
+                eng.request_swap(wset.params, step=wset.step)
+        wall = time.perf_counter() - t0
+        # restore generation A on the memoized decoder so a bench
+        # retry sees the same starting state
+        predict.make_slot_decoder(slots).swap_weights(params_a)
+
+    dropped = rows_n - len(out)
+    errors = sum(1 for r in out if "error" in r)
+    lat = stats.get("swap_latency_sec") or []
+    base_goodput = rows_n / base_wall
+    goodput = len(out) / wall if wall else 0.0
+    return {
+        "rows": rows_n, "slots": slots, "chunk_size": chunk,
+        "max_new_tokens": max_new,
+        "swaps": stats.get("swaps", 0),
+        "ingest_ms": round(ingest_ms, 2),
+        "swap_latency_ms": round(1e3 * lat[0], 2) if lat else None,
+        "swap_dropped": dropped + errors,
+        "swap_requeued": stats.get("swap_requeued", 0),
+        "weight_generation": stats.get("weight_generation", 0),
+        "goodput_rows_s": round(goodput, 2),
+        "baseline_rows_s": round(base_goodput, 2),
+        "goodput_dip_pct": round(
+            max(0.0, 100.0 * (1.0 - goodput / base_goodput)), 1
+        ) if base_goodput else None,
+        "platform": __import__("jax").devices()[0].platform,
+    }
 
 
 class _ListFeed(object):
@@ -2478,6 +2615,15 @@ def bench_summary(record):
         "serving_overload_goodput": _pluck(
             record, "serving_overload", "reject", "goodput_rows_s"
         ),
+        # serving lifecycle (docs/serving.md "Live weight swap &
+        # rollback"): mid-job checkpoint swap cost + the zero-drop
+        # contract (swap_dropped MUST report 0)
+        "swap_latency_ms": _pluck(
+            record, "serving_hotswap", "swap_latency_ms"
+        ),
+        "swap_dropped": _pluck(
+            record, "serving_hotswap", "swap_dropped"
+        ),
         # cross-request reuse plane (docs/serving.md "Prefix cache &
         # speculative decoding")
         "serving_prefix_gain": _pluck(
@@ -2606,6 +2752,9 @@ def main(model_name="resnet50", with_feed=True):
             # overload behavior per admission policy (tiny model —
             # measures the scheduler, not the chip)
             ("serving_overload", serving_overload_bench, 60),
+            # live weight hot-swap under load: swap latency, dropped
+            # requests (must be 0), goodput dip vs a no-swap baseline
+            ("serving_hotswap", serving_hotswap_bench, 60),
             # cross-request KV reuse: radix prefix cache at 0%/80%
             # shared workloads + draft-model speculative decode
             ("serving_prefix", serving_prefix_bench, 90),
@@ -2666,6 +2815,8 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(serving_generate_bench)))
     elif "serving_overload" in sys.argv:
         print(json.dumps(with_retry(serving_overload_bench)))
+    elif "serving_hotswap" in sys.argv:
+        print(json.dumps(with_retry(serving_hotswap_bench)))
     elif "serving_prefix" in sys.argv:
         print(json.dumps(with_retry(serving_prefix_bench)))
     elif "serving_speculative" in sys.argv:

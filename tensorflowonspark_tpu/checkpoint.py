@@ -18,15 +18,35 @@ API surface kept deliberately small:
   directory (local or any fsspec-reachable store);
 - :func:`save_for_serving` / :func:`load_for_serving` — params-only
   export, the SavedModel-role analogue consumed by the serving path
-  (reference analogue: TFNode.export_saved_model, TFNode.py:159-208).
+  (reference analogue: TFNode.export_saved_model, TFNode.py:159-208);
+- :func:`publish_for_serving` / :func:`list_serving_steps` — the
+  step-numbered serving-export layout the live hot-swap plane polls
+  (:mod:`tensorflowonspark_tpu.hot_swap`): each step is one atomic
+  export directory under a common root.
+
+Serving exports are ATOMIC: everything is written into a hidden temp
+directory first, the :data:`MANIFEST_NAME` file (step, per-leaf
+shape/dtype census, ``complete: true``) is written LAST, and one
+``os.replace`` makes the export visible.  A reader polling the root
+mid-save therefore sees either the old step set or the complete new
+step — never a torn one (tests/test_checkpoint.py pins this down,
+and the hot-swap watcher additionally refuses any directory whose
+manifest is missing or incomplete).
 """
 
+import json
 import logging
 import os
+import shutil
 
 import jax
 
 logger = logging.getLogger(__name__)
+
+#: Completion marker + shape/dtype census of a serving export; written
+#: LAST inside the temp directory, so its presence implies the params
+#: finished writing even on stores where the rename is not atomic.
+MANIFEST_NAME = "manifest.json"
 
 
 class Checkpointer(object):
@@ -129,12 +149,79 @@ def _abstractify(x):
 # ----------------------------------------------------------------------
 
 
+def param_manifest(params):
+    """Per-leaf ``{path: {"shape": [...], "dtype": str}}`` census of a
+    param pytree — what the hot-swap validation plane compares an
+    ingested checkpoint against the live model's expectation
+    (:mod:`tensorflowonspark_tpu.hot_swap`).  Quantized
+    :class:`~tensorflowonspark_tpu.quantize.QTensor` leaves are
+    censused at their ORIGINAL float shape (``q``'s shape), since the
+    published training checkpoints they validate against are raw."""
+    from tensorflowonspark_tpu import quantize as qz
+
+    flat = jax.tree_util.tree_flatten_with_path(
+        params, is_leaf=lambda x: isinstance(x, qz.QTensor)
+    )[0]
+    out = {}
+    for path, leaf in flat:
+        if isinstance(leaf, qz.QTensor):
+            leaf = leaf.q
+        out[jax.tree_util.keystr(path)] = {
+            "shape": [int(s) for s in getattr(leaf, "shape", ())],
+            "dtype": str(getattr(leaf, "dtype", type(leaf).__name__)),
+        }
+    return out
+
+
+def write_manifest(directory, step=None, params=None, extra=None):
+    """Write the serving-export completion manifest (see
+    :data:`MANIFEST_NAME`).  Call LAST: the manifest's presence is the
+    reader-side signal that every other file finished writing."""
+    manifest = {"complete": True}
+    if step is not None:
+        manifest["step"] = int(step)
+    if params is not None:
+        manifest["params"] = param_manifest(params)
+    if extra:
+        manifest.update(extra)
+    path = os.path.join(os.fspath(directory), MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return manifest
+
+
+def read_manifest(directory):
+    """The export's manifest dict, or None when absent or unparseable
+    — either way the directory is not (yet) a complete export.  The
+    hot-swap watcher separately quarantines a PRESENT-but-garbage
+    manifest with a typed reason (see
+    :mod:`tensorflowonspark_tpu.hot_swap`)."""
+    path = os.path.join(os.fspath(directory), MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def save_for_serving(directory, params, extra_metadata=None,
-                     output_schema=None):
+                     output_schema=None, step=None):
     """Export inference params (+ JSON metadata) — the role the
     reference filled with SavedModel export (TFNode.py:159-208,
     compat.py:10-17: chief exports, workers write to a dummy dir; here
     non-zero processes simply skip).
+
+    The export is ATOMIC: params + metadata land in a hidden
+    ``.tmp-<pid>`` sibling, the completion manifest
+    (:data:`MANIFEST_NAME` — ``complete: true`` + the per-leaf
+    shape/dtype census) is written last, and a single ``os.replace``
+    publishes the directory.  A reader polling mid-save never
+    observes a partially-written export (the hot-swap watcher's
+    contract, tests/test_checkpoint.py).
 
     ``output_schema`` — an interchange field list
     (``[(name, type), ...]``) or struct string — lands in the export's
@@ -145,8 +232,6 @@ def save_for_serving(directory, params, extra_metadata=None,
     generation exports).  Derive it from a live predictor with
     :func:`tensorflowonspark_tpu.serving.infer_output_schema`.
     """
-    import json
-
     import numpy as np
     import orbax.checkpoint as ocp
 
@@ -162,8 +247,14 @@ def save_for_serving(directory, params, extra_metadata=None,
         # and avoids the dummy-dir dance the reference needed
         params = jax.tree.map(lambda x: x, params)
     directory = os.path.abspath(os.fspath(directory))
+    parent = os.path.dirname(directory)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
+    staging = "{0}.tmp-{1}".format(directory, os.getpid())
+    if os.path.isdir(staging):
+        shutil.rmtree(staging)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(directory, "params"), params, force=True)
+    ckptr.save(os.path.join(staging, "params"), params, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
     if jax.process_index() == 0:
@@ -173,10 +264,67 @@ def save_for_serving(directory, params, extra_metadata=None,
                 output_schema if isinstance(output_schema, str)
                 else [list(f) for f in output_schema]
             )
-        with open(os.path.join(directory, "metadata.json"), "w") as f:
+        with open(os.path.join(staging, "metadata.json"), "w") as f:
             json.dump(meta, f)
+        # manifest LAST: its presence implies everything else landed
+        write_manifest(staging, step=step, params=params)
+    # publish: os.replace is atomic on POSIX but refuses a non-empty
+    # target, so an existing export moves aside first (the one
+    # non-atomic window replaces a COMPLETE old export with a COMPLETE
+    # new one — both sides carry a valid manifest)
+    old = None
+    if os.path.isdir(directory):
+        old = "{0}.old-{1}".format(directory, os.getpid())
+        if os.path.isdir(old):
+            shutil.rmtree(old)
+        os.replace(directory, old)
+    os.replace(staging, directory)
+    if old is not None:
+        shutil.rmtree(old, ignore_errors=True)
     logger.info("serving export written to %s", directory)
     return directory
+
+
+def publish_for_serving(root, step, params, extra_metadata=None,
+                        output_schema=None):
+    """Publish a STEP-NUMBERED serving export under ``root`` — the
+    layout the live hot-swap plane polls (:class:`tensorflowonspark_
+    tpu.hot_swap.CheckpointWatcher`): ``root/<step>/`` holding a
+    complete :func:`save_for_serving` export whose manifest carries
+    the step number.  Atomic end to end (temp dir + rename, manifest
+    last), so the watcher can NEVER observe a torn step.  Returns the
+    published step directory."""
+    root = os.path.abspath(os.fspath(root))
+    os.makedirs(root, exist_ok=True)
+    step_dir = os.path.join(root, str(int(step)))
+    return save_for_serving(
+        step_dir, params, extra_metadata=extra_metadata,
+        output_schema=output_schema, step=int(step),
+    )
+
+
+def list_serving_steps(root):
+    """Sorted step numbers of the COMPLETE serving exports under
+    ``root`` — directories named by an integer whose manifest parses
+    and declares ``complete: true``.  Torn/temp/foreign directories
+    are skipped silently (an in-progress publish is invisible by
+    design); quarantine decisions on complete-but-corrupt steps
+    belong to the hot-swap watcher, not this listing."""
+    root = os.path.abspath(os.fspath(root))
+    steps = []
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    for name in names:
+        try:
+            step = int(name)
+        except ValueError:
+            continue
+        manifest = read_manifest(os.path.join(root, name))
+        if manifest and manifest.get("complete"):
+            steps.append(step)
+    return sorted(steps)
 
 
 def load_for_serving(directory):

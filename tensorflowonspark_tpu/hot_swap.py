@@ -1,0 +1,445 @@
+"""Zero-downtime serving lifecycle: validated live weight hot-swap.
+
+Production fleets never stop to redeploy, but until this module the
+ServingEngine served one frozen weight set for its whole life —
+pushing a new checkpoint meant tearing the engine down and dropping
+every in-flight request (ROADMAP item 5).  This is the serving-side
+counterpart of PR 1's training auto-resume, in the spirit of
+TF-Replicator's "researchers never restart the fleet" contract and
+the reference's long-lived-cluster model: the fleet stays up, the
+weights move.
+
+The plane has three parts (docs/serving.md "Live weight swap &
+rollback"):
+
+- **publish** — training publishes step-numbered serving exports with
+  :func:`~tensorflowonspark_tpu.checkpoint.publish_for_serving`
+  (atomic: temp dir + rename, manifest written last), so a poller can
+  never observe a torn checkpoint;
+- **watch + validate** — :class:`CheckpointWatcher` polls the root
+  for new steps and walks each candidate through the validation
+  stages below; a checkpoint that fails ANY stage is **quarantined**
+  with a typed reason (a ``quarantine.json`` marker in the step
+  directory — it is never offered again, and serving continues on
+  the old generation):
+
+  1. *manifest* — present, parseable, ``complete: true``
+     (``bad_manifest`` / ``incomplete``);
+  2. *load* — the orbax restore itself; truncated/corrupt array
+     files surface here (``load_failed``);
+  3. *tree/shape/dtype* — the loaded tree against the live model's
+     :meth:`~tensorflowonspark_tpu.models.transformer.SlotDecoder.
+     param_spec` census: structure (``tree_mismatch``), per-leaf
+     shapes (``shape_mismatch``), dtype KIND (``dtype_mismatch`` —
+     exact dtype is not required, ingest re-casts/re-quantizes);
+  4. *canary* — one forward pass off the hot path
+     (``canary_failed``), when the watcher carries a ``canary_fn``.
+
+  Ingest (the orbax load + validation) runs on the watcher's
+  background thread by default, so a slow store never stalls decode
+  (the ``slow_ingest`` chaos fault pins this down);
+- **swap** — the ServingEngine drains admissions for the length of
+  the swap transaction, quiesces in-flight requests through the PR 4
+  watchdog teardown/re-admit path (reused for PLANNED swaps, not
+  just wedges — committed tokens are preserved exactly), installs
+  the new generation via :meth:`SlotDecoder.swap_weights` (int8
+  re-quantization on ingest, prefix cache flushed, no recompiles —
+  avals are identical by construction), then runs a post-install
+  canary.  The previous generation stays **resident** (params are
+  never donated through the jitted programs) until the new one
+  serves ``rollback_window`` clean requests; a post-swap canary
+  failure or an error spike during that probation flips back
+  automatically and quarantines the offending step.
+
+Every transition is telemetry (docs/observability.md): spans
+``swap_ingest``/``swap``; marks ``checkpoint_quarantined``,
+``swap_requeue``, ``swap_apply``, ``swap_commit``, ``swap_rollback``;
+counters ``serving.swaps`` / ``serving.swap_commits`` /
+``serving.swap_rollbacks`` / ``serving.checkpoints_quarantined``; and
+the ``serving.weight_generation`` gauge.
+"""
+
+import logging
+import os
+import threading
+import time
+
+from tensorflowonspark_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+#: Typed quarantine marker written into a rejected step directory —
+#: its presence keeps the watcher from ever re-offering the step.
+QUARANTINE_NAME = "quarantine.json"
+
+#: validation failure kinds, in stage order (module docstring)
+VALIDATION_KINDS = (
+    "bad_manifest", "incomplete", "load_failed", "tree_mismatch",
+    "shape_mismatch", "dtype_mismatch", "canary_failed",
+)
+
+
+class SwapError(Exception):
+    """Base for hot-swap plane failures."""
+
+
+class CheckpointRejected(SwapError):
+    """A checkpoint failed validation.  Carries the typed ``kind``
+    (one of :data:`VALIDATION_KINDS`) and the step it belongs to —
+    the same pair the quarantine marker records."""
+
+    def __init__(self, message, kind, step=None):
+        super(CheckpointRejected, self).__init__(message)
+        self.kind = str(kind)
+        self.step = step
+
+
+class WeightSet(object):
+    """A validated, ready-to-swap weight generation: the raw flagship
+    ``params`` (plus the optional ``draft`` sibling a speculative
+    export ships), the publishing ``step``, and its directory."""
+
+    def __init__(self, step, path, params, draft_params=None,
+                 metadata=None):
+        self.step = int(step)
+        self.path = path
+        self.params = params
+        self.draft_params = draft_params
+        self.metadata = metadata or {}
+
+    def __repr__(self):
+        return "WeightSet(step={0}, path={1!r})".format(
+            self.step, self.path
+        )
+
+
+# ----------------------------------------------------------------------
+# quarantine markers
+# ----------------------------------------------------------------------
+
+
+def quarantine(step_dir, kind, message):
+    """Write the typed quarantine marker into ``step_dir`` (the
+    checkpoint's bytes are kept for the operator's post-mortem — the
+    marker only makes the step invisible to every future poll)."""
+    import json
+
+    rec = {"kind": str(kind), "message": str(message)}
+    try:
+        with open(os.path.join(step_dir, QUARANTINE_NAME), "w") as f:
+            json.dump(rec, f)
+    except OSError:
+        # an unwritable store still quarantines in-session via the
+        # watcher's memory; the marker is belt-and-braces persistence
+        logger.warning("could not persist quarantine marker in %s",
+                       step_dir, exc_info=True)
+    return rec
+
+
+def read_quarantine(step_dir):
+    """The step's quarantine record, or None."""
+    import json
+
+    try:
+        with open(os.path.join(step_dir, QUARANTINE_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+# ----------------------------------------------------------------------
+# validation
+# ----------------------------------------------------------------------
+
+
+def _dtype_kind(dtype_str):
+    import numpy as np
+
+    try:
+        return np.dtype(dtype_str).kind
+    except TypeError:
+        return "?"
+
+
+def check_tree(expect, got_manifest):
+    """Compare an ingested checkpoint's param census against the live
+    model's ``expect`` spec; raises :class:`CheckpointRejected` with
+    the stage-appropriate kind, naming the first offending leaf."""
+    missing = sorted(set(expect) - set(got_manifest))
+    extra = sorted(set(got_manifest) - set(expect))
+    if missing or extra:
+        raise CheckpointRejected(
+            "param tree mismatch vs live model: missing {0}, "
+            "unexpected {1}".format(missing[:4], extra[:4]),
+            kind="tree_mismatch",
+        )
+    for path in sorted(expect):
+        if got_manifest[path]["shape"] != expect[path]["shape"]:
+            raise CheckpointRejected(
+                "shape mismatch at {0}: live {1} vs checkpoint "
+                "{2}".format(path, expect[path]["shape"],
+                             got_manifest[path]["shape"]),
+                kind="shape_mismatch",
+            )
+        if (_dtype_kind(got_manifest[path]["dtype"])
+                != _dtype_kind(expect[path]["dtype"])):
+            raise CheckpointRejected(
+                "dtype kind mismatch at {0}: live {1} vs checkpoint "
+                "{2} (exact dtype may differ — ingest re-casts; the "
+                "KIND must match)".format(
+                    path, expect[path]["dtype"],
+                    got_manifest[path]["dtype"],
+                ),
+                kind="dtype_mismatch",
+            )
+
+
+def validate_checkpoint(step_dir, step, expect=None, canary_fn=None):
+    """Run the full validation pipeline over one step directory and
+    return its :class:`WeightSet`; raises :class:`CheckpointRejected`
+    (typed) at the first failing stage.  Stage order matters: a torn
+    manifest must never reach the loader, and a mis-shaped tree must
+    never reach the canary (whose jitted forward would retrace)."""
+    from tensorflowonspark_tpu import checkpoint as ckpt
+
+    mpath = os.path.join(step_dir, ckpt.MANIFEST_NAME)
+    if not os.path.exists(mpath):
+        raise CheckpointRejected(
+            "step {0}: manifest missing — a torn or foreign "
+            "directory (atomic publishes always carry one)".format(step),
+            kind="bad_manifest", step=step,
+        )
+    manifest = ckpt.read_manifest(step_dir)
+    if manifest is None:
+        raise CheckpointRejected(
+            "step {0}: manifest present but unparseable".format(step),
+            kind="bad_manifest", step=step,
+        )
+    if not manifest.get("complete"):
+        raise CheckpointRejected(
+            "step {0}: manifest lacks complete=true (writer died "
+            "mid-save?)".format(step),
+            kind="incomplete", step=step,
+        )
+    try:
+        params, meta = ckpt.load_for_serving(step_dir)
+    except Exception as e:  # noqa: BLE001 - corrupt stores throw anything
+        raise CheckpointRejected(
+            "step {0}: checkpoint failed to load (corrupt/truncated "
+            "array files?): {1}".format(step, e),
+            kind="load_failed", step=step,
+        )
+    draft = None
+    if isinstance(params, dict) and "draft" in params:
+        params = dict(params)
+        draft = params.pop("draft")
+    if expect is not None:
+        try:
+            check_tree(expect, ckpt.param_manifest(params))
+        except CheckpointRejected as e:
+            e.step = step
+            raise
+    if canary_fn is not None:
+        try:
+            ok = canary_fn(params)
+        except Exception as e:  # noqa: BLE001 - canary faults are typed
+            raise CheckpointRejected(
+                "step {0}: canary raised: {1}".format(step, e),
+                kind="canary_failed", step=step,
+            )
+        if ok is False:
+            raise CheckpointRejected(
+                "step {0}: canary forward pass failed (non-finite "
+                "logits or explicit False)".format(step),
+                kind="canary_failed", step=step,
+            )
+    return WeightSet(step, step_dir, params, draft_params=draft,
+                     metadata=meta)
+
+
+# ----------------------------------------------------------------------
+# the watcher
+# ----------------------------------------------------------------------
+
+
+class CheckpointWatcher(object):
+    """Poll a step-numbered serving-export root for new weight
+    generations, validating each candidate before it can ever serve.
+
+    Args:
+      root: directory of :func:`~tensorflowonspark_tpu.checkpoint.
+        publish_for_serving` step exports.
+      poll_interval: seconds between directory scans.
+      expect: live param census (:meth:`SlotDecoder.param_spec`) the
+        tree/shape/dtype stage checks against; the ServingEngine
+        binds it automatically when the watcher arrives unbound.
+      canary_fn: optional ``fn(params) -> bool`` run as the LAST
+        validation stage, off the hot path (in the ingest thread);
+        raise or return False to quarantine with ``canary_failed``.
+        Independent of the engine's post-install canary.
+      background: ingest (orbax load + validation) on a daemon
+        thread (default), so a slow store never stalls the decode
+        loop; ``False`` ingests synchronously inside :meth:`poll`
+        (deterministic — what the unit tests use).
+      start_step: only steps STRICTLY greater are ever offered
+        (default: offer anything present — a freshly started engine
+        adopts the newest published weights via its first poll).
+      clock: monotonic clock override (tests).
+      ingest_delay: seconds to sleep at the top of every ingest;
+        defaults to the chaos plan's ``slow_ingest`` order (None
+        without a plan — zero overhead).
+    """
+
+    def __init__(self, root, *, poll_interval=5.0, expect=None,
+                 canary_fn=None, background=True, start_step=None,
+                 clock=None, ingest_delay=None):
+        self.root = os.path.abspath(os.fspath(root))
+        self.poll_interval = float(poll_interval)
+        self.expect = expect
+        self.canary_fn = canary_fn
+        self._clock = clock if clock is not None else time.monotonic
+        if ingest_delay is None:
+            from tensorflowonspark_tpu.testing import chaos
+
+            ingest_delay = chaos.ingest_delay()
+        self._ingest_delay = ingest_delay
+        self._floor = -1 if start_step is None else int(start_step)
+        self._lock = threading.Lock()
+        self._ready = None
+        self._last_scan = None
+        self._quarantined = {}  # step -> record (session memory)
+        self.quarantined = []   # ordered records for callers/tests
+        self.stats = {"scans": 0, "ingested": 0, "quarantined": 0,
+                      "offered": 0}
+        self._tracer = telemetry.get_tracer()
+        self._m_quar = telemetry.get_registry().counter(
+            "serving.checkpoints_quarantined"
+        )
+        self._stop = threading.Event()
+        self._thread = None
+        if background:
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="ckpt-watcher"
+            )
+            self._thread.start()
+
+    # -- scanning ------------------------------------------------------
+
+    def _candidates(self):
+        """Step numbers visible under root, newest first, excluding
+        quarantined steps and anything at/below the floor.  A step
+        directory is a candidate as soon as it EXISTS — manifest
+        validation decides completeness (torn dirs quarantine with a
+        typed reason; in-progress atomic publishes are invisible by
+        construction)."""
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        steps = []
+        for name in names:
+            try:
+                step = int(name)
+            except ValueError:
+                continue
+            if step <= self._floor or step in self._quarantined:
+                continue
+            if read_quarantine(os.path.join(self.root, name)):
+                self._quarantined[step] = True
+                continue
+            steps.append(step)
+        return sorted(steps, reverse=True)
+
+    def _ingest(self, step):
+        step_dir = os.path.join(self.root, str(step))
+        with self._tracer.span("swap_ingest", trace="swap", step=step):
+            if self._ingest_delay:
+                time.sleep(float(self._ingest_delay))
+            try:
+                w = validate_checkpoint(
+                    step_dir, step, expect=self.expect,
+                    canary_fn=self.canary_fn,
+                )
+            except CheckpointRejected as e:
+                self._record_quarantine(step, step_dir, e.kind, e)
+                return None
+        self.stats["ingested"] += 1
+        return w
+
+    def _record_quarantine(self, step, step_dir, kind, message):
+        rec = quarantine(step_dir, kind, message)
+        rec["step"] = step
+        self._quarantined[step] = rec
+        self.quarantined.append(rec)
+        self.stats["quarantined"] += 1
+        self._m_quar.inc()
+        self._tracer.mark(
+            "checkpoint_quarantined", trace="swap", step=step,
+            kind=kind,
+        )
+        logger.warning(
+            "hot-swap: quarantined checkpoint step %s (%s): %s",
+            step, kind, message,
+        )
+
+    def _scan_once(self):
+        """One scan-and-ingest pass: validate candidates newest-first
+        until one passes (older torn steps still get their typed
+        quarantine instead of lingering)."""
+        self.stats["scans"] += 1
+        for step in self._candidates():
+            w = self._ingest(step)
+            if w is not None:
+                with self._lock:
+                    # latest wins: an untaken older set is superseded
+                    self._ready = w
+                    self._floor = max(self._floor, w.step)
+                return w
+        return None
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self._scan_once()
+            except Exception:  # noqa: BLE001 - the watcher must survive
+                logger.warning("checkpoint watcher scan failed",
+                               exc_info=True)
+
+    # -- the engine-facing surface -------------------------------------
+
+    def poll(self):
+        """The newest validated :class:`WeightSet` not yet taken, or
+        None.  Never blocks on ingest in background mode; in
+        synchronous mode a scan runs inline at most every
+        ``poll_interval`` seconds."""
+        if self._thread is None:
+            now = self._clock()
+            if (self._last_scan is None
+                    or now - self._last_scan >= self.poll_interval):
+                self._last_scan = now
+                self._scan_once()
+        with self._lock:
+            w, self._ready = self._ready, None
+        if w is not None:
+            self._floor = max(self._floor, w.step)
+            self.stats["offered"] += 1
+        return w
+
+    def quarantine_step(self, weightset, kind, message):
+        """Engine-side quarantine: a step that passed validation but
+        failed AFTER install (post-swap canary, probation error
+        spike) must never be offered again."""
+        self._record_quarantine(
+            weightset.step, weightset.path, kind, message
+        )
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

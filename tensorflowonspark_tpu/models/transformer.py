@@ -1051,6 +1051,14 @@ class SlotDecoder:
                                barrier=False)
             if self._quantized else self._qparams
         )
+        # live-swap plane (hot_swap.py / docs/serving.md "Live weight
+        # swap & rollback"): params are deliberately NOT donated
+        # through the jitted programs (only cache/state are), so the
+        # previous generation's buffers stay resident for rollback;
+        # each install bumps this tag and the serving engine exports
+        # it as the serving.weight_generation gauge
+        self.weight_generation = 0
+        self._canary_jit = None
         self.cache = init_cache(model, self.num_slots,
                                 cache_len=self._bank_len)
         if self._spec:
@@ -1603,6 +1611,136 @@ class SlotDecoder:
         programs AND its device cache allocation."""
         self.state = self._idle_state()
         self.active[:] = False
+
+    # -- live weight swap (hot_swap.py) --------------------------------
+
+    def param_spec(self):
+        """Per-leaf ``{path: {"shape", "dtype"}}`` census of the RAW
+        ingest contract — what a published checkpoint must look like
+        to swap into this decoder (shapes exact; the hot-swap
+        validation plane treats dtype as kind-compatible, since
+        :meth:`swap_weights` casts to the live dtype / re-quantizes).
+        Quantized decoders census at the original float shapes."""
+        from tensorflowonspark_tpu.checkpoint import param_manifest
+
+        return param_manifest(self._params)
+
+    def _check_swap_tree(self, raw_params):
+        """Raise ``ValueError`` naming the first structural/shape
+        incompatibility of ``raw_params`` vs the live weights — a
+        mismatched tree silently retraces the jitted programs, so it
+        must never reach the install."""
+        from tensorflowonspark_tpu.checkpoint import param_manifest
+
+        live = self.param_spec()
+        new = param_manifest(raw_params)
+        missing = sorted(set(live) - set(new))
+        extra = sorted(set(new) - set(live))
+        if missing or extra:
+            raise ValueError(
+                "swap params tree mismatch: missing leaves {0}, "
+                "unexpected leaves {1}".format(missing[:4], extra[:4])
+            )
+        for path in sorted(live):
+            if new[path]["shape"] != live[path]["shape"]:
+                raise ValueError(
+                    "swap params shape mismatch at {0}: live {1} vs "
+                    "ingested {2}".format(
+                        path, live[path]["shape"], new[path]["shape"]
+                    )
+                )
+
+    def _ingest_params(self, raw_params):
+        """Raw float checkpoint tree -> the ``(qparams, params)`` pair
+        the compiled programs consume: re-quantized on ingest for
+        quantized deployments, cast to the live dtype otherwise —
+        always aval-identical to the previous generation, so the swap
+        hits the SAME compiled programs (census-tested)."""
+        qz = self._qz
+        if self._quantized:
+            qparams = qz.quantize_tree(jax.tree.map(jnp.asarray,
+                                                    raw_params))
+            params = qz.dequantize_tree(
+                qparams, self.model.cfg.jdtype, barrier=False
+            )
+            return qparams, params
+        params = jax.tree.map(
+            lambda new, old: jnp.asarray(new, old.dtype),
+            raw_params, self._params,
+        )
+        return params, params
+
+    def snapshot_weights(self):
+        """Opaque handle to the CURRENT weight generation (device
+        buffers stay resident — params are never donated).  Hand it
+        back to :meth:`restore_weights` to roll a swap back without
+        re-ingesting."""
+        return (self._qparams, self._params, self._dparams,
+                self.weight_generation)
+
+    def swap_weights(self, raw_params, draft_params=None):
+        """Install a new weight generation between decode chunks.
+
+        ``raw_params`` is a raw (float) checkpoint tree matching
+        :meth:`param_spec`; it is re-quantized on ingest when this
+        decoder serves int8 weights.  The slot table is NOT touched —
+        the serving engine quiesces in-flight requests first (the
+        watchdog teardown/re-admit path, reused for planned swaps).
+        The attached prefix cache is flushed (its KV blocks were
+        computed by the old weights — serving them under the new
+        generation would be silent corruption).  Avals are identical
+        by construction, so no compiled program retraces."""
+        self._check_swap_tree(raw_params)
+        self._qparams, self._params = self._ingest_params(raw_params)
+        if self._spec and draft_params is not None:
+            dparams = jax.tree.map(jnp.asarray, draft_params)
+            if self._qz.is_quantized(dparams):
+                dparams = self._qz.dequantize_tree(
+                    dparams, self.draft_model.cfg.jdtype, barrier=False
+                )
+            self._dparams = dparams
+        if self._use_prefix:
+            self.prefix_cache.clear()
+        self.weight_generation += 1
+        return self.weight_generation
+
+    def restore_weights(self, snapshot):
+        """Roll back to a :meth:`snapshot_weights` generation (no
+        re-ingest, no requantization — the old buffers were kept
+        resident).  Flushes the prefix cache like a forward swap."""
+        self._qparams, self._params, self._dparams, gen = snapshot
+        if self._use_prefix:
+            self.prefix_cache.clear()
+        self.weight_generation = int(gen)
+        return self.weight_generation
+
+    def canary_check(self, raw_params=None):
+        """ONE forward pass through the model (a fixed 8-token prompt)
+        with ``raw_params`` (default: the live weights), returning
+        True when every logit is finite.  Compiled separately from
+        the decode programs, so the first call never perturbs the
+        serving census; the hot-swap plane runs it as the last
+        validation stage — in the watcher's ingest thread (off the
+        hot path) and/or right after a swap installs, where a failure
+        triggers automatic rollback."""
+        if self._canary_jit is None:
+            self._canary_jit = jax.jit(
+                lambda p, t: self.model.apply({"params": p}, t)
+            )
+        if raw_params is None:
+            params = self._params
+        else:
+            # validate + ingest exactly as a swap would, so the canary
+            # exercises the same (re-quantized, live-dtype) weights
+            # that would serve
+            self._check_swap_tree(raw_params)
+            params = self._ingest_params(raw_params)[1]
+        tokens = (
+            jnp.arange(8, dtype=jnp.int32)[None, :]
+            % self.model.cfg.vocab_size
+        )
+        logits = self._canary_jit(params, tokens)
+        return bool(jnp.isfinite(jnp.asarray(logits)).all())
 
     def dispatch_chunk(self):
         """Dispatch one compiled decode chunk over every slot WITHOUT

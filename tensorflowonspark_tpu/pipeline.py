@@ -139,6 +139,18 @@ HasSchedule = _mixin(
     "inference batching schedule: 'static' | 'continuous'",
     "static",
 )
+# serving lifecycle (docs/serving.md "Live weight swap & rollback"):
+# a step-numbered serving-export root (checkpoint.publish_for_serving
+# layout) each executor's continuous engine watches during the
+# transform — newly published checkpoints are validated (manifest/
+# shape/dtype + canary; corrupt ones quarantined with a typed reason)
+# and hot-swapped between decode chunks with zero dropped requests
+HasCheckpointDir = _mixin(
+    "checkpoint_dir",
+    "step-numbered serving-export root to watch for validated live "
+    "weight hot-swaps during continuous-schedule transforms",
+    cap="CheckpointDir",
+)
 # deployment-time model_config overrides laid over the export metadata
 # before the predictor builds (serving.load_predictor config_overrides)
 # — the pipeline surface for the cross-request reuse knobs:
@@ -231,6 +243,7 @@ _ESTIMATOR_MIXINS = (
 
 _MODEL_MIXINS = (
     HasBatchSize,
+    HasCheckpointDir,
     HasExportDir,
     HasInputMapping,
     HasModelConfig,
@@ -478,6 +491,11 @@ def _run_model_iter(rows, args, predictor_builder=None):
         # partition — when transforming to a typed DataFrame, include
         # an "error" column in the output schema to surface them
         on_error=getattr(args, "on_error", None) or "raise",
+        # setCheckpointDir: each executor's continuous engine watches
+        # this publish_for_serving root and hot-swaps validated new
+        # weight generations mid-transform (zero dropped requests;
+        # docs/serving.md "Live weight swap & rollback")
+        checkpoint_dir=getattr(args, "checkpoint_dir", None) or None,
     )
 
 

@@ -273,6 +273,9 @@ def predict_rows(
     policy="block",
     watchdog_timeout=None,
     default_deadline=None,
+    checkpoint_dir=None,
+    watcher=None,
+    rollback_window=8,
 ):
     """Run ``predict`` over dict-rows; yields output dict-rows.
 
@@ -317,6 +320,15 @@ def predict_rows(
         :class:`~tensorflowonspark_tpu.serving_engine.ServingEngine`
         (bounded admission queue with ``block | reject | degrade``
         shedding, per-request deadlines, and the decode watchdog).
+      checkpoint_dir / watcher / rollback_window: continuous-only
+        LIFECYCLE knobs (docs/serving.md "Live weight swap &
+        rollback"): a step-numbered export root (``publish_for_
+        serving`` layout) or a pre-built
+        :class:`~tensorflowonspark_tpu.hot_swap.CheckpointWatcher`
+        arms validated live weight hot-swap between decode chunks —
+        zero dropped requests, previous weights resident until
+        ``rollback_window`` clean requests, automatic rollback on
+        canary failure or a post-swap error spike.
     """
     if schedule not in ("static", "continuous"):
         raise ValueError(
@@ -334,16 +346,20 @@ def predict_rows(
             stats, on_error=on_error, queue_depth=queue_depth,
             policy=policy, watchdog_timeout=watchdog_timeout,
             default_deadline=default_deadline,
+            checkpoint_dir=checkpoint_dir, watcher=watcher,
+            rollback_window=rollback_window,
         ):
             yield r
         return
     if (policy != "block" or queue_depth is not None
             or watchdog_timeout is not None
-            or default_deadline is not None):
+            or default_deadline is not None
+            or checkpoint_dir is not None or watcher is not None):
         raise ValueError(
-            "queue_depth/policy/watchdog_timeout/default_deadline are "
-            "continuous-schedule knobs; the static schedule has no "
-            "admission queue (see docs/serving.md)"
+            "queue_depth/policy/watchdog_timeout/default_deadline/"
+            "checkpoint_dir/watcher are continuous-schedule knobs; "
+            "the static schedule has no admission queue or swap plane "
+            "(see docs/serving.md)"
         )
     cols = sorted(input_mapping)
     buf = []  # ("ok", row) | ("rec", error_record) entries, input order
@@ -513,7 +529,8 @@ def _predict_rows_continuous(predict, rows, input_mapping,
                              output_mapping, num_slots, stats,
                              on_error="raise", queue_depth=None,
                              policy="block", watchdog_timeout=None,
-                             default_deadline=None):
+                             default_deadline=None, checkpoint_dir=None,
+                             watcher=None, rollback_window=8):
     """Continuous in-flight batching over a generation predictor.
 
     The scheduling loop lives in
@@ -536,7 +553,8 @@ def _predict_rows_continuous(predict, rows, input_mapping,
         queue_depth=queue_depth, policy=policy,
         default_deadline=default_deadline,
         watchdog_timeout=watchdog_timeout, on_error=on_error,
-        stats=stats,
+        stats=stats, checkpoint_dir=checkpoint_dir, watcher=watcher,
+        rollback_window=rollback_window,
     )
     for r in engine.serve(rows):
         yield r
@@ -646,6 +664,17 @@ def main(argv=None):
                    help="default per-request deadline in seconds "
                         "(expired requests return a typed record "
                         "with their partial tokens)")
+    p.add_argument("--checkpoint_dir", default=None,
+                   help="step-numbered serving-export root "
+                        "(publish_for_serving layout) to watch for "
+                        "live weight hot-swaps during the job "
+                        "(continuous schedule only)")
+    p.add_argument("--checkpoint_poll", type=float, default=5.0,
+                   help="seconds between checkpoint_dir scans")
+    p.add_argument("--rollback_window", type=int, default=8,
+                   help="clean requests a swapped-in generation must "
+                        "serve before the previous weights are "
+                        "released (automatic rollback inside it)")
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu.data import interchange
@@ -675,7 +704,15 @@ def main(argv=None):
                 queue_depth=args.queue_depth, policy=args.policy,
                 watchdog_timeout=args.watchdog_timeout,
                 default_deadline=args.deadline,
+                rollback_window=args.rollback_window,
             )
+            if args.checkpoint_dir:
+                from tensorflowonspark_tpu import hot_swap
+
+                kwargs["watcher"] = hot_swap.CheckpointWatcher(
+                    args.checkpoint_dir,
+                    poll_interval=args.checkpoint_poll,
+                )
         for out_row in predict_rows(
             predict, rows, input_mapping, output_mapping,
             args.batch_size, schedule=args.schedule, stats=sched_stats,
@@ -690,6 +727,17 @@ def main(argv=None):
             "%d watchdog fire(s)", shed,
             sched_stats.get("errors", 0),
             sched_stats.get("watchdog_fires", 0),
+        )
+    if sched_stats.get("swaps") or sched_stats.get("rollbacks"):
+        logger.info(
+            "lifecycle: %d weight swap(s) (%d committed, %d rolled "
+            "back), %d in-flight request(s) requeued across swaps, "
+            "serving generation %d",
+            sched_stats.get("swaps", 0),
+            sched_stats.get("swap_commits", 0),
+            sched_stats.get("rollbacks", 0),
+            sched_stats.get("swap_requeued", 0),
+            sched_stats.get("weight_generation", 0),
         )
     # p50/p99 come from the SHARED telemetry histogram, scoped to this
     # run — identical semantics on both schedules (the old code

@@ -37,7 +37,18 @@ counterpart:
   from its already-committed tokens.  The committed prefix is
   preserved and (greedy) recovered outputs are token-identical for
   unaffected requests, because the re-admitted prompt+prefix prefill
-  recreates exactly the context the lost decode step saw.
+  recreates exactly the context the lost decode step saw;
+- **serving lifecycle** (ISSUE 8 / :mod:`tensorflowonspark_tpu.
+  hot_swap`) — a :class:`~tensorflowonspark_tpu.hot_swap.
+  CheckpointWatcher` (``watcher=`` / ``checkpoint_dir=``) hot-swaps
+  validated new weight generations in between decode chunks with
+  zero dropped requests: in-flight requests quiesce through the SAME
+  teardown/re-admit path the watchdog uses (planned swaps, not just
+  wedges), the previous weights stay resident until
+  ``rollback_window`` clean requests commit the swap, and a
+  post-install canary failure or probation error spike rolls back
+  automatically.  :meth:`ServingEngine.drain` reuses the admission
+  gate for graceful shutdown.
 
 Every shed/expired/poisoned request is *accounted*: it occupies its
 input-order position in the output stream as a typed record (see
@@ -155,7 +166,9 @@ def error_record(kind, request_index, message, tokens_done=0,
     ``bad_shape`` / ``empty_prompt`` / ``too_long`` / ``bad_budget``
     / ``bad_deadline`` (validation), ``admit`` / ``predict``
     (per-request capture), ``shed`` (admission control), ``deadline``
-    (expiry — carries the committed ``partial`` tokens).
+    (expiry — carries the committed ``partial`` tokens), ``drained``
+    (a graceful :meth:`ServingEngine.drain` stopped admissions or
+    deadline-cancelled the lane — carries committed tokens too).
     """
     rec = {
         "kind": str(kind),
@@ -273,13 +286,31 @@ class ServingEngine(object):
       stats: optional dict filled with scheduling counters (see
         :meth:`serve`).
       clock: monotonic clock override (tests).
+      watcher: a :class:`~tensorflowonspark_tpu.hot_swap.
+        CheckpointWatcher` — newly published checkpoints it validates
+        hot-swap in between decode chunks with zero dropped requests
+        (docs/serving.md "Live weight swap & rollback").
+      checkpoint_dir: convenience — builds a watcher over this
+        step-numbered export root (``publish_for_serving`` layout);
+        the engine then owns (and closes) it.
+      checkpoint_poll_sec: watcher poll interval for
+        ``checkpoint_dir``.
+      rollback_window: clean completed requests the new generation
+        must serve before the previous weights are released; a
+        device-side error or watchdog fire inside the window rolls
+        back automatically.
+      swap_canary: run the decoder's single-forward canary right
+        after a swap installs; a failure rolls back on the spot and
+        quarantines the checkpoint.
     """
 
     def __init__(self, predict, input_mapping, output_mapping=None,
                  num_slots=8, *, chunk=None, queue_depth=None,
                  policy="block", degrade_floor=1, default_deadline=None,
                  watchdog_timeout=None, on_error="raise", wedge_fn=None,
-                 stats=None, clock=None):
+                 stats=None, clock=None, watcher=None,
+                 checkpoint_dir=None, checkpoint_poll_sec=5.0,
+                 rollback_window=8, swap_canary=True):
         if policy not in POLICIES:
             raise ValueError(
                 "policy must be one of {0}, got {1!r}".format(
@@ -359,6 +390,41 @@ class ServingEngine(object):
             _DispatchWatchdog() if self.watchdog_timeout is not None
             else None
         )
+        # live weight hot-swap plane (hot_swap.py / docs/serving.md
+        # "Live weight swap & rollback")
+        self.rollback_window = max(1, int(rollback_window))
+        self.swap_canary = bool(swap_canary)
+        self._own_watcher = False
+        if watcher is None and checkpoint_dir:
+            from tensorflowonspark_tpu import hot_swap
+
+            watcher = hot_swap.CheckpointWatcher(
+                checkpoint_dir, poll_interval=float(checkpoint_poll_sec)
+            )
+            self._own_watcher = True
+        self.watcher = watcher
+        if self.watcher is not None:
+            if not callable(getattr(self.decoder, "swap_weights", None)):
+                if self._own_watcher:
+                    self.watcher.close()
+                raise ValueError(
+                    "live weight hot-swap needs a decoder exposing "
+                    "swap_weights/snapshot_weights (transformer."
+                    "serving_builder generation decoders do); this "
+                    "predictor's decoder has none"
+                )
+            # bind the live param census so the watcher's validation
+            # stage can reject mis-shaped checkpoints off the hot path
+            if (getattr(self.watcher, "expect", None) is None
+                    and callable(getattr(self.decoder, "param_spec",
+                                         None))):
+                self.watcher.expect = self.decoder.param_spec()
+        self._swap_request = None
+        self._prev_weights = None    # (snapshot, WeightSet) in probation
+        self._probation_clean = 0
+        self._probation_errors = 0
+        self._draining = False
+        self._drain_deadline_at = None
         self.stats = stats if stats is not None else {}
         self.stats.update({
             "latency_sec": {}, "done_at": {}, "admitted": 0,
@@ -378,6 +444,22 @@ class ServingEngine(object):
             "prefix_hits": 0, "prefix_tokens_saved": 0, "evictions": 0,
             "pressure_evictions": 0,
             "spec_accepted": 0, "spec_proposed": 0, "spec_accept_rate": 0.0,
+            # serving lifecycle (docs/serving.md "Live weight swap &
+            # rollback"): applied swaps / committed (survived the
+            # probation window) / automatic rollbacks / in-flight
+            # requests requeued across swaps / per-swap transaction
+            # wall times / requests drained by drain(), and the live
+            # weight generation tag
+            "swaps": 0, "swap_commits": 0, "rollbacks": 0,
+            "swap_requeued": 0, "swap_latency_sec": [], "drained": 0,
+            # per-transition audit trail: {"event": "swap"|"rollback",
+            # "step": ..., "requeued": {request idx: committed tokens
+            # at the transition}} — what the swap-under-load e2e uses
+            # to assert committed prefixes survive token-identically
+            "swap_events": [],
+            "weight_generation": int(getattr(
+                self.decoder, "weight_generation", 0
+            )),
         })
         self._reuse_base = dict(self._decoder_reuse_stats())
         # telemetry: metrics resolved ONCE (null singletons when
@@ -392,9 +474,12 @@ class ServingEngine(object):
             for name in (
                 "admitted", "completed", "errors", "shed", "expired",
                 "degraded", "chunks", "watchdog_fires", "recovered",
-                "prefix_hit_admits",
+                "prefix_hit_admits", "swaps", "swap_commits",
+                "swap_rollbacks", "drained",
             )
         }
+        self._m_gen = reg.gauge("serving.weight_generation")
+        self._m_gen.set(self.stats["weight_generation"])
         # on-demand device profiling: serving_builder config keys
         # profile_dir/profile_steps ride the predictor; decode chunks
         # count as steps (tensorboard.start_profile is a graceful
@@ -565,8 +650,9 @@ class ServingEngine(object):
         eagerly (every available request has *arrived*): ``reject``
         keeps ``queue_depth`` waiting and sheds the rest as typed
         records; ``degrade`` accepts everything and lets admission
-        shrink budgets against the backlog."""
-        if self.policy == "block":
+        shrink budgets against the backlog.  A draining engine pulls
+        nothing — admissions stopped."""
+        if self.policy == "block" or self._draining:
             return
         # a free slot is admission capacity too: the refill runs just
         # before _admit_free, so counting only queue_depth would shed
@@ -606,6 +692,10 @@ class ServingEngine(object):
             if req["deadline_at"] is not None and now > req["deadline_at"]:
                 self.stats["expired"] += 1
                 self._m["expired"].inc()
+                # a watchdog/swap-requeued request may already carry
+                # committed tokens — the record keeps them
+                committed = [t for t in (req["out"] or [])
+                             if isinstance(t, int)]
                 self._record(
                     req["idx"], "deadline",
                     "request {0} expired after {1:.3f}s waiting for a "
@@ -613,7 +703,7 @@ class ServingEngine(object):
                         req["idx"], now - req["submit"],
                         req["deadline_at"] - req["submit"],
                     ),
-                    tokens_done=0, partial=[],
+                    tokens_done=len(committed), partial=committed,
                 )
             else:
                 keep.append(req)
@@ -628,9 +718,19 @@ class ServingEngine(object):
         the scheduler's progress signal."""
         progressed = False
         for slot in self.decoder.free_slots():
-            req = self._pending.pop(0) if self._pending else (
-                self._pull_one(it) if self.policy == "block" else None
-            )
+            if self._draining:
+                # only requeued IN-FLIGHT work (resume_prompt) may
+                # re-enter a draining engine; fresh admissions stopped
+                req = (
+                    self._pending.pop(0)
+                    if self._pending
+                    and "resume_prompt" in self._pending[0] else None
+                )
+            else:
+                req = self._pending.pop(0) if self._pending else (
+                    self._pull_one(it) if self.policy == "block"
+                    else None
+                )
             if req is None:
                 return progressed
             progressed = True
@@ -692,6 +792,11 @@ class ServingEngine(object):
                     ) from e
                 self.stats["errors"] += 1
                 self._m["errors"].inc()
+                if self._prev_weights is not None:
+                    # a device-side failure inside the rollback window
+                    # counts against the new generation (handled at
+                    # the next scheduling pass)
+                    self._probation_errors += 1
                 self._record(req["idx"], "admit", e)
                 continue  # the slot stays free for the next request
             committed = req["out"] or []
@@ -761,24 +866,16 @@ class ServingEngine(object):
             return toks
         return toks, None
 
-    def _recover(self):
-        """Tear the engine down after a wedged dispatch and re-admit
-        every in-flight request from its already-committed tokens.
-
-        The lost chunk's tokens (and any unresolved first-token
-        scalar) are dropped; each request's committed prefix is
-        appended to its prompt and the pair re-prefills into a fresh
-        slot, so greedy decode resumes exactly where the last
-        *synchronized* chunk left it — token-identical continuations
-        (the same masked-prefill invariant the continuous/static
-        parity tests pin down).  Re-admitted requests go to the FRONT
-        of the queue in input order; their deadlines keep running."""
-        self.stats["watchdog_fires"] += 1
-        self._m["watchdog_fires"].inc()
-        self._tracer.mark(
-            "watchdog_fire", trace="serve",
-            inflight=len(self._slot_req), chunk=self._chunk_index - 1,
-        )
+    def _teardown_and_requeue(self, mark_event):
+        """The PR 4 teardown/re-admit mechanism, shared by the
+        watchdog (unplanned wedges) and the hot-swap path (PLANNED
+        generation changes): every in-flight request's committed
+        prefix is preserved, appended to its prompt, and the pair
+        re-prefills into a fresh slot — greedy decode resumes exactly
+        where the last *synchronized* chunk left it (the lost chunk's
+        tokens and any unresolved first-token scalar are dropped).
+        Re-admitted requests go to the FRONT of the queue in input
+        order; their deadlines keep running."""
         inflight = sorted(
             self._slot_req.values(), key=lambda r: r["idx"]
         )
@@ -794,14 +891,255 @@ class ServingEngine(object):
                      np.asarray(committed, np.int32)]
                 ) if committed else req["prompt"]
             )
-            self.stats["recovered"] += 1
-            self._m["recovered"].inc()
             self._tracer.mark(
-                "watchdog_recover", trace="req%d" % req["idx"],
+                mark_event, trace="req%d" % req["idx"],
                 request_index=req["idx"], tokens_committed=len(committed),
             )
         self._pending[:0] = inflight
+        return inflight
+
+    def _recover(self):
+        """Tear the engine down after a wedged dispatch and re-admit
+        every in-flight request from its already-committed tokens
+        (:meth:`_teardown_and_requeue` — token-identical
+        continuations, the same masked-prefill invariant the
+        continuous/static parity tests pin down)."""
+        self.stats["watchdog_fires"] += 1
+        self._m["watchdog_fires"].inc()
+        if self._prev_weights is not None:
+            # a wedge inside the probation window counts against the
+            # new generation — roll back at the next scheduling pass
+            self._probation_errors += 1
+        self._tracer.mark(
+            "watchdog_fire", trace="serve",
+            inflight=len(self._slot_req), chunk=self._chunk_index - 1,
+        )
+        recovered = self._teardown_and_requeue("watchdog_recover")
+        self.stats["recovered"] += len(recovered)
+        for _ in recovered:
+            self._m["recovered"].inc()
         self._watchdog = _DispatchWatchdog()
+
+    # -- live weight swap / rollback (hot_swap.py) ---------------------
+
+    def request_swap(self, params, step=None, draft_params=None):
+        """Queue a MANUAL weight swap (no watcher needed — tests,
+        benches, in-process republish).  Applied between decode
+        chunks at the next scheduling pass, with the same quiesce /
+        canary / rollback contract as a watcher-discovered swap."""
+        if not callable(getattr(self.decoder, "swap_weights", None)):
+            raise ValueError(
+                "live weight hot-swap needs a decoder exposing "
+                "swap_weights/snapshot_weights (transformer."
+                "serving_builder generation decoders do); this "
+                "predictor's decoder has none"
+            )
+        from tensorflowonspark_tpu import hot_swap
+
+        self._swap_request = hot_swap.WeightSet(
+            self.stats["weight_generation"] + 1 if step is None
+            else step,
+            "<request_swap>", params, draft_params=draft_params,
+        )
+
+    def _set_generation(self):
+        gen = int(getattr(self.decoder, "weight_generation", 0))
+        self.stats["weight_generation"] = gen
+        self._m_gen.set(gen)
+        return gen
+
+    def _quarantine(self, w, kind, message):
+        if self.watcher is not None and w.path != "<request_swap>":
+            self.watcher.quarantine_step(w, kind, message)
+
+    def _maybe_swap(self):
+        """One scheduling-pass check of the lifecycle plane: roll
+        back first if the probation window accumulated errors, then
+        apply at most one pending swap.  Runs between chunks only —
+        never concurrently with a dispatch."""
+        if self._prev_weights is not None and self._probation_errors:
+            self._rollback(
+                "{0} device-side error(s)/wedge(s) within the first "
+                "{1} requests of the new generation".format(
+                    self._probation_errors, self.rollback_window
+                )
+            )
+        if self._draining:
+            return  # a draining engine is shutting down; don't churn
+        w, self._swap_request = self._swap_request, None
+        if w is None and self.watcher is not None:
+            w = self.watcher.poll()
+        if w is not None:
+            self._apply_swap(w)
+
+    def _apply_swap(self, w):
+        """The swap transaction, between decode chunks: quiesce
+        in-flight requests through the watchdog teardown/re-admit
+        path (admissions queue behind the bounded admission plane
+        meanwhile — the drain gate), install the new generation
+        (re-quantized on ingest for int8 deployments), run the
+        post-install canary, and arm the rollback window.  The
+        previous weights stay RESIDENT until the window closes."""
+        t0 = time.perf_counter()
+        with self._tracer.span("swap", trace="swap", step=w.step):
+            requeued = self._teardown_and_requeue("swap_requeue")
+            self.stats["swap_requeued"] += len(requeued)
+            self.stats["swap_events"].append({
+                "event": "swap", "step": w.step,
+                "requeued": {r["idx"]: len(r["out"]) for r in requeued},
+            })
+            snapshot = self.decoder.snapshot_weights()
+            try:
+                self.decoder.swap_weights(w.params, w.draft_params)
+            except Exception as e:  # noqa: BLE001 - typed quarantine
+                # a mismatch that slipped past (or never saw) the
+                # watcher's validation: nothing was installed, serving
+                # continues on the old generation
+                logger.warning("hot-swap: install of step %s refused: "
+                               "%s", w.step, e)
+                self._quarantine(w, "shape_mismatch", e)
+                return
+            ok = True
+            if self.swap_canary:
+                try:
+                    ok = self.decoder.canary_check() is not False
+                except Exception:  # noqa: BLE001 - canary is a verdict
+                    ok = False
+            if not ok:
+                self.decoder.restore_weights(snapshot)
+                self.stats["rollbacks"] += 1
+                self._m["swap_rollbacks"].inc()
+                self._quarantine(
+                    w, "canary_failed",
+                    "post-install canary failed for step {0}; rolled "
+                    "back to the previous generation".format(w.step),
+                )
+                self._tracer.mark(
+                    "swap_rollback", trace="swap", step=w.step,
+                    reason="canary_failed",
+                )
+                self._set_generation()
+                return
+        self._prev_weights = (snapshot, w)
+        self._probation_clean = 0
+        self._probation_errors = 0
+        self.stats["swaps"] += 1
+        self._m["swaps"].inc()
+        dt = time.perf_counter() - t0
+        self.stats["swap_latency_sec"].append(round(dt, 6))
+        gen = self._set_generation()
+        self._tracer.mark(
+            "swap_apply", trace="swap", step=w.step, generation=gen,
+            requeued=len(requeued), latency_sec=round(dt, 6),
+        )
+        logger.info(
+            "hot-swap: step %s serving as generation %d (%d in-flight "
+            "requeued, %.1fms)", w.step, gen, len(requeued), 1e3 * dt,
+        )
+
+    def _note_clean_completion(self):
+        """A completed request under probation; ``rollback_window``
+        of them commit the swap (previous weights released)."""
+        if self._prev_weights is None:
+            return
+        self._probation_clean += 1
+        if self._probation_clean >= self.rollback_window:
+            _snapshot, w = self._prev_weights
+            self._prev_weights = None
+            self.stats["swap_commits"] += 1
+            self._m["swap_commits"].inc()
+            self._tracer.mark(
+                "swap_commit", trace="swap", step=w.step,
+                clean_requests=self._probation_clean,
+            )
+
+    def _rollback(self, why):
+        """Automatic rollback: re-quiesce in-flight requests (their
+        committed prefixes — possibly spanning both generations —
+        are preserved), restore the resident previous weights, and
+        quarantine the offending step so the watcher never re-offers
+        it."""
+        snapshot, w = self._prev_weights
+        self._prev_weights = None
+        self._probation_errors = 0
+        requeued = self._teardown_and_requeue("swap_requeue")
+        self.stats["swap_requeued"] += len(requeued)
+        self.stats["swap_events"].append({
+            "event": "rollback", "step": w.step,
+            "requeued": {r["idx"]: len(r["out"]) for r in requeued},
+        })
+        self.decoder.restore_weights(snapshot)
+        self.stats["rollbacks"] += 1
+        self._m["swap_rollbacks"].inc()
+        self._quarantine(
+            w, "rollback",
+            "rolled back from step {0}: {1}".format(w.step, why),
+        )
+        gen = self._set_generation()
+        self._tracer.mark(
+            "swap_rollback", trace="swap", step=w.step,
+            generation=gen, reason=why,
+        )
+        logger.warning(
+            "hot-swap: rolled back step %s -> generation %d (%s)",
+            w.step, gen, why,
+        )
+
+    # -- graceful drain ------------------------------------------------
+
+    def drain(self, deadline=None):
+        """Begin a graceful drain: admissions STOP (block-policy
+        sources are no longer pulled; queued requests that never got
+        a slot return typed ``drained`` records at their positions),
+        in-flight requests run to completion, and past ``deadline``
+        seconds the stragglers are cancelled between chunks with
+        typed records carrying their committed tokens.  The
+        :meth:`serve` generator then finishes even if the source has
+        more rows.  This is the same quiesce machinery the hot-swap
+        path runs for the length of one swap transaction
+        (:meth:`_apply_swap`) — drain simply never re-opens the
+        gate."""
+        self._draining = True
+        if deadline is not None:
+            self._drain_deadline_at = self._clock() + float(deadline)
+
+    def _drain_pending(self):
+        """Queued requests that never reached a slot exit as typed
+        ``drained`` records; watchdog/swap-requeued IN-FLIGHT work
+        (``resume_prompt``) stays — it re-admits so committed tokens
+        are never lost."""
+        keep = []
+        for req in self._pending:
+            if "resume_prompt" in req:
+                keep.append(req)
+                continue
+            self.stats["drained"] += 1
+            self._m["drained"].inc()
+            self._record(
+                req["idx"], "drained",
+                "request {0} drained: engine stopped admissions "
+                "before a slot freed".format(req["idx"]),
+                tokens_done=0, partial=[],
+            )
+        self._pending = keep
+
+    def _drain_cancel_slots(self, now):
+        """Drain-deadline expiry: cancel every in-flight lane with a
+        typed record carrying its committed tokens (the slot-level
+        cancellation path — neighbors would be unaffected, nothing
+        recompiles)."""
+        for slot, req in list(self._slot_req.items()):
+            committed = [t for t in req["out"] if isinstance(t, int)]
+            self.stats["drained"] += 1
+            self._m["drained"].inc()
+            self._record(
+                req["idx"], "drained",
+                "request {0} cancelled by drain deadline; {1} "
+                "token(s) completed".format(req["idx"], len(committed)),
+                tokens_done=len(committed), partial=committed,
+            )
+            self.decoder.cancel(slot)
+            del self._slot_req[slot]
 
     # -- consume / finalize --------------------------------------------
 
@@ -843,6 +1181,7 @@ class ServingEngine(object):
         self.stats["done_at"][req["idx"]] = t_done - self._t0
         self._m["completed"].inc()
         self._m_lat.observe(t_done - req["submit"])
+        self._note_clean_completion()
 
     def _expire_slot(self, slot, req, now):
         """Cancel an expired in-flight lane between chunks; neighbors
@@ -886,12 +1225,25 @@ class ServingEngine(object):
         it = iter(rows)
         try:
             while True:
+                # lifecycle plane first: probation rollback, then at
+                # most one validated swap per pass — both run between
+                # chunks, never concurrently with a dispatch
+                self._maybe_swap()
                 self._refill(it)
                 self._expire_pending()
+                if self._draining:
+                    self._drain_pending()
                 progressed = self._admit_free(it)
                 for r in self._drain_ready():
                     yield r
                 if not self._slot_req:
+                    if self._draining:
+                        # drained: nothing in flight, nothing may be
+                        # admitted — the job is over regardless of
+                        # what the source still holds
+                        for r in self._drain_ready():
+                            yield r
+                        return
                     if self._pending or not self._exhausted:
                         if progressed:
                             # every admit this pass failed into records
@@ -925,6 +1277,10 @@ class ServingEngine(object):
                     elif (req["deadline_at"] is not None
                           and t_chunk > req["deadline_at"]):
                         self._expire_slot(slot, req, t_chunk)
+                if (self._draining
+                        and self._drain_deadline_at is not None
+                        and t_chunk > self._drain_deadline_at):
+                    self._drain_cancel_slots(t_chunk)
                 for r in self._drain_ready():
                     yield r
         finally:
@@ -933,3 +1289,5 @@ class ServingEngine(object):
                 self._profile.stop()
             if self._watchdog is not None:
                 self._watchdog.close()
+            if self._own_watcher and self.watcher is not None:
+                self.watcher.close()

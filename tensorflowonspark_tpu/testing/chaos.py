@@ -26,6 +26,15 @@ that fails must fail the same way every run):
   malformed requests for every admission-validation class; and
   :func:`slow_consumer` stalls the output side the way a slow
   downstream does.
+- **swap faults** (the lifecycle family, ISSUE 8):
+  :func:`corrupt_checkpoint` inflicts one corrupt-export variant per
+  hot-swap validation stage (truncated array file, garbage manifest,
+  shape-mismatched params — every one must quarantine with its typed
+  reason, never serve); the plan can order ``slow_ingest`` (a stalled
+  checkpoint store — background ingest must keep serving on the old
+  generation) and ``swap_during_wedge`` (a validated swap pending
+  while a dispatch wedges — watchdog recovery and the swap must both
+  land, dropping nothing).
 
 Nothing here runs unless a test opts in: ``heartbeat_chaos_fn`` returns
 ``None`` when ``TFOS_CHAOS_PLAN`` is unset, so production paths carry a
@@ -74,6 +83,29 @@ class ChaosPlan(object):
         self.faults.append(
             {"kind": "drop_heartbeats", "executor_id": int(executor_id),
              "beats": int(beats)}
+        )
+        return self
+
+    def slow_ingest(self, sec):
+        """Stall every checkpoint ingest (the hot-swap watcher's
+        orbax load + validation) for ``sec`` seconds — what a slow
+        or far-away checkpoint store looks like.  With the watcher's
+        default background ingest thread, serving must keep decoding
+        on the old generation for the whole stall
+        (tests/test_chaos_serving.py)."""
+        self.faults.append({"kind": "slow_ingest", "sec": float(sec)})
+        return self
+
+    def swap_during_wedge(self, at_chunk, hang_sec=30.0):
+        """The nastiest lifecycle ordering: a decode dispatch wedges
+        at ``at_chunk`` (watchdog territory) WHILE a validated new
+        checkpoint is waiting to swap.  Installs the wedge fault and
+        records the chunk so the test harness can time its publish
+        (:func:`swap_chunk_from_plan`); the engine must recover the
+        wedge, land the swap, and drop nothing."""
+        self.wedge_dispatch(at_chunk, hang_sec=hang_sec)
+        self.faults.append(
+            {"kind": "swap_at_chunk", "at_chunk": int(at_chunk)}
         )
         return self
 
@@ -209,6 +241,113 @@ def serving_wedge_fn():
                 return
 
     return maybe_wedge
+
+
+def ingest_delay():
+    """Seconds the chaos plan orders checkpoint ingests stalled
+    (``slow_ingest``), or None without a plan — the hot-swap
+    watcher's default ``ingest_delay`` hook (a single None check of
+    production overhead, like the other plan hooks)."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    secs = [f["sec"] for f in plan.faults if f["kind"] == "slow_ingest"]
+    return max(secs) if secs else None
+
+
+def swap_chunk_from_plan():
+    """The chunk index a ``swap_during_wedge`` fault targets, or None
+    — the test-harness half of that fault (the harness publishes the
+    new checkpoint so it lands while the wedge holds the dispatch)."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    for f in plan.faults:
+        if f["kind"] == "swap_at_chunk":
+            return int(f["at_chunk"])
+    return None
+
+
+#: corrupt-checkpoint kinds :func:`corrupt_checkpoint` can inflict —
+#: one per hot-swap validation stage (docs/serving.md "Live weight
+#: swap & rollback"): a truncated array file fails the LOAD stage, a
+#: garbage manifest fails the MANIFEST stage, shape-mismatched params
+#: fail the TREE stage.  Every kind must be quarantined with its
+#: typed reason and never served (tests/test_chaos_serving.py).
+CORRUPT_KINDS = ("truncate_array", "bad_manifest", "shape_mismatch")
+
+
+def corrupt_checkpoint(step_dir, kind):
+    """Deterministically corrupt a PUBLISHED step export in place.
+
+    - ``truncate_array``: the largest file under ``params/`` is cut
+      to a third — the orbax restore must fail (``load_failed``);
+    - ``bad_manifest``: the completion manifest becomes garbage bytes
+      (``bad_manifest``);
+    - ``shape_mismatch``: the export is re-published with its largest
+      ``>=2``-D leaf padded by one along the last axis — loads fine,
+      fails the live-model census check (``shape_mismatch``).
+
+    Returns the path corrupted/republished.
+    """
+    import numpy as np  # noqa: F401 - shape kind below
+
+    step_dir = os.fspath(step_dir)
+    if kind == "truncate_array":
+        biggest, size = None, -1
+        for root, _dirs, files in os.walk(os.path.join(step_dir, "params")):
+            for name in files:
+                p = os.path.join(root, name)
+                s = os.path.getsize(p)
+                if s > size:
+                    biggest, size = p, s
+        if biggest is None:
+            raise RuntimeError("no array files under %s" % step_dir)
+        with open(biggest, "r+b") as f:
+            f.truncate(max(1, size // 3))
+        return biggest
+    if kind == "bad_manifest":
+        path = os.path.join(step_dir, "manifest.json")
+        with open(path, "wb") as f:
+            f.write(b"\x00garbage{{{not json")
+        return path
+    if kind == "shape_mismatch":
+        from tensorflowonspark_tpu import checkpoint as ckpt
+
+        params, _meta = ckpt.load_for_serving(step_dir)
+        manifest = ckpt.read_manifest(step_dir) or {}
+        ckpt.save_for_serving(
+            step_dir, shape_mismatched_params(params),
+            step=manifest.get("step"),
+        )
+        return step_dir
+    raise ValueError(
+        "unknown corrupt kind {0!r}; pick one of {1}".format(
+            kind, CORRUPT_KINDS
+        )
+    )
+
+
+def shape_mismatched_params(params):
+    """A copy of ``params`` whose LARGEST ``>=2``-D leaf grew by one
+    along its last axis — the shape-mismatch corrupt variant (loads
+    cleanly, must be quarantined by the tree/shape validation
+    stage)."""
+    import jax
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    target, target_size = None, -1
+    for i, leaf in enumerate(leaves):
+        if getattr(leaf, "ndim", 0) >= 2 and leaf.size > target_size:
+            target, target_size = i, leaf.size
+    if target is None:
+        raise RuntimeError("params has no >=2-D leaf to mis-shape")
+    out = list(leaves)
+    a = np.asarray(out[target])
+    pad = [(0, 0)] * (a.ndim - 1) + [(0, 1)]
+    out[target] = np.pad(a, pad)
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 #: poison-payload kinds :func:`poison_row` can build — one per

@@ -83,6 +83,13 @@ def _full_record():
                         "degraded": 31, "latency_p50_ms": 900.0,
                         "latency_p99_ms": 2200.0},
         },
+        "serving_hotswap": {
+            "rows": 24, "slots": 4, "swaps": 1,
+            "swap_latency_ms": 41.3, "swap_dropped": 0,
+            "swap_requeued": 3, "weight_generation": 1,
+            "goodput_rows_s": 18.2, "baseline_rows_s": 19.9,
+            "goodput_dip_pct": 8.5,
+        },
         "serving_prefix": {
             "rows": 32, "slots": 8, "prefix_len": 320,
             "cold_rows_per_sec": 33.5,
@@ -149,6 +156,8 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["serving_generate_rows_s"] == 59.77
     assert parsed["serving_continuous_rows_s"] == 78.41
     assert parsed["serving_overload_goodput"] == 11.8  # reject-policy row
+    assert parsed["swap_latency_ms"] == 41.3  # hot-swap transaction
+    assert parsed["swap_dropped"] == 0  # the zero-downtime contract
     assert parsed["serving_prefix_gain"] == 1.653  # 80%-shared vs cold
     assert parsed["spec_accept_rate"] == 0.918
     assert parsed["async_ps_compressed_steps_s"] == 61.7
@@ -168,6 +177,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "resnet50_img_s", "vs_baseline", "lm_tok_s", "lm_mfu",
         "spark_feed_steps_s", "moe_tok_s", "serving_generate_rows_s",
         "serving_continuous_rows_s", "serving_overload_goodput",
+        "swap_latency_ms", "swap_dropped",
         "serving_prefix_gain", "spec_accept_rate",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "feed_wire_mb_per_step", "serving_u8_vs_f32",

@@ -216,6 +216,242 @@ def test_slow_consumer_stall_can_expire_deadlines():
 
 
 # ----------------------------------------------------------------------
+# swap fault family (ISSUE 8): corrupt checkpoints, slow ingest,
+# swap-during-wedge
+# ----------------------------------------------------------------------
+
+
+def _gen_predict_with_params(max_new=6, extra=None, seed=0):
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    model = tr.Transformer(tr.TransformerConfig(**TINY))
+    params = jax.tree.map(np.asarray, model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"])
+    cfg = dict(TINY, mode="generate", max_new_tokens=max_new,
+               pad_multiple=16, **(extra or {}))
+    return params, tr.serving_builder(params, cfg)
+
+
+@pytest.mark.parametrize(
+    "kind,reason",
+    [
+        ("truncate_array", "load_failed"),
+        ("bad_manifest", "bad_manifest"),
+        ("shape_mismatch", "shape_mismatch"),
+    ],
+)
+def test_corrupt_checkpoint_quarantined_serving_continues(
+        tmp_path, kind, reason):
+    # satellite: EVERY corrupt variant is quarantined with its named
+    # reason and serving continues on the old generation — outputs
+    # token-identical to a swap-free run
+    from tensorflowonspark_tpu import checkpoint as ckpt
+    from tensorflowonspark_tpu import hot_swap
+
+    params, predict = _gen_predict_with_params(
+        max_new=6, extra={"chunk_size": 2}
+    )
+    rows = [{"prompt": p} for p in _prompts([4, 7, 5, 9])]
+    ref = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], {"prompt": "tokens"},
+        batch_size=2, schedule="continuous",
+    ))
+    root = str(tmp_path / "pub")
+    step_dir = ckpt.publish_for_serving(root, 1, params)
+    chaos.corrupt_checkpoint(step_dir, kind)
+    watcher = hot_swap.CheckpointWatcher(
+        root, poll_interval=0.0, background=False
+    )
+    stats = {}
+    out = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], {"prompt": "tokens"},
+        batch_size=2, schedule="continuous", stats=stats,
+        watcher=watcher,
+    ))
+    assert stats["swaps"] == 0 and stats["weight_generation"] == 0
+    assert watcher.quarantined[-1]["kind"] == reason
+    assert hot_swap.read_quarantine(step_dir)["kind"] == reason
+    assert len(out) == len(rows)
+    for got, want in zip(out, ref):
+        np.testing.assert_array_equal(
+            np.asarray(got["generated"]), np.asarray(want["generated"])
+        )
+
+
+def test_slow_ingest_plan_hook(tmp_path, monkeypatch):
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    assert chaos.ingest_delay() is None
+    plan = chaos.ChaosPlan().slow_ingest(1.25)
+    plan.save(tmp_path / "plan.json")
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(tmp_path / "plan.json"))
+    assert chaos.ingest_delay() == 1.25
+    assert chaos.swap_chunk_from_plan() is None
+    plan2 = chaos.ChaosPlan().swap_during_wedge(3, hang_sec=0.5)
+    plan2.save(tmp_path / "plan.json")
+    assert chaos.swap_chunk_from_plan() == 3
+    kinds = [f["kind"] for f in chaos.ChaosPlan.load(
+        tmp_path / "plan.json"
+    ).faults]
+    assert kinds == ["wedge_dispatch", "swap_at_chunk"]
+
+
+def test_slow_ingest_background_watcher_never_stalls_serving(
+        tmp_path, monkeypatch):
+    # a stalled checkpoint store: the watcher's background ingest
+    # thread eats the stall while the engine keeps serving the old
+    # generation; once ingest lands, the NEXT job swaps
+    from tensorflowonspark_tpu import checkpoint as ckpt
+    from tensorflowonspark_tpu import hot_swap
+
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    params_a, predict = _gen_predict_with_params(
+        max_new=4, extra={"chunk_size": 2}, seed=0
+    )
+    params_b, _ = _gen_predict_with_params(max_new=4, seed=1)
+    rows = [{"prompt": p} for p in _prompts([4, 7, 5, 9])]
+    # warm the compiled programs so job walls are milliseconds
+    list(serving.predict_rows(
+        predict, [dict(r) for r in rows], {"prompt": "tokens"},
+        batch_size=2, schedule="continuous",
+    ))
+    root = str(tmp_path / "pub")
+    ckpt.publish_for_serving(root, 1, params_b)
+    watcher = hot_swap.CheckpointWatcher(
+        root, poll_interval=0.01, background=True, ingest_delay=1.0
+    )
+    try:
+        stats = {}
+        out = list(serving.predict_rows(
+            predict, [dict(r) for r in rows], {"prompt": "tokens"},
+            batch_size=2, schedule="continuous", stats=stats,
+            watcher=watcher,
+        ))
+        # the whole job completed on the old generation while the
+        # ingest thread was still sleeping through the stall
+        assert len(out) == len(rows)
+        assert stats["swaps"] == 0
+        assert stats["weight_generation"] == 0
+        # ingest eventually completes off the hot path
+        deadline = time.monotonic() + 10.0
+        stats2 = {}
+        while time.monotonic() < deadline:
+            out2 = list(serving.predict_rows(
+                predict, [dict(r) for r in rows], {"prompt": "tokens"},
+                batch_size=2, schedule="continuous", stats=stats2,
+                watcher=watcher,
+            ))
+            assert len(out2) == len(rows)
+            if stats2["swaps"]:
+                break
+            time.sleep(0.1)
+        assert stats2["swaps"] == 1
+    finally:
+        watcher.close()
+        predict.make_slot_decoder(2).swap_weights(params_a)
+
+
+def test_swap_during_wedge_lands_and_drops_nothing(
+        tmp_path, monkeypatch):
+    # the nastiest ordering: a validated swap is pending while a
+    # dispatch wedges.  rollback_window=1 commits on the first clean
+    # completion, so the later wedge is ordinary watchdog territory —
+    # recovery and the swap BOTH land, nothing is dropped
+    from tensorflowonspark_tpu import checkpoint as ckpt
+    from tensorflowonspark_tpu import hot_swap
+
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    params_a, predict = _gen_predict_with_params(
+        max_new=8, extra={"chunk_size": 2}, seed=0
+    )
+    params_b, _ = _gen_predict_with_params(max_new=8, seed=1)
+    rows = [{"prompt": p, "max_new": b} for p, b in zip(
+        _prompts([4, 7, 5, 9, 3, 6]), [2, 8, 8, 8, 8, 8]
+    )]
+    mapping = {"prompt": "tokens", "max_new": "max_new"}
+    # warm the compiled programs BEFORE arming the plan: a cold first
+    # dispatch pays XLA compile and a 0.25s watchdog would read that
+    # as a wedge (docs/serving.md "Decode watchdog")
+    list(serving.predict_rows(
+        predict, [dict(r) for r in rows], mapping, batch_size=2,
+        schedule="continuous",
+    ))
+    predict.make_slot_decoder(2).canary_check()
+    plan = chaos.ChaosPlan().swap_during_wedge(2, hang_sec=1.0)
+    plan.save(tmp_path / "plan.json")
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(tmp_path / "plan.json"))
+    root = str(tmp_path / "pub")
+    ckpt.publish_for_serving(root, 1, params_b)
+    watcher = hot_swap.CheckpointWatcher(
+        root, poll_interval=0.0, background=False, ingest_delay=0
+    )
+    stats = {}
+    out = list(serving.predict_rows(
+        predict, [dict(r) for r in rows], mapping, batch_size=2,
+        schedule="continuous", stats=stats, watcher=watcher,
+        watchdog_timeout=0.25, rollback_window=1,
+    ))
+    assert len(out) == len(rows)  # zero dropped
+    assert all("error" not in r for r in out)
+    assert stats["swaps"] == 1
+    assert stats["swap_commits"] == 1
+    assert stats["watchdog_fires"] >= 1
+    assert stats["rollbacks"] == 0
+    assert stats["weight_generation"] == 1
+    predict.make_slot_decoder(2).swap_weights(params_a)
+
+
+def test_wedge_inside_probation_window_rolls_back(tmp_path,
+                                                  monkeypatch):
+    # a wedge during the rollback window counts as an error spike
+    # against the NEW generation: the engine flips back to the
+    # resident previous weights, quarantines the step, and still
+    # completes every request
+    from tensorflowonspark_tpu import checkpoint as ckpt
+    from tensorflowonspark_tpu import hot_swap
+
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    params_a, predict = _gen_predict_with_params(
+        max_new=8, extra={"chunk_size": 2}, seed=0
+    )
+    params_b, _ = _gen_predict_with_params(max_new=8, seed=1)
+    rows = [{"prompt": p} for p in _prompts([4, 7, 5, 9])]
+
+    class _WedgeOnce:
+        fired = 0
+
+        def __call__(self, chunk_index):
+            if self.fired == 0 and chunk_index >= 1:
+                self.fired += 1
+                time.sleep(1.0)
+
+    root = str(tmp_path / "pub")
+    ckpt.publish_for_serving(root, 1, params_b)
+    watcher = hot_swap.CheckpointWatcher(
+        root, poll_interval=0.0, background=False
+    )
+    stats = {}
+    eng = serving_engine.ServingEngine(
+        predict, {"prompt": "tokens"}, num_slots=2,
+        watchdog_timeout=0.25, wedge_fn=_WedgeOnce(), stats=stats,
+        watcher=watcher, rollback_window=100,
+    )
+    out = list(eng.serve([dict(r) for r in rows]))
+    assert len(out) == len(rows)
+    assert all("error" not in r for r in out)
+    assert stats["swaps"] == 1
+    assert stats["rollbacks"] == 1
+    assert stats["weight_generation"] == 0  # back on the old weights
+    assert watcher.quarantined[-1]["kind"] == "rollback"
+    events = [e["event"] for e in stats["swap_events"]]
+    assert events == ["swap", "rollback"]
+    predict.make_slot_decoder(2).swap_weights(params_a)
+
+
+# ----------------------------------------------------------------------
 # combined kill-and-recover e2e (slow): poison + one wedged dispatch +
 # offered load 2x admission capacity, per policy
 # ----------------------------------------------------------------------

@@ -219,6 +219,98 @@ class TestServingExport:
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref), atol=1e-6)
 
 
+class TestAtomicServingSaves:
+    """ISSUE 8 satellite: serving exports are atomic (temp dir +
+    rename, manifest written last), so a reader polling mid-save sees
+    either the old step set or the COMPLETE new step — never a torn
+    one."""
+
+    def _params(self, seed=0):
+        rng = np.random.RandomState(seed)
+        return {
+            "w": rng.randn(8, 4).astype(np.float32),
+            "b": rng.randn(4).astype(np.float32),
+        }
+
+    def test_export_carries_complete_manifest(self, tmp_path):
+        out = ckpt.save_for_serving(
+            tmp_path / "export", self._params(), step=3
+        )
+        m = ckpt.read_manifest(out)
+        assert m["complete"] is True and m["step"] == 3
+        assert set(m["params"]) == set(
+            ckpt.param_manifest(self._params())
+        )
+
+    def test_reader_polling_mid_save_never_sees_torn_step(
+            self, tmp_path):
+        # a poller thread hammers the root while the main thread
+        # publishes steps: EVERY step it ever observes must carry a
+        # complete manifest and load fully — the regression the
+        # pre-atomic save_for_serving failed (params visible before
+        # metadata, no completion marker at all)
+        import threading
+
+        root = str(tmp_path / "pub")
+        stop = threading.Event()
+        failures = []
+        observed = set()
+
+        def poller():
+            while not stop.is_set():
+                for step in ckpt.list_serving_steps(root):
+                    observed.add(step)
+                    step_dir = str(tmp_path / "pub" / str(step))
+                    m = ckpt.read_manifest(step_dir)
+                    if not (m and m.get("complete")):
+                        failures.append((step, "manifest", m))
+                        continue
+                    try:
+                        params, _meta = ckpt.load_for_serving(step_dir)
+                        if ckpt.param_manifest(params) != m["params"]:
+                            failures.append((step, "census", None))
+                    except Exception as e:  # noqa: BLE001 - torn read
+                        failures.append((step, "load", repr(e)))
+
+        t = threading.Thread(target=poller, daemon=True)
+        t.start()
+        try:
+            for step in (1, 2, 3):
+                ckpt.publish_for_serving(root, step, self._params(step))
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not failures, failures[:3]
+        assert observed  # the poller actually raced the saves
+
+    def test_mid_save_staging_is_invisible(self, tmp_path):
+        # the staging layout a crashed writer leaves behind (params
+        # present, manifest absent — manifest is written LAST) must
+        # read as "no new step", not a torn one
+        import os
+
+        root = str(tmp_path / "pub")
+        ckpt.publish_for_serving(root, 1, self._params())
+        staging = os.path.join(root, "2.tmp-999")
+        os.makedirs(os.path.join(staging, "params"))
+        assert ckpt.list_serving_steps(root) == [1]
+        # even if the dir got renamed without its manifest (a
+        # non-atomic foreign writer), the listing skips it
+        os.rename(staging, os.path.join(root, "2"))
+        assert ckpt.list_serving_steps(root) == [1]
+
+    def test_publish_then_read_manifest_roundtrip(self, tmp_path):
+        root = str(tmp_path / "pub")
+        p = self._params(7)
+        step_dir = ckpt.publish_for_serving(
+            root, 12, p, extra_metadata={"note": "hi"}
+        )
+        loaded, meta = ckpt.load_for_serving(step_dir)
+        assert meta["note"] == "hi"
+        np.testing.assert_array_equal(loaded["w"], p["w"])
+        assert ckpt.list_serving_steps(root) == [12]
+
+
 # --- cluster-level failure -> resume (the recovery story, SURVEY.md §5) ---
 
 

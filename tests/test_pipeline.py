@@ -76,6 +76,20 @@ def test_model_schedule_and_model_config_params():
     assert args.model_config["prefix_cache"] is True
 
 
+def test_model_checkpoint_dir_param():
+    # ISSUE 8 wiring: setCheckpointDir points each executor's
+    # continuous engine at a publish_for_serving root for validated
+    # live weight hot-swaps mid-transform (docs/serving.md "Live
+    # weight swap & rollback")
+    from tensorflowonspark_tpu.pipeline import TFModel
+
+    m = TFModel({})
+    assert m.getCheckpointDir() is None
+    m.setSchedule("continuous").setCheckpointDir("/ckpts/serving")
+    args = m.merge_args_params()
+    assert args.checkpoint_dir == "/ckpts/serving"
+
+
 def test_merge_does_not_mutate_source_args():
     est = TFEstimator(lambda a, c: None, {"epochs": 99})
     est.setEpochs(5)
