@@ -1965,6 +1965,16 @@ def ps_tpu_bench(steps=40, batch=64, hidden=1024):
             ("async_compressed_topk_pe4_steps_per_sec",
              dict(overlap=True, push_every=4,
                   codec=("topk", {"ratio": 0.05}), reply_codec="int8")),
+            # the two-tier plane (docs/communication.md "Two-tier
+            # gradient plane"): device-resident PS shards, jitted
+            # on-device apply, ZERO per-step host readback — only the
+            # pod leader crosses the wire, one compressed delta window
+            # per push_every steps on a background thread.  Cadence
+            # rule: push_every x step_time should exceed the DCN RTT
+            # so the pusher never becomes the pacing tier
+            ("hierarchical_steps_per_sec",
+             dict(topology="hierarchical", push_every=16,
+                  codec="int8", reply_codec="same")),
         ):
             w = AsyncTrainer(
                 loss_fn, addrs,
@@ -2026,6 +2036,15 @@ def ps_tpu_bench(steps=40, batch=64, hidden=1024):
         out["async_pipelined_steps_per_sec"] / out["sync_steps_per_sec"], 3
     )
     out["async_vs_sync"] = round(best_async / out["sync_steps_per_sec"], 3)
+    # ROADMAP item 3's acceptance bar: the hierarchical (ICI-native)
+    # path must land within <=2x of sync on an on-pod mesh (ratio
+    # >= 0.5) — the in-pod step is one fused on-device dispatch, the
+    # remaining gap is dispatch shape, not a host/wire wall
+    if out.get("hierarchical_steps_per_sec"):
+        out["hier_ps_vs_sync"] = round(
+            out["hierarchical_steps_per_sec"] / out["sync_steps_per_sec"],
+            3,
+        )
     out["model"] = "MLP 784-%d-10, batch %d, 2 PS shards" % (hidden, batch)
     if out["async_vs_sync"] < 0.7:
         # measured on the tunneled chip: every async step pays a
@@ -2636,6 +2655,11 @@ def bench_summary(record):
             record, "async_ps_tpu", "async_compressed_steps_per_sec"
         ),
         "async_vs_sync": _pluck(record, "async_ps_tpu", "async_vs_sync"),
+        # the two-tier (ICI-native) plane's trajectory metric: on-pod
+        # hierarchical async vs sync (acceptance bar: >= 0.5)
+        "hier_ps_vs_sync": _pluck(
+            record, "async_ps_tpu", "hier_ps_vs_sync"
+        ),
         # narrow-dtype data plane (docs/data_plane.md)
         "feed_wire_mb_per_step": (
             _pluck(
@@ -2695,6 +2719,115 @@ def emit_record(record, full_path=None):
         line = json.dumps(summary)
     assert len(line) <= 1500, len(line)
     return line
+
+
+#: summary keys where a DECREASE is the improvement; everything else
+#: in bench_summary is a throughput/ratio where bigger is better.
+LOWER_IS_BETTER = frozenset({
+    "wall_sec", "swap_latency_ms", "swap_dropped",
+    "telemetry_overhead_pct", "feed_wire_mb_per_step",
+})
+
+
+def _tail_sections(text):
+    """Recover top-level record sections from a truncated JSON tail
+    (the driver's BENCH_r0N.json wrappers keep only the last ~2000
+    stdout chars of the old giant-line format).  Scans for
+    ``"name": {`` at any position and raw-decodes the balanced object;
+    sections cut off by the truncation simply don't parse and are
+    skipped."""
+    import re
+
+    dec = json.JSONDecoder()
+    out = {}
+    for m in re.finditer(r'"(\w+)":\s*\{', text):
+        name = m.group(1)
+        try:
+            obj, _ = dec.raw_decode(text, m.end() - 1)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and name not in out:
+            out[name] = obj
+    # scalar top-levels (metric/value/vs_baseline ride outside any
+    # section); only keep ones bench_summary plucks at the top level
+    for key in ("metric", "value", "vs_baseline", "bench_wall_sec"):
+        m = re.search(r'"%s":\s*("[^"]*"|[-0-9.eE]+)' % key, text)
+        if m and key not in out:
+            try:
+                out[key] = json.loads(m.group(1))
+            except ValueError:
+                pass
+    return out
+
+
+def load_compare_record(path):
+    """Load a comparison anchor: a ``bench_full.json`` record, an
+    already-compact summary line, or a driver ``BENCH_r0N.json``
+    wrapper (``{n, cmd, rc, tail, parsed}`` — ``parsed`` when the run
+    printed a summary line, else the sections recoverable from the
+    stdout ``tail``).  Returns a summary-shaped dict."""
+    with open(path) as f:
+        d = json.load(f)
+    if not isinstance(d, dict):
+        raise ValueError("%s is not a JSON object" % path)
+    if "tail" in d and "cmd" in d:  # driver wrapper
+        parsed = d.get("parsed")
+        if isinstance(parsed, dict) and "full_record" in parsed:
+            return parsed
+        return bench_summary(_tail_sections(str(d.get("tail") or "")))
+    if "full_record" in d:  # already a compact summary line
+        return d
+    return bench_summary(d)  # a full record
+
+
+def compare_records(prev, cur, threshold=0.10):
+    """Per-key deltas of two bench runs plus a ``regressions`` list.
+
+    ``prev``/``cur`` are summary-shaped dicts (see
+    :func:`load_compare_record`).  A key regresses when both sides are
+    numeric and it moved more than ``threshold`` (fraction) the WRONG
+    way — down for throughput/ratio keys, up for the
+    :data:`LOWER_IS_BETTER` set.  Keys missing on either side are
+    reported under ``uncomparable`` (a vanished row is a signal too,
+    just not a numeric one)."""
+    deltas = {}
+    regressions = []
+    uncomparable = []
+    keys = [k for k in bench_summary({}) if k != "full_record"]
+    for k in keys:
+        p, c = prev.get(k), cur.get(k)
+        if not isinstance(p, (int, float)) or not isinstance(c, (int, float)):
+            if p is not None or c is not None:
+                uncomparable.append(k)
+            continue
+        pct = (c - p) / abs(p) if p else (0.0 if c == p else None)
+        deltas[k] = {
+            "prev": p, "cur": c,
+            "pct": round(100.0 * pct, 2) if pct is not None else None,
+        }
+        if pct is None:
+            continue
+        wrong = -pct if k in LOWER_IS_BETTER else pct
+        if wrong < -threshold:
+            regressions.append(k)
+    return {
+        "threshold_pct": round(100.0 * threshold, 1),
+        "compared": len(deltas),
+        "deltas": deltas,
+        "regressions": sorted(regressions),
+        "uncomparable": sorted(uncomparable),
+    }
+
+
+def run_compare(prev_path, cur_path=None):
+    """CLI driver for ``bench.py --compare``: current run defaults to
+    :data:`BENCH_FULL_PATH`; prints the comparison JSON and returns
+    it."""
+    prev = load_compare_record(prev_path)
+    cur = load_compare_record(cur_path or BENCH_FULL_PATH)
+    out = compare_records(prev, cur)
+    out["anchor"] = prev_path
+    return out
 
 
 def main(model_name="resnet50", with_feed=True):
@@ -2804,6 +2937,20 @@ def with_retry(fn, attempts=3):
 
 
 if __name__ == "__main__":
+    if "--compare" in sys.argv:
+        # regression gate: per-key deltas vs a prior record (a
+        # bench_full.json or a driver BENCH_r0N.json wrapper) — pure
+        # file work, no chip, no compile cache
+        _i = sys.argv.index("--compare")
+        _rest = [a for a in sys.argv[_i + 1:] if not a.startswith("-")]
+        if not _rest:
+            print("usage: bench.py --compare <prev.json> [cur.json]",
+                  file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(run_compare(
+            _rest[0], _rest[1] if len(_rest) > 1 else None
+        )))
+        sys.exit(0)
     _enable_compile_cache()
     if "--feed-worker" in sys.argv:
         feed_worker()

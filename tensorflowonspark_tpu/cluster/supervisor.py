@@ -147,6 +147,10 @@ class Supervisor(object):
                 self.ctx.executor_id, e,
             )
         self.heartbeater.start()
+        # seed the hierarchical gradient plane's pod-leader kv: at
+        # start every compute peer is live, so the leader is simply the
+        # lowest executor id (re-elected on every rebirth/park below)
+        self._publish_leader(self.compute_eids)
         self._thread = threading.Thread(
             target=self._watch,
             daemon=True,
@@ -356,6 +360,14 @@ class Supervisor(object):
             meta = dict(self.node_meta, generation=self.generation)
             client.register(meta)
             self._await_generation(client, self.generation)
+            # hierarchical-PS leader re-election: the ICI group just
+            # re-rendezvoused; elect among the peers that made it to
+            # this generation (a permanently-dead peer never re-
+            # registers, so it drops out of the electorate) and publish
+            # so the respawned compute process picks up its DCN duty
+            self._publish_leader(
+                self._peers_at_generation(client, self.generation)
+            )
             client.close()
         except Exception:  # noqa: BLE001 - barrier is best-effort; the
             logger.warning(  # monitor owns permanent-failure detection
@@ -364,6 +376,56 @@ class Supervisor(object):
                 self.ctx.executor_id, self.generation, exc_info=True,
             )
         self._spawn()
+
+    def _peers_at_generation(self, client, generation):
+        """Compute peers whose liveness record reached ``generation`` —
+        the electorate for the pod-leader re-election (everyone behind
+        the barrier is either dead or about to take the same path)."""
+        try:
+            executors, _ = client.get_liveness()
+        except Exception:  # noqa: BLE001 - server flaky: keep them all
+            return list(self.compute_eids)
+        live = [
+            eid for eid in self.compute_eids
+            if executors.get(str(eid), {}).get("generation", -1)
+            >= generation
+        ]
+        return live or list(self.compute_eids)
+
+    def _publish_leader(self, live_eids):
+        """Elect the hierarchical plane's pod leader among ``live_eids``
+        and publish it into the node kv (``hier_leader``) — the hook
+        :func:`tensorflowonspark_tpu.parallel.hier_ps.current_leader`
+        reads from the compute process."""
+        try:
+            from tensorflowonspark_tpu.parallel.hier_ps import elect_leader
+
+            leader = elect_leader(live_eids)
+        except Exception:  # noqa: BLE001 - empty electorate: keep old kv
+            logger.warning(
+                "executor %d: pod-leader election failed",
+                self.ctx.executor_id, exc_info=True,
+            )
+            return None
+        try:
+            self.mgr.set("hier_leader", leader)
+        except Exception:  # noqa: BLE001 - kv is best effort
+            logger.warning(
+                "executor %d: unable to publish pod leader %s",
+                self.ctx.executor_id, leader, exc_info=True,
+            )
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "leader_elected",
+            trace="executor%d" % self.ctx.executor_id,
+            leader=leader, generation=self.generation,
+        )
+        logger.info(
+            "executor %d: pod leader for generation %d is executor %s",
+            self.ctx.executor_id, self.generation, leader,
+        )
+        return leader
 
     def _reset_data_plane(self):
         """Release feeders and drop stale state: zero every feed queue's

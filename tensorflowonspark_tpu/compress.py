@@ -41,9 +41,34 @@ __all__ = [
     "Int8Codec",
     "NoneCodec",
     "TopKCodec",
+    "dtype_str",
     "encoded_nbytes",
     "get_codec",
+    "resolve_dtype",
 ]
+
+
+def dtype_str(dt):
+    """Wire-safe dtype spelling.  ``dtype.str`` round-trips for every
+    builtin numpy dtype, but extension dtypes (``ml_dtypes.bfloat16``,
+    the gradient dtype of bf16 training) stringify as an opaque void
+    (``'<V2'``) that ``np.dtype()`` resolves to raw bytes — a silent
+    corruption, not an error.  For those the registered NAME
+    (``'bfloat16'``) is the round-trippable spelling."""
+    dt = np.dtype(dt)
+    s = dt.str
+    try:
+        if np.dtype(s) == dt:
+            return s
+    except TypeError:
+        pass
+    return dt.name
+
+
+def resolve_dtype(s):
+    """Inverse of :func:`dtype_str` (``np.dtype`` accepts both the
+    ``.str`` and the registered-name spellings)."""
+    return np.dtype(str(s))
 
 
 class Codec(object):
@@ -74,7 +99,7 @@ class NoneCodec(Codec):
 
     def encode(self, arr):
         arr = np.ascontiguousarray(arr)
-        return [arr], {"dtype": arr.dtype.str, "shape": list(arr.shape)}
+        return [arr], {"dtype": dtype_str(arr.dtype), "shape": list(arr.shape)}
 
     def decode(self, parts, meta):
         return parts[0]
@@ -99,7 +124,7 @@ class Int8Codec(Codec):
         scale = amax / 127.0 if amax > 0 else 1.0
         q = np.clip(np.rint(f / scale), -127, 127).astype(np.int8)
         return [q], {
-            "dtype": dtype.str,
+            "dtype": dtype_str(dtype),
             "shape": list(arr.shape),
             "scale": scale,
         }
@@ -107,7 +132,7 @@ class Int8Codec(Codec):
     def decode(self, parts, meta):
         q = parts[0].reshape(meta["shape"])
         out = q.astype(np.float32) * np.float32(meta["scale"])
-        return out.astype(np.dtype(meta["dtype"]), copy=False)
+        return out.astype(resolve_dtype(meta["dtype"]), copy=False)
 
 
 class TopKCodec(Codec):
@@ -139,7 +164,7 @@ class TopKCodec(Codec):
         if n <= self.min_size:
             dense = np.ascontiguousarray(arr)
             return [dense], {
-                "dtype": dtype.str,
+                "dtype": dtype_str(dtype),
                 "shape": list(arr.shape),
                 "dense": True,
             }
@@ -152,14 +177,14 @@ class TopKCodec(Codec):
         vals = np.ascontiguousarray(flat[idx])
         idx = np.ascontiguousarray(idx)
         return [idx, vals], {
-            "dtype": dtype.str,
+            "dtype": dtype_str(dtype),
             "shape": list(arr.shape),
             "k": int(k),
         }
 
     def decode(self, parts, meta):
         shape = meta["shape"]
-        dtype = np.dtype(meta["dtype"])
+        dtype = resolve_dtype(meta["dtype"])
         if meta.get("dense"):
             return parts[0].reshape(shape)
         idx, vals = parts
@@ -239,9 +264,12 @@ class ErrorFeedback(object):
         approx = self.codec.decode(
             [p.copy() for p in parts], meta
         ).astype(np.float32, copy=False)
+        # the residual MUST stay float32: a bf16 residual would round
+        # away exactly the small corrections error feedback exists to
+        # carry (tested in tests/test_compress.py::TestBfloat16)
         self._residual[name] = f - approx
         # the receiver reconstructs in the original dtype
-        meta = dict(meta, dtype=arr.dtype.str)
+        meta = dict(meta, dtype=dtype_str(arr.dtype))
         return parts, meta
 
     def decode(self, parts, meta):

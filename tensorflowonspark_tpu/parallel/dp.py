@@ -351,36 +351,15 @@ class SyncTrainer(object):
             limit = steps_per_execution
             if max_steps is not None:
                 limit = min(limit, max_steps - steps)
-            # collect up to `limit` globally-ready batches; the per-batch
-            # all-hosts barrier keeps the fused count identical on every
-            # host, so no straggler enters a collective alone (a batch a
-            # ready host pulled in the failing round is dropped — the
-            # same data the reference's '90% of steps' trick dropped).
-            group, subs = [], []
             t_feed0 = _time.perf_counter()
-            for _ in range(limit):
-                if columnar:
-                    batch, n = feed.next_arrays(batch_size)
-                    have = n == batch_size and not feed.should_stop()
-                else:
-                    rows = feed.next_batch(batch_size)
-                    have = (
-                        bool(rows)
-                        and len(rows) == batch_size
-                        and not feed.should_stop()
-                    )
-                if not all_hosts_ready(have):
-                    if have:
-                        logger.info("dropping one ready batch at global stop")
-                    logger.info("global stop after %d steps", steps)
-                    stop = True
-                    break
-                if columnar:
-                    group.append(preprocess(batch) if preprocess else batch)
-                else:
-                    group.append(
-                        preprocess(rows) if preprocess else _default_batch(rows)
-                    )
+            group, stop = collect_ready_group(
+                feed, batch_size, limit, columnar=columnar,
+                preprocess=preprocess,
+            )
+            if stop:
+                logger.info("global stop after %d steps", steps)
+            subs = []
+            for _ in group:
                 rng, sub = jax.random.split(rng)
                 subs.append(sub)
             if not group:
@@ -460,6 +439,48 @@ class SyncTrainer(object):
             checkpointer.save(steps, state, wait=True)
             feed.commit_partitions()
         return state
+
+
+def collect_ready_group(feed, batch_size, limit, columnar=False,
+                        preprocess=None):
+    """Collect up to ``limit`` globally-ready batches from a feed.
+
+    The per-batch all-hosts barrier keeps the collected count identical
+    on every host, so no straggler enters a collective alone (a batch a
+    ready host pulled in the failing round is dropped — the same data
+    the reference's '90% of steps' trick dropped).  Shared by
+    :meth:`SyncTrainer.train_on_feed` and the hierarchical plane's
+    :meth:`~tensorflowonspark_tpu.parallel.hier_ps.HierTrainer.
+    train_on_feed` — both tiers stop on the same global agreement.
+
+    Returns ``(group, stopped)``: the ready batches (preprocessed /
+    default-stacked) and whether the global stop fired.
+    """
+    group = []
+    stopped = False
+    for _ in range(limit):
+        if columnar:
+            batch, n = feed.next_arrays(batch_size)
+            have = n == batch_size and not feed.should_stop()
+        else:
+            rows = feed.next_batch(batch_size)
+            have = (
+                bool(rows)
+                and len(rows) == batch_size
+                and not feed.should_stop()
+            )
+        if not all_hosts_ready(have):
+            if have:
+                logger.info("dropping one ready batch at global stop")
+            stopped = True
+            break
+        if columnar:
+            group.append(preprocess(batch) if preprocess else batch)
+        else:
+            group.append(
+                preprocess(rows) if preprocess else _default_batch(rows)
+            )
+    return group, stopped
 
 
 def _default_batch(rows):

@@ -12,6 +12,9 @@ Canonical axis names (used by every strategy module and the models):
 axis      meaning
 ========  =====================================================
 ``data``  pure data parallelism (batch split, grads psum'd)
+``ps``    device-resident PS aggregation (hierarchical gradient
+          plane: in-pod grads psum/reduce-scatter along this axis,
+          see :mod:`tensorflowonspark_tpu.parallel.hier_ps`)
 ``fsdp``  data parallelism with fully-sharded params (zero-3)
 ``model`` tensor parallelism (matmul column/row sharding)
 ``pipe``  pipeline stages (microbatched ppermute loop)
@@ -30,6 +33,7 @@ import math
 logger = logging.getLogger(__name__)
 
 AXIS_DATA = "data"
+AXIS_PS = "ps"
 AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "model"
 AXIS_PIPELINE = "pipe"
@@ -37,9 +41,13 @@ AXIS_SEQ = "seq"
 AXIS_EXPERT = "expert"
 
 #: All known axes in canonical mesh order (DCN-friendly → ICI-hungry).
+#: ``ps`` sits right after ``data``: the in-pod aggregation axis wants
+#: ICI locality but never spans DCN (the hierarchical plane's whole
+#: point is that only a pod leader crosses it).
 CANONICAL_ORDER = (
     AXIS_PIPELINE,
     AXIS_DATA,
+    AXIS_PS,
     AXIS_FSDP,
     AXIS_EXPERT,
     AXIS_SEQ,

@@ -106,8 +106,20 @@ def _sendmsg_all(sock, views):
 
 
 def _part_meta(p):
-    return {"dtype": p.dtype.str, "shape": list(p.shape),
-            "nbytes": int(p.nbytes)}
+    # dtype_str, not .str: extension dtypes (bfloat16) stringify as an
+    # opaque void that np.dtype() resolves to raw bytes
+    return {"dtype": compress_mod.dtype_str(p.dtype),
+            "shape": list(p.shape), "nbytes": int(p.nbytes)}
+
+
+def _payload_view(p):
+    """Byte view of a contiguous payload array.  Extension dtypes
+    (bfloat16) refuse buffer export under their own format code, so
+    fall back to a zero-copy uint8 reinterpret of the same memory."""
+    try:
+        return memoryview(p).cast("B")
+    except (ValueError, TypeError):
+        return memoryview(p.reshape(-1).view(np.uint8))
 
 
 def _send_frame(sock, header, entries):
@@ -123,7 +135,7 @@ def _send_frame(sock, header, entries):
         payloads.extend(parts)
     hb = json.dumps(dict(header, tensors=meta)).encode("utf-8")
     views = [memoryview(struct.pack(">I", len(hb))), memoryview(hb)]
-    views.extend(memoryview(p).cast("B") for p in payloads)
+    views.extend(_payload_view(p) for p in payloads)
     return _sendmsg_all(sock, views)
 
 
@@ -160,7 +172,7 @@ def _recv_part(sock, m):
     frame) is rejected as ConnectionError before any allocation, the
     same posture as the tfrecord codec's corruption checks."""
     try:
-        dtype = np.dtype(str(m["dtype"]))
+        dtype = compress_mod.resolve_dtype(m["dtype"])
         shape = tuple(int(s) for s in m["shape"])
         nbytes = int(m["nbytes"])
     except (KeyError, TypeError, ValueError) as e:
@@ -182,6 +194,11 @@ def recv_msg(sock):
     consumer (the shard's ``update()``, the client's unshard) sees
     plain numpy regardless of what crossed the wire.  Undecodable or
     inconsistent frames raise ``ConnectionError``.
+
+    The returned header carries ``_recv_nbytes`` — the exact wire
+    bytes this frame occupied (length prefix + header + payloads), the
+    receive-side twin of ``send_msg``'s return value; anything the
+    peer put under that key is overwritten after parse.
     """
     (hlen,) = struct.unpack(">I", _recv_exact(sock, 4))
     if hlen > _MAX_HEADER:
@@ -192,11 +209,13 @@ def recv_msg(sock):
         raise ConnectionError("undecodable frame header: {0}".format(e))
     if not isinstance(header, dict):
         raise ConnectionError("frame header is not an object")
+    nbytes = 4 + hlen
     tensors = {}
     for m in header.get("tensors", ()):
         if m.get("codec"):
             codec = compress_mod.get_codec(str(m["codec"]))
             parts = [_recv_part(sock, pm) for pm in m.get("parts", ())]
+            nbytes += sum(int(p.nbytes) for p in parts)
             try:
                 tensors[m["name"]] = codec.decode(parts, m.get("meta") or {})
             except (KeyError, TypeError, ValueError, IndexError) as e:
@@ -204,7 +223,10 @@ def recv_msg(sock):
                     "codec {0} decode failed: {1}".format(m["codec"], e)
                 )
         else:
-            tensors[m["name"]] = _recv_part(sock, m)
+            part = _recv_part(sock, m)
+            nbytes += int(part.nbytes)
+            tensors[m["name"]] = part
+    header["_recv_nbytes"] = nbytes
     return header, tensors
 
 
@@ -258,7 +280,22 @@ class _Adam(object):
         return param - self.lr * mhat / (np.sqrt(vhat) + self.eps)
 
 
-OPTIMIZERS = {"sgd": _SGD, "adagrad": _Adagrad, "adam": _Adam}
+class _Delta(object):
+    """Hierarchical-plane server rule: the pod leader ships parameter
+    DELTAS (local progress since the last synced base, already the
+    product of the pod's own on-device optimizer), and the server folds
+    them straight in — ``param + scale * delta``.  ``scale`` < 1 damps
+    the mixing when many pods push concurrently."""
+
+    def __init__(self, scale=1.0):
+        self.scale = scale
+
+    def update(self, name, param, grad):
+        return param + self.scale * grad
+
+
+OPTIMIZERS = {"sgd": _SGD, "adagrad": _Adagrad, "adam": _Adam,
+              "delta": _Delta}
 
 
 def _build_optimizer(spec):
@@ -351,6 +388,17 @@ class ParamServerShard(object):
         self._stop = threading.Event()
         self._sock = None
         self.addr = None
+        #: hierarchical-plane window ledger: pod id -> last applied
+        #: window sequence.  A push carrying ``pod``/``window`` header
+        #: fields is applied AT MOST ONCE per (pod, window): a re-push
+        #: after a leader failover (the new leader cannot know whether
+        #: its predecessor's in-flight window landed) is answered
+        #: idempotently with the live params instead of double-applying
+        #: the gradient (tests/test_hier_ps.py asserts via applied_log).
+        self.applied_windows = {}
+        #: append-only (pod, window) apply log — test observability for
+        #: the exactly-once contract; bounded by run length in tests.
+        self.applied_log = []
 
     # -- ops -----------------------------------------------------------
 
@@ -375,6 +423,18 @@ class ParamServerShard(object):
         with self._lock:
             if self._opt is None:
                 return {"op": "error", "error": "shard not initialized"}, {}
+            pod, window = header.get("pod"), header.get("window")
+            if pod is not None and window is not None:
+                window = int(window)
+                if window <= self.applied_windows.get(pod, -1):
+                    # duplicate window (leader failover re-push): do NOT
+                    # re-apply; reply with live params so the client
+                    # still advances
+                    return {"op": "push_ok", "dedup": True}, dict(
+                        self._params
+                    )
+                self.applied_windows[pod] = window
+                self.applied_log.append((pod, window))
             for name, grad in tensors.items():
                 p = self._params.get(name)
                 if p is None:
@@ -387,6 +447,16 @@ class ParamServerShard(object):
                 )
             # piggyback fresh params: push+pull in one round trip
             return {"op": "push_ok"}, dict(self._params)
+
+    def _op_window(self, header, tensors):
+        """Last applied hierarchical window for ``pod`` (-1 when the
+        pod never pushed) — what a freshly-elected pod leader resumes
+        its sequence from (docs/communication.md)."""
+        with self._lock:
+            return {
+                "op": "window_ok",
+                "last": self.applied_windows.get(header.get("pod"), -1),
+            }, {}
 
     # -- service loop --------------------------------------------------
 
@@ -412,7 +482,8 @@ class ParamServerShard(object):
             ).start()
 
     def _serve_conn(self, conn):
-        ops = {"init": self._op_init, "pull": self._op_pull, "push": self._op_push}
+        ops = {"init": self._op_init, "pull": self._op_pull,
+               "push": self._op_push, "window": self._op_window}
         reply = _ReplyCompressor()
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -578,11 +649,14 @@ class PSClient(object):
             reply_codec = push.spec() if push is not None else None
         self._reply_views = [dict() for _ in self._socks]
         self._reply_active = False
+        #: wire bytes this client laid on / pulled off each shard
+        #: connection (headers + payloads, both directions; one writer
+        #: per index) — initialized BEFORE the reply negotiation so its
+        #: round trip is accounted too
+        self._sent_bytes = [0] * len(self._socks)
+        self._recv_bytes = [0] * len(self._socks)
         if reply_codec is not None:
             self._negotiate_reply(reply_codec)
-        #: wire bytes this client laid on each shard connection
-        #: (send-side tunnel accounting; one writer per index)
-        self._sent_bytes = [0] * len(self._socks)
         # fleet telemetry: the wire accounting that used to live only
         # in this object now also publishes into the process registry,
         # and push/pull round trips trace as spans (null singletons /
@@ -591,6 +665,7 @@ class PSClient(object):
 
         _reg = _telemetry.get_registry()
         self._m_bytes = _reg.counter("ps.bytes_sent")
+        self._m_bytes_recv = _reg.counter("ps.bytes_recv")
         self._m_trips = _reg.counter("ps.round_trips")
         self._m_rt_hist = _reg.histogram("ps.round_trip_sec")
         self._tracer = _telemetry.get_tracer()
@@ -614,9 +689,10 @@ class PSClient(object):
         (runs before the workers start, so the sockets are free)."""
         spec = compress_mod.get_codec(spec).spec()
         ok = True
-        for s in self._socks:
-            send_msg(s, {"op": "codec", "reply": spec})
+        for i, s in enumerate(self._socks):
+            self._sent_bytes[i] += send_msg(s, {"op": "codec", "reply": spec})
             h, _ = recv_msg(s)
+            self._recv_bytes[i] += h.get("_recv_nbytes", 0)
             if h.get("op") != "codec_ok":
                 ok = False
         if not ok:
@@ -625,9 +701,12 @@ class PSClient(object):
             logger.warning(
                 "reply codec %s rejected by a shard; dense replies", spec
             )
-            for s in self._socks:
-                send_msg(s, {"op": "codec", "reply": None})
-                recv_msg(s)
+            for i, s in enumerate(self._socks):
+                self._sent_bytes[i] += send_msg(
+                    s, {"op": "codec", "reply": None}
+                )
+                h, _ = recv_msg(s)
+                self._recv_bytes[i] += h.get("_recv_nbytes", 0)
         self._reply_active = ok
 
     @property
@@ -635,6 +714,14 @@ class PSClient(object):
         """Total wire bytes laid on the shard connections by the worker
         round trips (headers + payloads, send side)."""
         return sum(self._sent_bytes)
+
+    @property
+    def bytes_recv(self):
+        """Total wire bytes pulled OFF the shard connections (headers +
+        payloads, receive side) — the reply/delta traffic ``bytes_sent``
+        never saw.  Compressed delta replies shrink exactly this number
+        (unit-tested against known payloads in tests/test_ps.py)."""
+        return sum(self._recv_bytes)
 
     def _apply_reply(self, i, header, tensors):
         """Post-process one shard reply: delta-coded tensors are folded
@@ -689,6 +776,8 @@ class PSClient(object):
                     "ps.pull", trace="ps", shard=i, op=op
                 ):
                     h, t = recv_msg(sock)
+                self._recv_bytes[i] += h.get("_recv_nbytes", 0)
+                self._m_bytes_recv.inc(h.get("_recv_nbytes", 0))
                 self._m_trips.inc()
                 self._m_rt_hist.observe(time.perf_counter() - t0)
                 if h.get("op") == "error":
@@ -697,6 +786,7 @@ class PSClient(object):
                     )
                 else:
                     box[0] = self._apply_reply(i, h, t)
+                    box[2] = h
             except Exception as e:  # noqa: BLE001 - delivered to caller
                 box[1] = e
             ev.set()
@@ -799,7 +889,7 @@ class PSClient(object):
         boxes = []
         events = []
         for i in range(len(self._socks)):
-            box = [None, None]  # [reply, error]
+            box = [None, None, None]  # [reply, error, reply header]
             ev = threading.Event()
             boxes.append(box)
             events.append(ev)
@@ -856,18 +946,23 @@ class PSClient(object):
         headers = [{"op": "pull"} for _ in self._socks]
         return self._unshard(self._roundtrip_all(headers, [{}] * len(self._socks)))
 
-    def push_pull(self, grads):
+    def push_pull(self, grads, header_extra=None):
         """Ship gradients, get fresh params back (one async-SGD step)."""
-        return self.push_pull_async(grads).result()
+        return self.push_pull_async(grads, header_extra=header_extra).result()
 
-    def push_pull_async(self, grads):
+    def push_pull_async(self, grads, header_extra=None):
         """Enqueue the push on every shard worker and return a handle;
         ``handle.result()`` blocks for the replies and unshards.  The
         pipelined :class:`AsyncTrainer` uses this to overlap the round
         trip with the next gradient computation without an extra relay
         thread (each hop in the wakeup chain costs a context switch —
         measured on the bench model, a pool-thread relay ate the whole
-        overlap win)."""
+        overlap win).
+
+        ``header_extra`` merges extra JSON-able fields into every
+        shard's push header — the hierarchical plane stamps its
+        ``pod``/``window`` ledger ids this way so the server can
+        dedup leader-failover re-pushes."""
         if self._assignment is None:
             raise RuntimeError(
                 "call init(params_template, optimizer) before pull()/"
@@ -876,11 +971,27 @@ class PSClient(object):
             )
         leaves, _ = _flatten(grads)
         per_shard = self._shard_tensors(leaves)
-        headers = [{"op": "push"} for _ in self._socks]
+        headers = [
+            dict({"op": "push"}, **(header_extra or {}))
+            for _ in self._socks
+        ]
         return _PushHandle(
             self,
             *self._enqueue_all(headers, per_shard, codec=self._push_codec)
         )
+
+    def window_floor(self, pod):
+        """The highest window sequence EVERY shard has applied for
+        ``pod`` (-1 when the pod never pushed) — where a newly-elected
+        pod leader resumes its push sequence.  Taking the min over
+        shards makes a partially-landed window (some shards applied it
+        before the old leader died) get re-pushed everywhere; shards
+        that already applied it dedup by the ledger, so each shard
+        still applies each window exactly once."""
+        headers = [{"op": "window", "pod": pod} for _ in self._socks]
+        boxes, events = self._enqueue_all(headers, [{}] * len(self._socks))
+        self._collect(boxes, events)
+        return min(int((b[2] or {}).get("last", -1)) for b in boxes)
 
     def _join_workers(self):
         self._closed = True
@@ -1070,20 +1181,63 @@ class AsyncTrainer(object):
       max_inflight: bounded-staleness cap for ``overlap`` mode.
       codec / reply_codec / error_feedback: gradient-plane compression,
         forwarded to :class:`PSClient` (docs/communication.md).
+      topology: ``"flat"`` (default — every step crosses the host/TCP
+        wire, the DistBelief shape above) or ``"hierarchical"`` — the
+        two-tier plane (docs/communication.md "Two-tier gradient
+        plane"): per-step gradients aggregate over ICI collectives on
+        the mesh and the PS apply runs as a jitted on-device program
+        against device-resident shard state (NO host readback on the
+        in-pod path); only the pod leader crosses DCN, pushing
+        compressed window deltas at ``push_every`` cadence through
+        this same wire with ``max_inflight`` bounding staleness.
+        Delegates to
+        :class:`tensorflowonspark_tpu.parallel.hier_ps.HierTrainer`;
+        ``mesh``/``pod_id``/``members``/``member_id``/``leader_fn``
+        are forwarded (``pipeline``/``overlap`` do not apply — the
+        in-pod path has nothing to overlap, it never leaves the
+        device).
     """
 
     def __init__(self, loss_fn, ps_addresses,
                  optimizer=("sgd", {"learning_rate": 0.01}),
                  pipeline=True, overlap=False, push_every=1,
                  max_inflight=2, codec=None, reply_codec=None,
-                 error_feedback=True):
+                 error_feedback=True, topology="flat", mesh=None,
+                 pod_id="pod0", members=None, member_id=0,
+                 leader_fn=None):
         import jax
 
         if push_every < 1:
             raise ValueError(
                 "push_every must be >= 1, got {0}".format(push_every)
             )
-        self.client = PSClient(
+        if topology not in ("flat", "hierarchical"):
+            raise ValueError(
+                "topology must be 'flat' or 'hierarchical', got "
+                "{0!r}".format(topology)
+            )
+        self.topology = topology
+        if topology == "hierarchical":
+            # lazy import: hier_ps imports this module for the wire
+            from tensorflowonspark_tpu.parallel import hier_ps
+
+            self._hier = hier_ps.HierTrainer(
+                loss_fn, ps_addresses, optimizer=optimizer, mesh=mesh,
+                push_every=push_every, max_inflight=max_inflight,
+                codec=codec, reply_codec=reply_codec,
+                error_feedback=error_feedback, pod_id=pod_id,
+                members=members, member_id=member_id,
+                leader_fn=leader_fn,
+            )
+            self._client = None
+            self.optimizer = optimizer
+            self.push_every = int(push_every)
+            self.pipeline = False
+            self.overlap = False
+            self._drain = None
+            return
+        self._hier = None
+        self._client = PSClient(
             ps_addresses, codec=codec, reply_codec=reply_codec,
             error_feedback=error_feedback,
         )
@@ -1103,7 +1257,19 @@ class AsyncTrainer(object):
             if self.overlap else None
         )
 
+    @property
+    def client(self):
+        """The live :class:`PSClient` (wire accounting).  Hierarchical
+        topology resolves through the CURRENT leader epoch's link — a
+        failover swaps the underlying connection, and a captured
+        reference would keep reading the dead epoch's counters."""
+        if self._hier is not None:
+            return self._hier.client
+        return self._client
+
     def init(self, params):
+        if self._hier is not None:
+            return self._hier.init(params)
         return self.client.init(params, self.optimizer)
 
     _mean_cache = None
@@ -1141,7 +1307,12 @@ class AsyncTrainer(object):
 
     def step(self, params, batch):
         """One async step; returns fresh params (stale-gradient model:
-        grads computed at ``params`` may land after other workers')."""
+        grads computed at ``params`` may land after other workers').
+        Hierarchical topology: the device-resident state is
+        authoritative, ``params`` is ignored and the returned tree
+        stays on device."""
+        if self._hier is not None:
+            return self._hier.step(batch)
         grads = self._grad_fn(params, batch)
         window = self._accumulate(grads)
         if window is None:
@@ -1173,6 +1344,8 @@ class AsyncTrainer(object):
         freshest params or None.  Call at epoch/export boundaries so
         checkpoints see every shipped gradient.  A partially-filled
         accumulation window is shipped (mean over its actual count)."""
+        if self._hier is not None:
+            return self._hier.drain()
         if self._accum is not None:
             window = self._mean_fn(self._accum_n)(self._accum)
             self._accum, self._accum_n = None, 0
@@ -1192,6 +1365,8 @@ class AsyncTrainer(object):
         return fresh
 
     def stop(self, stop_servers=False):
+        if self._hier is not None:
+            return self._hier.stop(stop_servers=stop_servers)
         try:
             self.drain()
         except Exception:  # noqa: BLE001 - teardown must proceed

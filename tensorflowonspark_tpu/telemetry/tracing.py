@@ -183,6 +183,17 @@ class Tracer(object):
             out = [s for s in out if s.get("trace") == trace]
         return out
 
+    def count(self, name, trace=None):
+        """Number of recorded spans matching the filter — the
+        assertion primitive for MUST-NOT-FIRE contracts (e.g. the
+        hierarchical PS plane's zero-``grad_readback`` invariant,
+        tests/test_hier_ps.py) without materializing the span list."""
+        return sum(
+            1 for s in self._spans
+            if s["name"] == name
+            and (trace is None or s.get("trace") == trace)
+        )
+
     def clear(self):
         self._spans.clear()
 

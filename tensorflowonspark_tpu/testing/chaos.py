@@ -77,6 +77,20 @@ class ChaosPlan(object):
         )
         return self
 
+    def kill_leader(self, at_window):
+        """Kill the hierarchical gradient plane's pod leader the first
+        time its DCN push window sequence reaches ``at_window`` (the
+        fault surfaces as
+        :class:`~tensorflowonspark_tpu.parallel.hier_ps.LeaderKilled`
+        inside the pusher — exactly what a leader death mid-push looks
+        like to the trainer, which must re-elect and resume with no
+        window double-applied and none lost).  Each entry fires once,
+        in plan order."""
+        self.faults.append(
+            {"kind": "kill_leader", "at_window": int(at_window)}
+        )
+        return self
+
     def drop_heartbeats(self, executor_id, beats):
         """Drop the next ``beats`` HEARTBEAT frames of ``executor_id``
         (simulates a network partition of exactly that length)."""
@@ -208,6 +222,41 @@ def heartbeat_chaos_fn(executor_id):
         return False
 
     return drop
+
+
+def hier_leader_fault_fn():
+    """Build the hierarchical trainer's DCN ``fault_fn`` from the plan,
+    or None when no plan orders ``kill_leader`` faults (the common
+    case — one None check of production overhead, like every other
+    plan hook).
+
+    Returns ``fault(window_seq)``: raises ``LeaderKilled`` inside the
+    leader's pusher thread when an armed fault's ``at_window`` is due.
+    Each fault fires once, in plan order — two entries model the
+    SUCCESSOR dying too."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    kills = [f for f in plan.faults if f["kind"] == "kill_leader"]
+    if not kills:
+        return None
+    spent = set()
+
+    def fault(window_seq):
+        from tensorflowonspark_tpu.parallel.hier_ps import LeaderKilled
+
+        for i, f in enumerate(kills):
+            if i not in spent and window_seq >= f["at_window"]:
+                spent.add(i)
+                logger.warning(
+                    "chaos: killing pod leader at DCN window %d per plan",
+                    window_seq,
+                )
+                raise LeaderKilled(
+                    "chaos kill_leader at window {0}".format(window_seq)
+                )
+
+    return fault
 
 
 def serving_wedge_fn():

@@ -133,6 +133,9 @@ def _full_record():
                          "compression_gain": 6.56,
                          "async_vs_sync": 0.599,
                          "async_vs_sync_uncompressed": 0.091,
+                         "hierarchical_steps_per_sec": 94.8,
+                         "hierarchical_wire_kb_per_step": 101.6,
+                         "hier_ps_vs_sync": 0.92,
                          "sync_steps_per_sec": 103.0},
         "serving_cpu": {"rows_per_sec": 34395.2},
         "async_ps": {"async_steps_per_sec": 1135.2},
@@ -162,6 +165,7 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["spec_accept_rate"] == 0.918
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
+    assert parsed["hier_ps_vs_sync"] == 0.92  # two-tier plane (ISSUE 9)
     assert parsed["feed_wire_mb_per_step"] == 0.0512  # narrowed wire
     assert parsed["serving_u8_vs_f32"] == 3.34
     assert parsed["decode_overlap_gain"] == 1.34
@@ -180,7 +184,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "swap_latency_ms", "swap_dropped",
         "serving_prefix_gain", "spec_accept_rate",
         "async_ps_compressed_steps_s",
-        "async_vs_sync", "feed_wire_mb_per_step", "serving_u8_vs_f32",
+        "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",
+        "serving_u8_vs_f32",
         "decode_overlap_gain", "telemetry_overhead_pct", "wall_sec",
         "full_record",
     ])
@@ -241,3 +246,84 @@ def test_unwritable_full_path_still_emits_summary(tmp_path):
     parsed = json.loads(line)
     assert parsed["full_record"] is None
     assert parsed["resnet50_img_s"] == 2675.11
+
+
+# --- bench --compare (per-key deltas + regression gate, ISSUE 9) -------
+
+
+def test_compare_flags_regressions_in_the_right_direction(tmp_path):
+    prev = bench.bench_summary(_full_record())
+    cur = dict(prev)
+    cur["lm_tok_s"] = prev["lm_tok_s"] * 0.8          # throughput DOWN: bad
+    cur["swap_latency_ms"] = prev["swap_latency_ms"] * 2  # latency UP: bad
+    cur["resnet50_img_s"] = prev["resnet50_img_s"] * 1.5  # UP: good
+    cur["wall_sec"] = prev["wall_sec"] * 0.5          # lower-better DOWN: good
+    out = bench.compare_records(prev, cur)
+    assert "lm_tok_s" in out["regressions"]
+    assert "swap_latency_ms" in out["regressions"]
+    assert "resnet50_img_s" not in out["regressions"]
+    assert "wall_sec" not in out["regressions"]
+    # per-key deltas carry prev/cur/pct
+    d = out["deltas"]["lm_tok_s"]
+    assert d["prev"] == prev["lm_tok_s"] and d["cur"] == cur["lm_tok_s"]
+    assert abs(d["pct"] + 20.0) < 0.01
+
+
+def test_compare_within_threshold_is_clean():
+    prev = bench.bench_summary(_full_record())
+    cur = {k: (v * 1.05 if isinstance(v, float) and v else v)
+           for k, v in prev.items()}
+    out = bench.compare_records(prev, cur)
+    assert out["regressions"] == []
+    assert out["compared"] > 5
+
+
+def test_compare_reports_uncomparable_keys():
+    prev = bench.bench_summary(_full_record())
+    cur = dict(prev, lm_tok_s=None)  # row vanished
+    out = bench.compare_records(prev, cur)
+    assert "lm_tok_s" in out["uncomparable"]
+    assert "lm_tok_s" not in out["deltas"]
+
+
+def test_load_compare_record_roundtrips_a_full_record(tmp_path):
+    path = tmp_path / "full.json"
+    path.write_text(json.dumps(_full_record()))
+    got = bench.load_compare_record(str(path))
+    assert got["lm_tok_s"] == 57501.2
+    assert got["hier_ps_vs_sync"] == 0.92
+
+
+def test_load_compare_record_handles_driver_wrapper(tmp_path):
+    # BENCH_r0N.json shape: {n, cmd, rc, tail, parsed} — when the run
+    # predates the summary-line contract, sections are recovered from
+    # the (possibly head-truncated) stdout tail
+    record = _full_record()
+    tail = json.dumps(record)
+    wrapper = {"n": 5, "cmd": "python bench.py", "rc": 0,
+               "tail": tail[-2000:], "parsed": None}
+    path = tmp_path / "BENCH_r0X.json"
+    path.write_text(json.dumps(wrapper))
+    got = bench.load_compare_record(str(path))
+    # the tail ends with async_ps_tpu and the final sections: those
+    # must be recovered; the truncated head ones are simply absent
+    assert got["async_vs_sync"] == 0.599
+    assert got["hier_ps_vs_sync"] == 0.92
+    # and the real anchor the CI gate uses parses too
+    import os
+
+    anchor = os.path.join(os.path.dirname(bench.__file__), "BENCH_r05.json")
+    summary = bench.load_compare_record(anchor)
+    assert any(v is not None for v in summary.values())
+
+
+def test_run_compare_cli_shape(tmp_path):
+    prev = tmp_path / "prev.json"
+    cur = tmp_path / "cur.json"
+    prev.write_text(json.dumps(_full_record()))
+    rec = _full_record()
+    rec["transformer"]["value"] = 1.0  # massive regression
+    cur.write_text(json.dumps(rec))
+    out = bench.run_compare(str(prev), str(cur))
+    assert out["anchor"] == str(prev)
+    assert "lm_tok_s" in out["regressions"]

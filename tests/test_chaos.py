@@ -537,3 +537,110 @@ def test_elastic_kill_resumes_from_checkpoint_with_loss_parity(tmp_path):
     for lc, lk in zip(sorted(clean_losses), sorted(chaos_losses)):
         assert lc < 0.05 and lk < 0.05, (clean_losses, chaos_losses)
         assert abs(lc - lk) < 0.05, (clean_losses, chaos_losses)
+
+
+# ----------------------------------------------------------------------
+# kill-the-leader: the hierarchical gradient plane's chaos family (fast)
+# ----------------------------------------------------------------------
+
+
+def test_hier_leader_fault_fn_arms_from_plan(tmp_path, monkeypatch):
+    from tensorflowonspark_tpu.parallel import hier_ps
+
+    path = chaos.ChaosPlan().kill_leader(at_window=3).save(
+        tmp_path / "p.json"
+    )
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(path))
+    fault = chaos.hier_leader_fault_fn()
+    assert fault is not None
+    fault(2)  # below the window: nothing
+    with pytest.raises(hier_ps.LeaderKilled):
+        fault(3)
+    fault(10)  # spent: fires once
+
+
+def test_hier_leader_fault_fn_absent_without_plan(monkeypatch):
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    assert chaos.hier_leader_fault_fn() is None
+
+
+def test_kill_the_leader_reelects_with_loss_parity(tmp_path, monkeypatch):
+    """The hierarchical-plane kill-and-recover e2e (fast lane: the pod
+    is in-process, the global PS shards and the wire are real).
+
+    The plan kills the pod leader mid-push at DCN window 2; the
+    trainer must re-elect, resume the ledger from the server's applied
+    floor, re-push the dead epoch's pending windows, and converge to
+    the same answer as an unkilled run — with every (pod, window)
+    applied EXACTLY once on every shard and the successor's
+    error-feedback epoch starting clean."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.parallel import hier_ps
+    from tensorflowonspark_tpu.parallel import ps as ps_mod
+
+    target = np.asarray([1.0, -2.0, 0.5, 3.0], np.float32)
+
+    def loss_fn(params, batch):
+        del batch
+        return jnp.sum((params["w"] - target) ** 2)
+
+    def run(with_chaos):
+        servers = [ps_mod.ParamServerShard() for _ in range(2)]
+        addrs = []
+        for s in servers:
+            _, port = s.start("127.0.0.1", 0)
+            addrs.append("127.0.0.1:{0}".format(port))
+        if with_chaos:
+            path = chaos.ChaosPlan().kill_leader(at_window=2).save(
+                tmp_path / "leader_plan.json"
+            )
+            monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, str(path))
+        else:
+            monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+        tr = hier_ps.HierTrainer(
+            loss_fn, addrs,
+            optimizer=("sgd", {"learning_rate": 0.05}),
+            push_every=2, codec="int8", reply_codec="same",
+            members=(0, 1), member_id=0,
+            fault_fn=chaos.hier_leader_fault_fn(),
+        )
+        tr.init({"w": np.zeros(4, np.float32)})
+        for _ in range(80):
+            tr.step(None)
+        out = np.asarray(jax.device_get(tr.drain())["w"])
+        epochs = tr.dcn_epochs()
+        logs = [list(s.applied_log) for s in servers]
+        probe = ps_mod.PSClient(addrs)
+        probe.init({"w": np.zeros(4, np.float32)}, ("delta", {}))
+        srv = np.asarray(probe.pull()["w"])
+        probe.close()
+        tr.stop()
+        for s in servers:
+            s.stop()
+        return out, epochs, logs, srv
+
+    clean, _, _, _ = run(with_chaos=False)
+    killed, epochs, logs, srv = run(with_chaos=True)
+    # loss parity with the unkilled run
+    np.testing.assert_allclose(killed, target, atol=1e-2)
+    np.testing.assert_allclose(killed, clean, atol=1e-2)
+    # the global tier kept tracking the pod THROUGH the failover (the
+    # successor pushes new windows, not just the re-pushed backlog)
+    np.testing.assert_allclose(srv, killed, atol=1e-3)
+    # re-election happened: two leader epochs, successor is member 1
+    assert [e["member"] for e in epochs] == [0, 1]
+    dead, live = epochs
+    # the successor's ledger resumed from the server's applied floor
+    # and drained clean (no window stranded)
+    assert live["resumed_from"] >= 1
+    assert live["pending"] == [] and dead["pending"]
+    # ledger: every (pod, window) applied exactly once per shard, no
+    # gaps — no gradient double-applied, none silently dropped
+    for log in logs:
+        assert len(set(log)) == len(log)
+        seqs = sorted(w for _, w in log)
+        assert seqs == list(range(len(seqs)))

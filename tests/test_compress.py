@@ -504,3 +504,154 @@ def test_drain_surfaces_background_errors(two_shards):
             tr.step({"w": np.ones(4, np.float32)}, None)
         tr.drain()
     tr._drain.stop()
+
+
+# --- bfloat16 gradients (the bf16-training wire, ISSUE 9) --------------
+
+
+class TestBfloat16(object):
+    """bf16 gradient round trips: the codecs were float32-centric, and
+    ``dtype.str`` for the ml_dtypes extension type is an opaque void
+    (``'<V2'``) that silently reinterprets as raw bytes — the wire now
+    spells extension dtypes by their registered NAME."""
+
+    def _bf16(self):
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+
+    def test_dtype_str_roundtrips_bf16(self):
+        bf = self._bf16()
+        s = compress.dtype_str(bf)
+        assert s == "bfloat16"  # NOT '<V2'
+        assert compress.resolve_dtype(s) == bf
+        # builtin dtypes keep the canonical .str spelling
+        assert compress.dtype_str(np.float32) == np.dtype(np.float32).str
+
+    @pytest.mark.parametrize("codec", [
+        compress.NoneCodec(), compress.Int8Codec(),
+        compress.TopKCodec(ratio=0.5, min_size=4),
+    ])
+    def test_codec_roundtrip_preserves_bf16_dtype(self, codec):
+        bf = self._bf16()
+        rng = np.random.RandomState(3)
+        arr = (rng.randn(6, 5) * 2).astype(np.float32).astype(bf)
+        parts, meta = codec.encode(arr)
+        out = codec.decode(parts, meta)
+        assert out.dtype == bf and out.shape == arr.shape
+        if isinstance(codec, compress.TopKCodec):
+            # the kept coordinates round-trip (the dropped half is the
+            # codec's lossiness, not a dtype bug)
+            nz = np.flatnonzero(out.astype(np.float32).ravel())
+            np.testing.assert_allclose(
+                out.astype(np.float32).ravel()[nz],
+                arr.astype(np.float32).ravel()[nz],
+                rtol=1e-2,
+            )
+        else:
+            # quantization error stays bounded in float32 terms
+            err = np.abs(
+                out.astype(np.float32) - arr.astype(np.float32)
+            ).max()
+            assert err <= (
+                np.abs(arr.astype(np.float32)).max() / 64.0 + 1e-6
+            )
+
+    def test_bf16_dense_wire_roundtrip(self):
+        bf = self._bf16()
+        a, b = socket.socketpair()
+        try:
+            g = np.array([1.5, -2.25, 0.125, 7.0], dtype=bf)
+            sent = ps.send_msg(a, {"op": "push"}, {"g": g})
+            header, got = ps.recv_msg(b)
+            assert got["g"].dtype == bf
+            np.testing.assert_array_equal(got["g"], g)
+            # byte accounting symmetric across the two sides
+            assert header["_recv_nbytes"] == sent
+        finally:
+            a.close()
+            b.close()
+
+    def test_bf16_codec_wire_roundtrip(self):
+        bf = self._bf16()
+        a, b = socket.socketpair()
+        try:
+            g = (np.arange(-16, 16, dtype=np.float32) / 4).astype(bf)
+            ps.send_msg(a, {"op": "push"}, {"g": g},
+                        codec=compress.Int8Codec())
+            _, got = ps.recv_msg(b)
+            assert got["g"].dtype == bf
+            np.testing.assert_allclose(
+                got["g"].astype(np.float32), g.astype(np.float32),
+                atol=np.abs(g.astype(np.float32)).max() / 100.0,
+            )
+        finally:
+            a.close()
+            b.close()
+
+    def test_ef_residual_accumulates_in_float32(self):
+        # the EF residual MUST stay float32: a bf16 residual (8 mantissa
+        # bits) would round away exactly the sub-quantization-step
+        # corrections error feedback exists to carry
+        bf = self._bf16()
+        ef = compress.ErrorFeedback(compress.Int8Codec())
+        rng = np.random.RandomState(4)
+        g = (rng.randn(256) * 0.1).astype(np.float32).astype(bf)
+        ef.encode_named("g", g)
+        assert ef._residual["g"].dtype == np.float32
+
+    def test_ef_telescoping_sum_survives_bf16_gradients(self):
+        # sum of decoded messages tracks the sum of true grads at
+        # FLOAT32 precision: the telescoping invariant, with bf16 on
+        # the wire's edges and fp32 in the residual
+        bf = self._bf16()
+        ef = compress.ErrorFeedback(compress.Int8Codec())
+        rng = np.random.RandomState(5)
+        true_sum = np.zeros(128, np.float64)
+        decoded_sum = np.zeros(128, np.float64)
+        for _ in range(50):
+            g = (rng.randn(128) * 0.03).astype(np.float32).astype(bf)
+            parts, meta = ef.encode_named("g", g)
+            # decode at the codec's float32 working precision: the
+            # telescoping property is about what EF tracks, not about
+            # the receiver's (bf16) storage rounding on top of it
+            dec = ef.decode(
+                [p.copy() for p in parts], dict(meta, dtype="<f4")
+            )
+            true_sum += g.astype(np.float64)
+            decoded_sum += dec.astype(np.float64)
+        # the gap IS the final residual (elementwise telescoping), up
+        # to fp32 accumulation noise — NOT 50 steps of bf16 drift
+        np.testing.assert_allclose(
+            (true_sum - decoded_sum).astype(np.float32),
+            ef._residual["g"], atol=5e-5,
+        )
+
+    def test_bf16_residual_would_break_the_invariant(self):
+        # the failure mode the float32 rule prevents, demonstrated:
+        # accumulating the SAME residuals in bf16 loses the small
+        # corrections (documents WHY the dtype rule exists)
+        bf = self._bf16()
+        rng = np.random.RandomState(6)
+        codec = compress.Int8Codec()
+        r32 = np.zeros(128, np.float32)
+        rbf = np.zeros(128, dtype=bf)
+        drift32 = drift_bf = 0.0
+        for _ in range(50):
+            g = (rng.randn(128) * 0.03).astype(np.float32)
+            for kind in ("f32", "bf16"):
+                r = r32 if kind == "f32" else rbf.astype(np.float32)
+                f = g + r
+                parts, meta = codec.encode(f)
+                dec = codec.decode([p.copy() for p in parts], meta)
+                new_r = f - dec
+                if kind == "f32":
+                    r32 = new_r
+                    drift32 = np.abs(new_r).max()
+                else:
+                    rbf = new_r.astype(bf)
+                    drift_bf += np.abs(
+                        new_r - rbf.astype(np.float32)
+                    ).max()
+        # the bf16 path leaks residual every step; fp32 does not
+        assert drift_bf > 0.0
