@@ -1405,6 +1405,7 @@ def telemetry_overhead_bench(train_steps=160, rows_n=24, slots=4,
         return time.perf_counter() - t0
 
     was_enabled = telemetry.enabled()
+    plane = None
     try:
         run_train()     # compile warmup (shared across both modes)
         run_serving()
@@ -1414,7 +1415,42 @@ def telemetry_overhead_bench(train_steps=160, rows_n=24, slots=4,
         telemetry.set_enabled(True)
         train_on = min(run_train(), run_train())
         serve_on = min(run_serving(), run_serving())
+        # fleet health plane (ISSUE 10 acceptance: instrumentation +
+        # scrape loop + SLO engine + straggler detector + exposition
+        # ALL running stays <= 2% vs disabled): same train loop with a
+        # HealthPlane.local scraping this process at 10Hz, one rule
+        # engineered to FIRE (p99 < 1ns never holds) and one quiet
+        # burn-rate rule, and the OpenMetrics endpoint live
+        plane = telemetry.HealthPlane.local(
+            interval=0.1,
+            slo=[
+                {"name": "bench-train-p99",
+                 "metric": "train.step_sec", "stat": "p99",
+                 "op": "<", "threshold": 1e-9, "window": 30},
+                {"name": "bench-serving-errors", "kind": "burn_rate",
+                 "bad": "serving.errors", "total": "serving.completed",
+                 "objective": 0.999, "short_window": 10,
+                 "long_window": 60},
+            ],
+        )
+        plane.start()
+        srv = plane.serve(port=0)
+        train_health = min(run_train(), run_train())
+        # prove the exposition is live + strictly parseable (outside
+        # the timed region)
+        import urllib.request
+
+        with urllib.request.urlopen(
+            srv.url + "/metrics", timeout=10
+        ) as resp:
+            telemetry.parse_openmetrics(resp.read().decode("utf-8"))
+        alerts_fired = telemetry.get_registry().counter(
+            "health.alerts_fired"
+        ).value
+        scrapes = plane.store.scrapes
     finally:
+        if plane is not None:
+            plane.stop()
         telemetry.set_enabled(was_enabled)
 
     def pct(on, off):
@@ -1429,6 +1465,11 @@ def telemetry_overhead_bench(train_steps=160, rows_n=24, slots=4,
         "serving_rows_s_instrumented": round(rows_n / serve_on, 1),
         "serving_rows_s_disabled": round(rows_n / serve_off, 1),
         "serving_overhead_pct": pct(serve_on, serve_off),
+        # the health plane riding on top (scrape + SLO + straggler +
+        # HTTP exposition): total overhead vs disabled telemetry
+        "health_overhead_pct": pct(train_health, train_off),
+        "alerts_fired": int(alerts_fired),
+        "health_scrapes": int(scrapes),
         "platform": __import__("jax").devices()[0].platform,
     }
 
@@ -2680,6 +2721,15 @@ def bench_summary(record):
         "telemetry_overhead_pct": _pluck(
             record, "telemetry_overhead", "overhead_pct"
         ),
+        # fleet health plane (docs/observability.md "Fleet health
+        # plane"): scrape loop + SLO engine + straggler detector +
+        # exposition all running — acceptance bar <= 2%
+        "health_overhead_pct": _pluck(
+            record, "telemetry_overhead", "health_overhead_pct"
+        ),
+        "alerts_fired": _pluck(
+            record, "telemetry_overhead", "alerts_fired"
+        ),
         "wall_sec": record.get("bench_wall_sec"),
     }
 
@@ -2725,7 +2775,8 @@ def emit_record(record, full_path=None):
 #: in bench_summary is a throughput/ratio where bigger is better.
 LOWER_IS_BETTER = frozenset({
     "wall_sec", "swap_latency_ms", "swap_dropped",
-    "telemetry_overhead_pct", "feed_wire_mb_per_step",
+    "telemetry_overhead_pct", "health_overhead_pct", "alerts_fired",
+    "feed_wire_mb_per_step",
 })
 
 

@@ -74,6 +74,10 @@ class ClusterMonitor(object):
         self.dead_executor_id = None
         #: total per-executor generation bumps observed (monotonic)
         self.restart_events = 0
+        #: straggler hints pushed by the fleet health plane
+        #: (telemetry/health.py) — newest per executor; ops tooling and
+        #: the supervisor surface read these alongside the error state
+        self.health_hints = {}
         self._by_id = {n["executor_id"]: n for n in cluster_info}
         self._first_dead = {}
         self._known_gen = {}
@@ -178,6 +182,18 @@ class ClusterMonitor(object):
         if self.error is not None:
             raise DeadExecutorError(self.error, self.dead_executor_id)
 
+    def note_straggler(self, hint):
+        """Record a health-plane straggler hint against this monitor —
+        advisory (nothing is killed): the fleet keeps running while
+        the flagged node is profiled and the operator decides."""
+        self.health_hints[hint["executor"]] = dict(hint)
+        logger.warning(
+            "monitor: health plane flagged executor %s as a straggler "
+            "(dominant phase %r, +%.3fs/step vs the fleet)",
+            hint.get("executor"), hint.get("phase"),
+            hint.get("excess_sec", 0.0),
+        )
+
     def metrics(self):
         """Per-executor telemetry snapshots merged with liveness (the
         in-process half of ``TFCluster.metrics()`` — usable on a bare
@@ -265,6 +281,10 @@ class TPUCluster(object):
         self.elastic = bool(cluster_meta.get("elastic", False))
         #: liveness watcher (started by run(); None in bare-handle tests)
         self.monitor = monitor
+        #: fleet health plane (started by start_health_plane(); stopped
+        #: by shutdown())
+        self.health = None
+        self._profile_seq = itertools.count(1)
 
     # -- data plane ----------------------------------------------------
 
@@ -556,6 +576,12 @@ class TPUCluster(object):
             SIGALRM guard (reference: TFCluster.py:136-144).
         """
         deadline = time.monotonic() + timeout
+        if self.health is not None:
+            self.health.stop()
+            from tensorflowonspark_tpu.telemetry import health as _health
+
+            _health.unregister_status_provider("ledger")
+            self.health = None
         if self.monitor is not None:
             self.monitor.stop()
         workers = [
@@ -807,6 +833,127 @@ class TPUCluster(object):
         )
         view["generation"] = self.server.generation
         return view
+
+    # -- fleet health plane (ISSUE 10; docs/observability.md) ----------
+
+    def start_health_plane(self, port=None, slo=None, interval=None,
+                           window=None, straggler=True,
+                           straggler_opts=None, profile_steps=20,
+                           profile_dir=None):
+        """Start the standing fleet health plane over this cluster.
+
+        Scrapes the monitor's per-executor telemetry (the heartbeat-
+        piggyback path — no new connections to the nodes) every
+        ``interval`` seconds into windowed time series, evaluates the
+        ``slo`` rules (anything
+        :func:`~tensorflowonspark_tpu.telemetry.health.load_rules`
+        accepts), auto-diagnoses stragglers (MAD outliers over
+        per-executor step/feed/wire series, attributed to their
+        dominant phase), and — when a straggler is flagged — fires the
+        PR 7 profiler hook on THAT node only (a ``profile_request`` kv
+        its NodePublisher picks up; ``profile_dir`` defaults to
+        ``/tmp/tfos_health_profiles/<cluster_id>``).
+
+        ``port`` (0 = ephemeral) additionally starts the HTTP
+        exposition surface: ``/metrics`` (OpenMetrics), ``/healthz``
+        (flips 503 on a dead executor), ``/status`` (fleet JSON).
+        Returns the :class:`~tensorflowonspark_tpu.telemetry.health.
+        HealthPlane`; :meth:`shutdown` stops it.
+        """
+        from tensorflowonspark_tpu.telemetry import health as _health
+
+        if self.health is not None:
+            return self.health
+        monitor = self.monitor or ClusterMonitor(
+            self.server, self.cluster_info
+        )
+        if profile_dir is None:
+            import tempfile
+
+            profile_dir = "{0}/tfos_health_profiles/{1}".format(
+                tempfile.gettempdir(), self.cluster_id
+            )
+
+        def on_straggler(hint):
+            monitor.note_straggler(hint)
+            self._request_profile(
+                hint["executor"], steps=profile_steps,
+                log_dir=profile_dir, hint=hint,
+            )
+
+        plane = _health.HealthPlane(
+            monitor.metrics,
+            interval=interval,
+            window=window,
+            slo=slo,
+            straggler=straggler,
+            straggler_opts=straggler_opts,
+            on_straggler=on_straggler,
+            liveness_fn=self.server.liveness.health,
+        )
+        _health.register_status_provider("ledger", self._ledger_status)
+        plane.start()
+        if port is not None:
+            plane.serve(port=port)
+        self.health = plane
+        return plane
+
+    def _ledger_status(self):
+        """Per-worker committed/pending partition counts for
+        ``/status`` (the same numbers ``metrics(include_ledger=True)``
+        merges in)."""
+        out = {}
+        for n in self.cluster_info:
+            if n["job_name"] not in ("worker", "chief", "master"):
+                continue
+            try:
+                m = self._connect(n)
+                out[str(n["executor_id"])] = {
+                    "committed": len(m.ledger("committed")._getvalue()),
+                    "pending": len(m.ledger("pending")._getvalue()),
+                }
+            except Exception:  # noqa: BLE001 - node mid-restart
+                out[str(n["executor_id"])] = {"unreachable": True}
+        return out
+
+    def _request_profile(self, executor_id, steps=20, log_dir=None,
+                         hint=None):
+        """Ask ONE node to capture a device profile: write a sequenced
+        ``profile_request`` into its manager kv — its NodePublisher
+        (telemetry/aggregate.py) starts the PR 7
+        ``tensorboard.start_profile`` hook and acks into
+        ``profile_state``.  Also records the straggler hint in the
+        node's kv so its logs/heartbeats can surface it."""
+        node_meta = next(
+            (n for n in self.cluster_info
+             if n["executor_id"] == int(executor_id)), None,
+        )
+        if node_meta is None:
+            logger.warning(
+                "profile request for unknown executor %s", executor_id
+            )
+            return None
+        req = {
+            "seq": next(self._profile_seq),
+            "log_dir": log_dir,
+            "steps": int(steps) if steps else None,
+        }
+        try:
+            m = self._connect(node_meta)
+            m.set("profile_request", req)
+            if hint is not None:
+                m.set("health_hint", dict(hint))
+        except Exception:  # noqa: BLE001 - node mid-restart: the hint
+            logger.warning(  # stands, the capture is lost
+                "unable to deliver profile request to executor %s",
+                executor_id, exc_info=True,
+            )
+            return None
+        logger.info(
+            "profile request %d delivered to executor %s (%s, %s steps)",
+            req["seq"], executor_id, log_dir, steps,
+        )
+        return req
 
     def tensorboard_url(self):
         """URL of the cluster's tensorboard, if one was launched

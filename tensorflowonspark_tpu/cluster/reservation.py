@@ -194,6 +194,23 @@ class Liveness(object):
                     }
         return out
 
+    def health(self):
+        """The ``/healthz`` summary of this registry (consumed by the
+        fleet health plane's exposition surface,
+        telemetry/exposition.py): healthy iff no tracked executor is
+        currently dead.  Carries the dead set's reasons and the worst
+        heartbeat age so a probe failure names its cause."""
+        dead = self.dead()
+        snap = self.snapshot()
+        ages = [rec["age"] for rec in snap.values()]
+        return {
+            "healthy": not dead,
+            "executors": len(snap),
+            "dead": {str(eid): d["reason"] for eid, d in dead.items()},
+            "max_heartbeat_age": round(max(ages), 3) if ages else None,
+            "deadline": self.deadline,
+        }
+
     def snapshot(self):
         """Last-seen ages + metadata for every tracked executor (the
         LIVENESS query payload)."""

@@ -120,6 +120,7 @@ class Supervisor(object):
         self._stop = threading.Event()
         self._thread = None
         self._chaos_fn = chaos_fn
+        self._hint_logged = False
 
     # -- lifecycle -----------------------------------------------------
 
@@ -181,6 +182,25 @@ class Supervisor(object):
         counters["cluster.restarts"] = self.restarts
         gauges = snap.setdefault("gauges", {})
         gauges["cluster.generation"] = self.generation
+        # the fleet health plane's straggler verdict round-trips: the
+        # driver wrote it into this node's kv (health_hint); flag it
+        # back on the beat so the fleet view shows WHICH node is
+        # flagged even to observers that never query the plane
+        try:
+            hint = self.mgr.get("health_hint")
+            if hasattr(hint, "_getvalue"):
+                hint = hint._getvalue()
+        except Exception:  # noqa: BLE001 - kv is best effort
+            hint = None
+        if isinstance(hint, dict):
+            if not self._hint_logged:
+                self._hint_logged = True
+                logger.warning(
+                    "executor %d flagged as a straggler by the fleet "
+                    "health plane (dominant phase %r)",
+                    self.ctx.executor_id, hint.get("phase"),
+                )
+            gauges["health.straggler"] = 1.0
         return snap
 
     def _proc_alive(self):

@@ -342,6 +342,12 @@ class SyncTrainer(object):
         m_steps = reg.counter("train.steps")
         m_step_hist = reg.histogram("train.step_sec")
         m_feed_hist = reg.histogram("train.feed_wait_sec")
+        # phase twins of the h2d/dispatch spans: the health plane's
+        # straggler detector attributes a slow node to its dominant
+        # phase from these per-executor series (telemetry/health.py
+        # PHASE_METRICS)
+        m_h2d_hist = reg.histogram("train.h2d_sec")
+        m_disp_hist = reg.histogram("train.dispatch_sec")
         import time as _time
 
         stop = False
@@ -375,24 +381,32 @@ class SyncTrainer(object):
             t_step0 = _time.perf_counter()
             trace_id = "step%d" % steps
             if len(group) == 1:
+                t_h2d = _time.perf_counter()
                 with tracer.span("h2d", trace=trace_id):
                     device_batch = sh.shard_batch(
                         group[0], self.mesh, self.data_axes
                     )
+                t_disp = _time.perf_counter()
+                m_h2d_hist.observe(t_disp - t_h2d)
                 with tracer.span("dispatch", trace=trace_id):
                     state, metrics = self.step_on_device(
                         state, device_batch, subs[0]
                     )
+                m_disp_hist.observe(_time.perf_counter() - t_disp)
             else:
                 stacked = jax.tree.map(lambda *xs: np.stack(xs), *group)
+                t_h2d = _time.perf_counter()
                 with tracer.span("h2d", trace=trace_id):
                     device_stacked = sh.shard_batch(
                         stacked, self.mesh, self.data_axes, leading_dims=1
                     )
+                t_disp = _time.perf_counter()
+                m_h2d_hist.observe(t_disp - t_h2d)
                 with tracer.span("dispatch", trace=trace_id):
                     state, metrics = self.multi_step_on_device(
                         state, device_stacked, jnp.stack(subs)
                     )
+                m_disp_hist.observe(_time.perf_counter() - t_disp)
                 metrics = jax.tree.map(lambda m: m[-1], metrics)
             m_step_hist.observe(
                 (_time.perf_counter() - t_step0) / len(group)

@@ -351,6 +351,44 @@ class DcnLink(object):
             name="hier-dcn-%s-m%s" % (self.pod_id, member_id),
         )
         self._thread.start()
+        # fleet health plane: the DCN link's state rides /status
+        # (telemetry/health.py; one slot per pod, latest link wins —
+        # exactly the leader-epoch semantics).  Weakref-bound so a
+        # retired leader epoch's link (and its PSClient sockets) is
+        # never pinned by the provider registry
+        import weakref
+
+        from tensorflowonspark_tpu.telemetry import health as _health
+
+        _ref = weakref.ref(self)
+
+        def _link_status():
+            link = _ref()
+            return (
+                {"retired": True} if link is None
+                else link.health_status()
+            )
+
+        _health.register_status_provider(
+            "hier_ps.%s" % self.pod_id, _link_status
+        )
+
+    def health_status(self):
+        """Compact DCN-link state for the health plane's ``/status``:
+        which member holds the leader duty, how far the window
+        sequence has advanced, and the in-flight backlog."""
+        with self._lock:
+            pending = len(self._pending)
+        return {
+            "pod": self.pod_id,
+            "member": self.member_id,
+            "next_window": self._next_seq,
+            "resumed_from": self.resumed_from,
+            "pushed": len(self._pushed),
+            "acked": len(self._acked),
+            "inflight": pending,
+            "error": str(self.error) if self.error else None,
+        }
 
     # -- lifecycle -----------------------------------------------------
 

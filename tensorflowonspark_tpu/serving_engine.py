@@ -94,6 +94,16 @@ def latency_summary(since=None):
     to scope the summary to one job/bench window (the histogram is
     cumulative across jobs).  Returns zeros when telemetry is disabled
     or nothing was observed.
+
+    **This histogram is the authoritative percentile source** (docs/
+    serving.md "Latency accounting").  Consumers that also keep raw
+    per-request lists (``stats["latency_sec"]``; the bench's
+    ``TFOS_TELEMETRY=0`` fallback) interpolate differently — a raw
+    list nearest-rank percentile vs the histogram's within-bucket
+    linear interpolation — so the two agree only to the geometric
+    bucket width (ratio 1.25, ~±12%; parity-tested at that tolerance
+    in tests/test_serving_engine.py).  Report from here unless
+    telemetry is off.
     """
     snap = latency_histogram().snapshot()
     if since:
@@ -501,6 +511,47 @@ class ServingEngine(object):
         self._exhausted = False
         self._chunk_index = 0
         self._t0 = self._clock()
+        # fleet health plane: this engine's compact state rides the
+        # /status exposition (telemetry/health.py; latest engine wins
+        # the "serving" slot).  Registered through a weakref — every
+        # continuous job builds an engine, and the provider registry
+        # must never keep a finished job's decoder (and its params)
+        # alive
+        import weakref
+
+        from tensorflowonspark_tpu.telemetry import health as _health
+
+        _ref = weakref.ref(self)
+
+        def _serving_status():
+            eng = _ref()
+            return (
+                {"finished": True} if eng is None
+                else eng.health_status()
+            )
+
+        _health.register_status_provider("serving", _serving_status)
+
+    def health_status(self):
+        """Compact serving summary for the health plane's ``/status``
+        route: live load, shed/deadline/watchdog accounting, and the
+        weight-swap lifecycle state."""
+        return {
+            "slots": getattr(self.decoder, "num_slots", None),
+            "in_flight": len(self._slot_req),
+            "queued": len(self._pending),
+            "policy": self.policy,
+            "draining": self._draining,
+            "admitted": self.stats["admitted"],
+            "completed": self.stats["completed"],
+            "shed": self.stats["shed"],
+            "expired": self.stats["expired"],
+            "errors": self.stats["errors"],
+            "watchdog_fires": self.stats["watchdog_fires"],
+            "weight_generation": self.stats["weight_generation"],
+            "swaps": self.stats["swaps"],
+            "rollbacks": self.stats["rollbacks"],
+        }
 
     # -- cross-request reuse accounting --------------------------------
 

@@ -18,7 +18,16 @@ driver.  This package is the one place they all publish now
   merging for the driver's fleet view (counters summed, histograms
   merged bucket-wise, percentiles recomputed) plus the node-side
   publisher that ships snapshots over the heartbeat plane to the
-  reservation server, where ``TFCluster.metrics()`` pulls them.
+  reservation server, where ``TFCluster.metrics()`` pulls them;
+- :mod:`~tensorflowonspark_tpu.telemetry.health` — the standing
+  fleet health plane over the aggregation (ISSUE 10): per-executor
+  time-series ring buffers with windowed queries, declarative SLO
+  rules (thresholds + multiwindow error-budget burn rates) with
+  hysteresis, and straggler auto-diagnosis that names the slow node
+  and its dominant phase and auto-fires the profiler on it;
+- :mod:`~tensorflowonspark_tpu.telemetry.exposition` — the HTTP
+  scrape surface: ``/metrics`` in OpenMetrics text (with the strict
+  parser the tests round-trip through), ``/healthz``, ``/status``.
 
 **Zero-cost-when-disabled**: ``TFOS_TELEMETRY=0`` (or
 ``set_enabled(False)``) makes every registry accessor return a shared
@@ -51,4 +60,20 @@ from tensorflowonspark_tpu.telemetry.aggregate import (  # noqa: F401
     fleet_view,
     merge_snapshots,
     start_node_publisher,
+)
+from tensorflowonspark_tpu.telemetry.health import (  # noqa: F401
+    Alert,
+    HealthPlane,
+    SloEngine,
+    SloRule,
+    StragglerDetector,
+    TimeSeriesStore,
+    load_rules,
+    register_status_provider,
+    unregister_status_provider,
+)
+from tensorflowonspark_tpu.telemetry.exposition import (  # noqa: F401
+    ExpositionServer,
+    parse_openmetrics,
+    to_openmetrics,
 )

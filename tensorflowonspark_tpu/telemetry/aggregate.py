@@ -78,7 +78,10 @@ def merge_snapshots(snapshots):
             key=lambda t: t[0],
         )
         h = {
-            "count": agg["count"], "sum": round(agg["sum"], 9),
+            # exact sum (registry.Histogram.snapshot carries the exact
+            # running sum): the merged mean is sum/count, never
+            # bucket-interpolated
+            "count": agg["count"], "sum": agg["sum"],
             "min": agg["min"], "max": agg["max"], "buckets": triples,
         }
         h["p50"] = _registry.histogram_percentile(h, 50)
@@ -107,9 +110,20 @@ class NodePublisher(object):
     the node manager kv every ``interval`` seconds (step 1 of the
     module-docstring pipeline).  Publication is best-effort: a manager
     hiccup is logged once and retried next interval — telemetry must
-    never take a node down."""
+    never take a node down.
+
+    The same loop is the compute-side pickup of the health plane's
+    **auto-profiler trigger** (ISSUE 10): when the driver's straggler
+    diagnosis flags this node, it writes a sequenced
+    ``profile_request`` into the manager kv; the publisher sees it on
+    its next pass, starts the PR 7 ``tensorboard.start_profile``
+    capture (graceful no-op on builds without the profiler), and acks
+    into ``profile_state`` so the driver/tests can assert the capture
+    was triggered on the flagged node only."""
 
     KV_KEY = "metrics"
+    PROFILE_REQ_KEY = "profile_request"
+    PROFILE_STATE_KEY = "profile_state"
 
     def __init__(self, mgr, interval=None, registry=None):
         self.mgr = mgr
@@ -120,6 +134,7 @@ class NodePublisher(object):
         self._stop = threading.Event()
         self._warned = False
         self._thread = None
+        self._profile_seq = 0
 
     def _snapshot(self):
         reg = self.registry or _registry.get_registry()
@@ -140,9 +155,72 @@ class NodePublisher(object):
                 )
             return False
 
+    def check_profile_request(self):
+        """Start a profiler capture when the driver requested one via
+        the ``profile_request`` kv (sequenced — each request fires
+        once, surviving publisher restarts through the persisted
+        ``profile_state`` ack).  Returns the ack dict when a capture
+        was triggered this call, else None."""
+        try:
+            req = self.mgr.get(self.PROFILE_REQ_KEY)
+            if hasattr(req, "_getvalue"):
+                req = req._getvalue()
+        except Exception:  # noqa: BLE001 - kv may not exist / mgr down
+            return None
+        if not isinstance(req, dict) or not req.get("seq"):
+            return None
+        seq = int(req["seq"])
+        if seq <= self._profile_seq:
+            return None
+        if self._profile_seq == 0:
+            # fresh publisher (process restart): consult the persisted
+            # ack so an already-served request doesn't re-fire
+            try:
+                prev = self.mgr.get(self.PROFILE_STATE_KEY)
+                if hasattr(prev, "_getvalue"):
+                    prev = prev._getvalue()
+                if isinstance(prev, dict) and int(
+                    prev.get("seq", 0)
+                ) >= seq:
+                    self._profile_seq = int(prev["seq"])
+                    return None
+            except Exception:  # noqa: BLE001 - no ack kv yet
+                pass
+        self._profile_seq = seq
+        from tensorflowonspark_tpu import telemetry as _t
+        from tensorflowonspark_tpu import tensorboard as _tb
+
+        log_dir = req.get("log_dir") or "tfos_profile"
+        sub = os.path.join(str(log_dir), str(os.getpid()))
+        sess = _tb.start_profile(sub, req.get("steps"))
+        state = {
+            "seq": seq,
+            "started": sess is not None,
+            "log_dir": sub,
+            "pid": os.getpid(),
+        }
+        try:
+            self.mgr.set(self.PROFILE_STATE_KEY, state)
+        except Exception:  # noqa: BLE001 - ack is observability
+            logger.warning(
+                "unable to ack profile request %d", seq, exc_info=True
+            )
+        reg = self.registry or _registry.get_registry()
+        reg.counter("health.profile_captures").inc()
+        _t.get_tracer().mark(
+            "profile_capture", trace="health", seq=seq,
+            started=state["started"], log_dir=sub,
+        )
+        logger.info(
+            "health plane profile request %d: capture %s into %s",
+            seq, "started" if state["started"] else "unavailable", sub,
+        )
+        return state
+
     def _run(self):
         while not self._stop.wait(self.interval):
             self.publish_once()
+            self.check_profile_request()
         self.publish_once()
 
     def start(self):

@@ -156,7 +156,11 @@ class Histogram(object):
             lo, hi = self._min, self._max
         out = {
             "count": total,
-            "sum": round(s, 9),
+            # the EXACT running sum (never rounded, never re-derived
+            # from buckets): means stay exact — not bucket-interpolated
+            # — through snapshot_delta, merge_snapshots, and the
+            # OpenMetrics `_sum` line (ISSUE 10 satellite)
+            "sum": s,
             "min": lo,
             "max": hi,
             "p50": _percentile_from_counts(counts, self.bounds, total, 50),

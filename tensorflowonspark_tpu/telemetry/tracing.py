@@ -109,6 +109,11 @@ class Tracer(object):
         )
         self._ids = itertools.count(1)
         self._local = threading.local()
+        #: spans evicted by the bounded store (ISSUE 10 satellite):
+        #: truncation must be *visible* — a trace missing its oldest
+        #: spans without this counter reads as "nothing happened"
+        self.dropped_spans = 0
+        self._m_dropped = None
         #: perf_counter at construction — span timestamps are relative
         #: to this epoch (Chrome-trace ``ts`` microseconds)
         self.epoch = time.perf_counter()
@@ -158,6 +163,16 @@ class Tracer(object):
         )
 
     def _record(self, name, trace, span_id, parent, t0, dur, attrs):
+        if len(self._spans) == self._spans.maxlen:
+            # the deque is about to silently evict its oldest span —
+            # count it into the registry so truncation shows up in
+            # snapshot() / the fleet view (tracing.dropped_spans)
+            self.dropped_spans += 1
+            if self._m_dropped is None:
+                self._m_dropped = _registry.get_registry().counter(
+                    "tracing.dropped_spans"
+                )
+            self._m_dropped.inc()
         rec = {
             "name": name,
             "trace": trace,

@@ -26,6 +26,14 @@ that fails must fail the same way every run):
   malformed requests for every admission-validation class; and
   :func:`slow_consumer` stalls the output side the way a slow
   downstream does.
+- **straggler faults** (the health-plane family, ISSUE 10):
+  ``ChaosPlan.slow_executor`` stalls one executor's feed pulls
+  (:func:`slow_feed_fn` + :class:`SlowFeed` — the stall lands in the
+  ``feed`` phase of its telemetry series), and
+  :meth:`TcpGremlin.delay` stalls a live TCP link (the WIRE-phase
+  flavor); the fleet health plane's straggler detector must name the
+  injected node, attribute the right phase, and auto-fire the
+  profiler on it only (tests/test_chaos.py, tests/test_health.py).
 - **swap faults** (the lifecycle family, ISSUE 8):
   :func:`corrupt_checkpoint` inflicts one corrupt-export variant per
   hot-swap validation stage (truncated array file, garbage manifest,
@@ -88,6 +96,24 @@ class ChaosPlan(object):
         in plan order."""
         self.faults.append(
             {"kind": "kill_leader", "at_window": int(at_window)}
+        )
+        return self
+
+    def slow_executor(self, executor_id, per_batch_sec, batches=0):
+        """Make executor ``executor_id`` a STRAGGLER: stall each of its
+        feed pulls by ``per_batch_sec`` (the slow-data-pipeline node a
+        congested NIC or a TcpGremlin ``delay()`` in front of its feed
+        produces — the stall lands in the ``feed`` phase of the health
+        plane's per-executor series).  ``batches=0`` stalls every
+        batch; otherwise only the first ``batches``.  Consumed via
+        :func:`slow_feed_fn` / :class:`SlowFeed` in the user fn under
+        test; the fleet health plane's straggler detector is expected
+        to name this executor and its ``feed`` phase
+        (tests/test_chaos.py)."""
+        self.faults.append(
+            {"kind": "slow_executor", "executor_id": int(executor_id),
+             "per_batch_sec": float(per_batch_sec),
+             "batches": int(batches)}
         )
         return self
 
@@ -197,6 +223,61 @@ def step_fault_fn(ctx):
                 os.kill(os.getpid(), signal.SIGKILL)
 
     return fault
+
+
+def slow_feed_fn(ctx):
+    """Build this executor's straggler-injection hook from the plan,
+    or None when no ``slow_executor`` fault targets it (the common
+    case — one None check of production overhead, like every other
+    plan hook).  Returns ``delay()`` — call it once per feed pull; it
+    sleeps ``per_batch_sec`` while the fault's batch budget lasts.
+    Compose with :class:`SlowFeed` to stall a real feed."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    faults = [
+        f for f in plan.faults
+        if f["kind"] == "slow_executor"
+        and f["executor_id"] == int(ctx.executor_id)
+    ]
+    if not faults:
+        return None
+    import time as _time
+
+    state = {"pulled": 0}
+    per_sec = max(f["per_batch_sec"] for f in faults)
+    budget = max(f["batches"] for f in faults)
+
+    def delay():
+        state["pulled"] += 1
+        if budget and state["pulled"] > budget:
+            return
+        _time.sleep(per_sec)
+
+    return delay
+
+
+class SlowFeed(object):
+    """Wrap a :class:`~tensorflowonspark_tpu.data.feed.DataFeed` so
+    every pull stalls through ``delay_fn`` first — the injection
+    vehicle of :meth:`ChaosPlan.slow_executor` (the stall lands inside
+    the consumer's ``feed_wait`` phase, exactly where a slow data
+    pipeline would).  Everything else proxies to the wrapped feed."""
+
+    def __init__(self, feed, delay_fn):
+        self._feed = feed
+        self._delay = delay_fn
+
+    def next_batch(self, *a, **kw):
+        self._delay()
+        return self._feed.next_batch(*a, **kw)
+
+    def next_arrays(self, *a, **kw):
+        self._delay()
+        return self._feed.next_arrays(*a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(self._feed, name)
 
 
 def heartbeat_chaos_fn(executor_id):
@@ -492,15 +573,18 @@ class TcpGremlin(object):
     client's connect succeeds at the TCP level, then the peer vanishes
     mid-handshake — the hard flavor of refusal to retry correctly);
     ``cut_all`` severs established connections the way a mid-request
-    network partition does.
+    network partition does; ``delay(sec)`` stalls every forwarded
+    chunk by ``sec`` — a congested/far link, the WIRE-phase straggler
+    injection (``delay(0)`` restores full speed).
     """
 
-    def __init__(self, target_addr):
+    def __init__(self, target_addr, delay_sec=0.0):
         self.target_addr = tuple(target_addr)
         self._listener = None
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._refuse = 0
+        self._delay = float(delay_sec)
         self._pairs = []  # live (client_sock, server_sock) pairs
         self.connections = 0  # total accepted (observability for tests)
 
@@ -518,6 +602,12 @@ class TcpGremlin(object):
     def refuse_next(self, n):
         with self._lock:
             self._refuse += int(n)
+
+    def delay(self, sec):
+        """Stall every forwarded chunk by ``sec`` seconds from now on
+        (both directions) — deterministic wire-latency injection."""
+        with self._lock:
+            self._delay = float(sec)
 
     def cut_all(self):
         """Sever every live proxied connection immediately."""
@@ -565,13 +655,18 @@ class TcpGremlin(object):
                     name="gremlin-pipe",
                 ).start()
 
-    @staticmethod
-    def _pipe(src, dst):
+    def _pipe(self, src, dst):
+        import time as _time
+
         try:
             while True:
                 data = src.recv(1 << 16)
                 if not data:
                     break
+                with self._lock:
+                    stall = self._delay
+                if stall:
+                    _time.sleep(stall)
                 dst.sendall(data)
         except OSError:
             pass

@@ -125,6 +125,9 @@ def _full_record():
             "serving_rows_s_instrumented": 610.4,
             "serving_rows_s_disabled": 618.0,
             "serving_overhead_pct": 1.24,
+            "health_overhead_pct": 1.6,
+            "alerts_fired": 1,
+            "health_scrapes": 34,
         },
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
                          "async_compressed_steps_per_sec": 61.7,
@@ -170,6 +173,9 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["serving_u8_vs_f32"] == 3.34
     assert parsed["decode_overlap_gain"] == 1.34
     assert parsed["telemetry_overhead_pct"] == 1.21
+    # health plane (ISSUE 10): scrape+SLO+straggler+exposition riding
+    assert parsed["health_overhead_pct"] == 1.6
+    assert parsed["alerts_fired"] == 1
     assert parsed["wall_sec"] == 741.2
 
 
@@ -186,7 +192,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "async_ps_compressed_steps_s",
         "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",
         "serving_u8_vs_f32",
-        "decode_overlap_gain", "telemetry_overhead_pct", "wall_sec",
+        "decode_overlap_gain", "telemetry_overhead_pct",
+        "health_overhead_pct", "alerts_fired", "wall_sec",
         "full_record",
     ])
 

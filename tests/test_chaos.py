@@ -644,3 +644,235 @@ def test_kill_the_leader_reelects_with_loss_parity(tmp_path, monkeypatch):
         assert len(set(log)) == len(log)
         seqs = sorted(w for _, w in log)
         assert seqs == list(range(len(seqs)))
+
+
+# ----------------------------------------------------------------------
+# straggler injection (ISSUE 10): plan hooks (fast) + health-plane e2e
+# ----------------------------------------------------------------------
+
+
+def test_slow_executor_plan_targets_only_its_executor(tmp_path, monkeypatch):
+    plan = chaos.ChaosPlan().slow_executor(1, 0.02)
+    monkeypatch.setenv(
+        chaos.TFOS_CHAOS_PLAN, plan.save(tmp_path / "plan.json")
+    )
+
+    class Ctx:
+        executor_id = 1
+
+    class Other:
+        executor_id = 0
+
+    assert chaos.slow_feed_fn(Other()) is None  # non-target: no hook
+    delay = chaos.slow_feed_fn(Ctx())
+    assert delay is not None
+    t0 = time.perf_counter()
+    delay()
+    assert time.perf_counter() - t0 >= 0.02
+
+
+def test_slow_executor_batch_budget(tmp_path, monkeypatch):
+    plan = chaos.ChaosPlan().slow_executor(0, 0.02, batches=2)
+    monkeypatch.setenv(
+        chaos.TFOS_CHAOS_PLAN, plan.save(tmp_path / "plan.json")
+    )
+
+    class Ctx:
+        executor_id = 0
+
+    delay = chaos.slow_feed_fn(Ctx())
+    t0 = time.perf_counter()
+    delay()
+    delay()
+    assert time.perf_counter() - t0 >= 0.04
+    t1 = time.perf_counter()
+    delay()  # budget spent: full speed again
+    assert time.perf_counter() - t1 < 0.015
+
+
+def test_slow_feed_wraps_and_proxies():
+    class FakeFeed:
+        marker = "yes"
+
+        def next_batch(self, n):
+            return list(range(n))
+
+        def should_stop(self):
+            return False
+
+    calls = []
+    feed = chaos.SlowFeed(FakeFeed(), lambda: calls.append(1))
+    assert feed.next_batch(3) == [0, 1, 2]
+    assert calls == [1]
+    assert feed.should_stop() is False   # proxied
+    assert feed.marker == "yes"          # attribute passthrough
+
+
+def test_tcp_gremlin_delay_slows_the_wire():
+    # the WIRE-phase straggler flavor: a gremlin delay measurably
+    # stretches a round trip through the proxy, and delay(0) restores
+    import socket
+    import threading as _threading
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+
+    def echo_once():
+        conn, _ = srv.accept()
+        while True:
+            data = conn.recv(1024)
+            if not data:
+                return
+            conn.sendall(data)
+
+    _threading.Thread(target=echo_once, daemon=True).start()
+    gremlin = chaos.TcpGremlin(srv.getsockname())
+    addr = gremlin.start()
+    try:
+        c = socket.create_connection(addr, timeout=5)
+
+        def rtt():
+            t0 = time.perf_counter()
+            c.sendall(b"ping")
+            assert c.recv(1024) == b"ping"
+            return time.perf_counter() - t0
+
+        fast = min(rtt() for _ in range(3))
+        gremlin.delay(0.05)
+        slow = rtt()
+        assert slow >= 0.05  # one direction stalled at least once
+        gremlin.delay(0)
+        assert min(rtt() for _ in range(3)) < 0.04
+        c.close()
+    finally:
+        gremlin.stop()
+        srv.close()
+
+
+def _straggler_train_fn(args, ctx):
+    """Feed-consuming loop publishing the REAL per-executor telemetry
+    the health plane scrapes (train.step_sec / feed_wait_sec / steps),
+    with the chaos straggler hook wrapping the feed — the stall lands
+    inside feed_wait exactly like a slow data pipeline."""
+    import time as _t
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import telemetry, tensorboard
+    from tensorflowonspark_tpu.testing import chaos as _chaos
+
+    reg = telemetry.get_registry()
+    h_step = reg.histogram("train.step_sec")
+    h_feed = reg.histogram("train.feed_wait_sec")
+    steps = reg.counter("train.steps")
+    feed = ctx.get_data_feed(train_mode=True)
+    delay = _chaos.slow_feed_fn(ctx)
+    if delay is not None:
+        feed = _chaos.SlowFeed(feed, delay)
+    while not feed.should_stop():
+        t0 = _t.perf_counter()
+        rows = feed.next_batch(4)
+        h_feed.observe(_t.perf_counter() - t0)
+        if not rows:
+            continue
+        t1 = _t.perf_counter()
+        float(np.sum(np.asarray(rows, dtype=np.float64)))
+        _t.sleep(0.004)
+        h_step.observe(_t.perf_counter() - t1)
+        steps.inc()
+        # feeds the auto-triggered capture so its step budget finishes
+        # while batches still flow (dp.train_on_feed does the same)
+        tensorboard.profile_step()
+
+
+@pytest.mark.slow
+def test_straggler_e2e_flagged_attributed_and_profiled(tmp_path):
+    """Acceptance (ISSUE 10): an injected slow executor is flagged
+    within one evaluation window, attributed to the FEED phase, and a
+    profiler capture is triggered on that node only."""
+    import threading as _threading
+
+    from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+    from tensorflowonspark_tpu.cluster.cluster import InputMode
+    from tensorflowonspark_tpu.engine import LocalEngine
+
+    plan = chaos.ChaosPlan().slow_executor(1, 0.08)
+    env = plan.env(plan.save(tmp_path / "plan.json"))
+    env["TFOS_TELEMETRY_PUBLISH_INTERVAL"] = "0.2"
+    env["TFOS_TELEMETRY"] = "1"
+    prof_dir = str(tmp_path / "prof")
+    engine = LocalEngine(2, env=env, deterministic=True)
+    try:
+        cluster = tpu_cluster.run(
+            engine, _straggler_train_fn, args={}, num_executors=2,
+            input_mode=InputMode.SPARK, heartbeat_interval=0.5,
+        )
+        window = 20.0
+        plane = cluster.start_health_plane(
+            interval=0.5, profile_steps=3, profile_dir=prof_dir,
+            straggler_opts={
+                "window": window, "min_samples": 5, "ratio": 2.0,
+            },
+        )
+        flag_at = {}
+
+        def watch():
+            while not flag_at and not plane._stop.is_set():
+                if plane.hints:
+                    flag_at["t"] = time.monotonic()
+                    return
+                time.sleep(0.1)
+
+        watcher = _threading.Thread(target=watch, daemon=True)
+        t_start = time.monotonic()
+        watcher.start()
+        # enough work that the slow node is still feeding well past
+        # detection: exec 1 runs ~30 batches/partition x 4 at 80ms+
+        parts = [[float(i) for i in range(120)] for _ in range(8)]
+        cluster.train(parts, feed_timeout=120)
+        # detection + the profile ack need a few more beats
+        deadline = time.monotonic() + 20
+        state1 = None
+        while time.monotonic() < deadline:
+            if plane.hints and state1 is not None:
+                break
+            node1 = next(
+                n for n in cluster.cluster_info
+                if n["executor_id"] == 1
+            )
+            try:
+                v = cluster._connect(node1).get(
+                    "profile_state"
+                )._getvalue()
+                if isinstance(v, dict):
+                    state1 = v
+            except Exception:
+                pass
+            time.sleep(0.3)
+
+        # 1) flagged, the RIGHT node, the RIGHT phase, within a window
+        assert plane.hints, "straggler never flagged"
+        assert set(plane.hints) == {1}
+        hint = plane.hints[1]
+        assert hint["phase"] == "feed", hint
+        assert flag_at["t"] - t_start <= window + 10.0
+        # the monitor surfaced the same hint
+        assert cluster.monitor.health_hints[1]["phase"] == "feed"
+
+        # 2) the profiler fired on the flagged node ONLY
+        assert state1 is not None, "profile request never acked"
+        assert state1["seq"] >= 1
+        node0 = next(
+            n for n in cluster.cluster_info if n["executor_id"] == 0
+        )
+        v0 = cluster._connect(node0).get("profile_state")._getvalue()
+        assert v0 is None, "profiler fired on the healthy node too"
+        if state1.get("started"):
+            # the capture landed on disk (graceful-degradation builds
+            # report started=False instead)
+            assert os.path.isdir(state1["log_dir"])
+
+        cluster.shutdown(grace_secs=1, timeout=60)
+    finally:
+        engine.stop()

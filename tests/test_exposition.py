@@ -1,0 +1,289 @@
+"""Exposition-surface tests (ISSUE 10 tentpole, part b).
+
+The OpenMetrics text mapping round-trips through the STRICT parser
+(acceptance), the parser rejects every format violation a collector
+would choke on, and the HTTP server's three routes behave: `/metrics`
+parses, `/healthz` flips 503 on an injected dead-executor heartbeat
+(acceptance), `/status` carries the fleet summary + registered
+subsystem providers.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import exposition, health
+from tensorflowonspark_tpu.telemetry.registry import MetricsRegistry
+
+
+def _sample_registry():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("serving.admitted").inc(42)
+    reg.counter("train.steps").inc(7)
+    reg.gauge("serving.weight_generation").set(3)
+    h = reg.histogram("serving.request_latency_sec")
+    for v in (0.002, 0.004, 0.011, 0.7, 1.5, 1.5, 0.03):
+        h.observe(v)
+    return reg
+
+
+class TestOpenMetricsMapping:
+    def test_round_trip_through_strict_parser(self):
+        # acceptance: /metrics output round-trips a strict parser
+        snap = _sample_registry().snapshot()
+        text = exposition.to_openmetrics(snap)
+        fams = exposition.parse_openmetrics(text)
+        assert fams["serving_admitted"]["type"] == "counter"
+        (_n, _l, v), = fams["serving_admitted"]["samples"]
+        assert v == 42
+        assert fams["serving_weight_generation"]["type"] == "gauge"
+        hist = fams["serving_request_latency_sec"]
+        assert hist["type"] == "histogram"
+        by_name = {}
+        for name, labels, value in hist["samples"]:
+            by_name.setdefault(name, []).append((labels, value))
+        # exact-sum satellite: _sum is the exact running sum (full
+        # float precision through the text format), _count the total
+        (_l1, total), = by_name["serving_request_latency_sec_count"]
+        assert total == 7
+        (_l2, s), = by_name["serving_request_latency_sec_sum"]
+        assert s == pytest.approx(
+            0.002 + 0.004 + 0.011 + 0.7 + 1.5 + 1.5 + 0.03, rel=0, abs=0
+        )
+        # +Inf bucket == _count
+        buckets = by_name["serving_request_latency_sec_bucket"]
+        assert buckets[-1][0]["le"] == "+Inf"
+        assert buckets[-1][1] == total
+
+    def test_fleet_merge_round_trips_too(self):
+        snaps = [_sample_registry().snapshot() for _ in range(3)]
+        merged = telemetry.merge_snapshots(snaps)
+        fams = exposition.parse_openmetrics(
+            exposition.to_openmetrics(merged)
+        )
+        (_n, _l, v), = fams["serving_admitted"]["samples"]
+        assert v == 3 * 42
+
+    def test_sanitize(self):
+        assert exposition.sanitize_name("a.b-c/d") == "a_b_c_d"
+        assert exposition.sanitize_name("train.steps") == "train_steps"
+        # a leading digit is not a legal metric name start
+        assert exposition.sanitize_name("9lives").startswith("_")
+
+    def test_empty_snapshot_is_valid(self):
+        text = exposition.to_openmetrics(
+            {"counters": {}, "gauges": {}, "histograms": {}}
+        )
+        assert exposition.parse_openmetrics(text) == {}
+
+
+class TestStrictParserRejections:
+    def _good(self):
+        return exposition.to_openmetrics(_sample_registry().snapshot())
+
+    def test_missing_eof(self):
+        text = self._good().replace("# EOF\n", "")
+        with pytest.raises(ValueError, match="EOF"):
+            exposition.parse_openmetrics(text)
+
+    def test_mid_text_eof(self):
+        text = "# EOF\n" + self._good()
+        with pytest.raises(ValueError, match="before the end"):
+            exposition.parse_openmetrics(text)
+
+    def test_sample_without_type_declaration(self):
+        with pytest.raises(ValueError, match="no TYPE"):
+            exposition.parse_openmetrics("mystery_total 3\n# EOF\n")
+
+    def test_counter_without_total_suffix(self):
+        text = "# TYPE c counter\nc 3\n# EOF\n"
+        with pytest.raises(ValueError, match="_total"):
+            exposition.parse_openmetrics(text)
+
+    def test_histogram_missing_inf_bucket(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1.0"} 2\n'
+            "h_sum 0.5\nh_count 2\n# EOF\n"
+        )
+        with pytest.raises(ValueError, match=r"\+Inf"):
+            exposition.parse_openmetrics(text)
+
+    def test_histogram_non_cumulative_buckets(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1.0"} 5\n'
+            'h_bucket{le="2.0"} 3\n'
+            'h_bucket{le="+Inf"} 5\n'
+            "h_sum 0.5\nh_count 5\n# EOF\n"
+        )
+        with pytest.raises(ValueError, match="cumulative"):
+            exposition.parse_openmetrics(text)
+
+    def test_histogram_inf_disagrees_with_count(self):
+        text = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1.0"} 2\n'
+            'h_bucket{le="+Inf"} 2\n'
+            "h_sum 0.5\nh_count 3\n# EOF\n"
+        )
+        with pytest.raises(ValueError, match="_count"):
+            exposition.parse_openmetrics(text)
+
+    def test_bad_value(self):
+        text = "# TYPE c counter\nc_total banana\n# EOF\n"
+        with pytest.raises(ValueError, match="value"):
+            exposition.parse_openmetrics(text)
+
+    def test_bad_label(self):
+        text = '# TYPE h histogram\nh_bucket{le=1} 2\n# EOF\n'
+        with pytest.raises(ValueError, match="sample line|label"):
+            exposition.parse_openmetrics(text)
+
+    def test_duplicate_type(self):
+        text = "# TYPE c counter\n# TYPE c counter\nc_total 1\n# EOF\n"
+        with pytest.raises(ValueError, match="duplicate"):
+            exposition.parse_openmetrics(text)
+
+
+# ----------------------------------------------------------------------
+# HTTP server routes
+# ----------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+class TestHttpRoutes:
+    @pytest.fixture
+    def plane(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("train.steps").inc(5)
+        p = health.HealthPlane.local(registry=reg, interval=60)
+        p.scrape_once()
+        srv = p.serve(port=0)
+        yield p, srv
+        p.stop()
+
+    def test_metrics_parses(self, plane):
+        p, srv = plane
+        code, body = _get(srv.url + "/metrics")
+        assert code == 200
+        fams = exposition.parse_openmetrics(body)
+        assert "train_steps" in fams
+
+    def test_status_json(self, plane):
+        p, srv = plane
+        code, body = _get(srv.url + "/status")
+        assert code == 200
+        status = json.loads(body)
+        assert status["scrapes"] >= 1
+        assert "0" in status["executors"]
+        assert "providers" in status
+
+    def test_404(self, plane):
+        _p, srv = plane
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv.url + "/nope")
+        assert e.value.code == 404
+
+    def test_healthz_healthy_without_liveness_source(self, plane):
+        _p, srv = plane
+        code, body = _get(srv.url + "/healthz")
+        assert code == 200
+        assert json.loads(body)["healthy"] is True
+
+
+class TestHealthzFlip:
+    def test_dead_executor_heartbeat_flips_healthz(self):
+        # acceptance: /healthz flips on an injected dead-executor
+        # heartbeat — the node reports compute_alive=False, liveness
+        # declares it dead immediately, the probe goes 503
+        from tensorflowonspark_tpu.cluster import reservation
+
+        server = reservation.Server(1, heartbeat_interval=0.2)
+        addr = server.start()
+        plane = health.HealthPlane.local(
+            interval=60, liveness_fn=server.liveness.health
+        )
+        srv = plane.serve(port=0)
+        try:
+            client = reservation.Client(addr)
+            client.heartbeat(0, compute_alive=True, host="n0")
+            code, body = _get(srv.url + "/healthz")
+            assert code == 200
+            assert json.loads(body)["healthy"] is True
+
+            client.heartbeat(0, compute_alive=False, host="n0")
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _get(srv.url + "/healthz")
+            assert e.value.code == 503
+            hz = json.loads(e.value.read().decode("utf-8"))
+            assert hz["healthy"] is False
+            assert any("executor 0" in r for r in hz["reasons"])
+            assert "compute process dead" in hz["liveness"]["dead"]["0"]
+
+            # recovery: the node beats alive again -> 200
+            client.heartbeat(0, compute_alive=True, host="n0")
+            code, _body = _get(srv.url + "/healthz")
+            assert code == 200
+            client.close()
+        finally:
+            plane.stop()
+            server.stop()
+
+    def test_reservation_server_plane(self):
+        # the "optionally the reservation server" deployment: a plane
+        # built straight on a bare rendezvous server exposes the
+        # snapshots its MetricsStore collected over heartbeats
+        from tensorflowonspark_tpu.cluster import reservation
+
+        server = reservation.Server(1)
+        addr = server.start()
+        try:
+            reg = MetricsRegistry(enabled=True)
+            reg.counter("worker.rows").inc(11)
+            client = reservation.Client(addr)
+            client.heartbeat(0, metrics=reg.snapshot(), host="n0")
+            client.close()
+            plane = health.HealthPlane.for_reservation_server(
+                server, interval=60
+            )
+            plane.scrape_once()
+            srv = plane.serve(port=0)
+            try:
+                code, body = _get(srv.url + "/metrics")
+                assert code == 200
+                fams = exposition.parse_openmetrics(body)
+                (_n, _l, v), = fams["worker_rows"]["samples"]
+                assert v == 11
+            finally:
+                plane.stop()
+        finally:
+            server.stop()
+
+
+def test_page_severity_alert_flips_healthz():
+    # healthz merges the SLO engine: a firing page-severity alert is
+    # an unhealthy fleet even with every heartbeat green
+    reg = MetricsRegistry(enabled=True)
+    reg.histogram("serving.request_latency_sec").observe(5.0)
+    plane = health.HealthPlane.local(
+        registry=reg,
+        interval=60,
+        slo=[{
+            "name": "latency-page",
+            "metric": "serving.request_latency_sec",
+            "stat": "p99", "op": "<", "threshold": 0.001,
+            "window": 300, "severity": "page",
+        }],
+    )
+    plane.scrape_once()
+    hz = plane.healthz()
+    assert hz["healthy"] is False
+    assert any("latency-page" in r for r in hz["reasons"])

@@ -1,0 +1,679 @@
+"""Fleet health-plane tests (ISSUE 10 tentpole, parts a/c/d).
+
+Time-series store (delta frames, windowed queries, counter-reset and
+staleness rules), SLO rule grammar + burn-rate evaluation with
+hysteresis, straggler detection with per-phase attribution, and the
+HealthPlane scrape loop incl. the auto-profiler trigger path.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.telemetry import health
+from tensorflowonspark_tpu.telemetry.registry import MetricsRegistry
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+def _snap(counters=None, hists=None, gauges=None):
+    """Build a registry snapshot: hists maps name -> list of values."""
+    reg = MetricsRegistry(enabled=True)
+    for name, v in (counters or {}).items():
+        reg.counter(name).inc(v)
+    for name, v in (gauges or {}).items():
+        reg.gauge(name).set(v)
+    for name, values in (hists or {}).items():
+        h = reg.histogram(name)
+        for v in values:
+            h.observe(v)
+    return reg.snapshot()
+
+
+# ----------------------------------------------------------------------
+# time-series store
+# ----------------------------------------------------------------------
+
+
+class TestTimeSeriesStore:
+    def test_delta_frames_and_windowed_sums(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=10, clock=clock)
+        st.append(0, _snap(counters={"c": 5}))
+        clock.tick(2)
+        st.append(0, _snap(counters={"c": 9}))
+        assert st.sum_over("c") == 9  # 5 + (9-5)
+        assert st.rate("c", window=10) == pytest.approx(9 / 2.0)
+        assert st.executors() == [0]
+
+    def test_counter_reset_uses_post_reset_value(self):
+        # an executor restart zeroes its registry: cur < base must be
+        # read as a reset (delta = cur), never a negative rate
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        st.append(0, _snap(counters={"c": 100}))
+        clock.tick()
+        st.append(0, _snap(counters={"c": 3}))  # restarted, did 3 more
+        assert st.sum_over("c") == 103
+
+    def test_histogram_reset_uses_post_reset_snapshot(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        st.append(0, _snap(hists={"h": [0.1] * 50}))
+        clock.tick()
+        st.append(0, _snap(hists={"h": [0.2, 0.2]}))
+        h = st.hist_over("h")
+        assert h["count"] == 52  # 50 + the 2 post-reset, none negative
+
+    def test_out_of_window_frames_excluded(self):
+        # the staleness rule: frames older than the window must not
+        # leak into (= double-count in) windowed queries
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=10, clock=clock)
+        st.append(0, _snap(counters={"c": 5}))
+        clock.tick(60)
+        st.append(0, _snap(counters={"c": 8}))
+        clock.tick(1)
+        st.append(0, _snap(counters={"c": 9}))
+        assert st.sum_over("c", window=10) == 4  # only the 3+1 recent
+        assert st.sum_over("c", window=1000) == 9
+
+    def test_ring_is_bounded(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=1e6, max_frames=5, clock=clock)
+        for i in range(50):
+            clock.tick()
+            st.append(0, _snap(counters={"c": i + 1}))
+        assert len(st.frames(0, window=1e6)) == 5
+        assert st.scrapes == 50
+
+    def test_windowed_percentile_and_exact_mean(self):
+        import numpy as np
+
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        values = [0.001 * (i + 1) for i in range(200)]
+        # ship in 4 cumulative snapshots (the wire shape)
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("lat")
+        for i, v in enumerate(values):
+            h.observe(v)
+            if (i + 1) % 50 == 0:
+                clock.tick()
+                st.append(0, reg.snapshot())
+        got = st.p99_over("lat", window=100)
+        want = float(np.percentile(np.asarray(values), 99))
+        assert got == pytest.approx(want, rel=0.15)
+        # exact-sum satellite: the windowed mean is sum/count, exact
+        assert st.mean_over("lat", window=100) == pytest.approx(
+            sum(values) / len(values), rel=0, abs=1e-12
+        )
+
+    def test_gauge_last_and_series(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        st.append(0, _snap(gauges={"g": 2.0}, counters={"c": 1}))
+        st.append(1, _snap(gauges={"g": 7.0}))
+        clock.tick()
+        st.append(0, _snap(gauges={"g": 3.0}, counters={"c": 4}))
+        assert st.gauge_last("g") == 7.0  # fleet rule: max
+        assert st.gauge_last("g", executor=0) == 3.0
+        pts = st.series("c", executor=0, kind="counter")
+        assert [v for _t, v in pts] == [1, 3]
+        gpts = st.series("g", executor=0, kind="gauge")
+        assert [v for _t, v in gpts] == [2.0, 3.0]
+
+    def test_disjoint_metric_sets_across_executors(self):
+        # heterogeneous-fleet satellite: executors reporting disjoint
+        # metric sets merge without cross-contamination or crash
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        st.append(0, _snap(counters={"a": 1}))
+        st.append(1, _snap(counters={"b": 2}, hists={"h": [0.1]}))
+        st.append(2, {})          # empty delta — ignored
+        st.append(3, None)        # falsy — ignored
+        assert st.sum_over("a") == 1
+        assert st.sum_over("b") == 2
+        assert st.sum_over("a", executor=1) == 0
+        assert st.hist_over("h")["count"] == 1
+        assert st.executors() == [0, 1]
+
+
+class TestMergeHeterogeneous:
+    """merge_snapshots with the inputs a real fleet produces
+    (ISSUE 10 satellite)."""
+
+    def test_disjoint_empty_and_stale(self):
+        a = _snap(counters={"x": 1}, hists={"h": [0.1, 0.2]})
+        b = _snap(counters={"y": 5})
+        stale = _snap(counters={"x": 7})  # an old snapshot: merged
+        # views weight it once — merging is by-value, never by-age
+        merged = telemetry.merge_snapshots([a, b, None, {}, stale])
+        assert merged["counters"] == {"x": 8, "y": 5}
+        assert merged["histograms"]["h"]["count"] == 2
+        # exact mean through the merge
+        assert merged["histograms"]["h"]["mean"] == pytest.approx(
+            0.15, rel=0, abs=1e-12
+        )
+
+    def test_histogram_without_buckets_key(self):
+        # a NULL histogram snapshot ({"count": 0, "sum": 0.0,
+        # "buckets": []}) and a bucketless dict both merge harmlessly
+        merged = telemetry.merge_snapshots([
+            {"histograms": {"h": {"count": 0, "sum": 0.0, "buckets": []}}},
+            {"histograms": {"h": {"count": 0, "sum": 0.0}}},
+            _snap(hists={"h": [0.3]}),
+        ])
+        assert merged["histograms"]["h"]["count"] == 1
+
+    def test_merge_of_windowed_deltas_no_double_count(self):
+        # the store's hist_over is a merge of per-frame deltas: the
+        # same observation must appear exactly once however the frames
+        # are cut
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("h")
+        total = 0
+        for k in range(5):
+            for _ in range(10):
+                h.observe(0.01)
+                total += 1
+            clock.tick()
+            st.append(0, reg.snapshot())
+        assert st.hist_over("h", window=100)["count"] == total
+
+
+# ----------------------------------------------------------------------
+# SLO rules
+# ----------------------------------------------------------------------
+
+
+class TestRuleGrammar:
+    def test_load_rules_from_list_dict_json_yaml(self, tmp_path):
+        spec = [{"name": "r1", "metric": "m", "stat": "p99",
+                 "op": "<", "threshold": 0.5, "window": 30}]
+        assert len(health.load_rules(spec)) == 1
+        assert len(health.load_rules({"rules": spec})) == 1
+        jpath = tmp_path / "slo.json"
+        jpath.write_text(json.dumps({"rules": spec}))
+        assert len(health.load_rules(str(jpath))) == 1
+        ypath = tmp_path / "slo.yaml"
+        ypath.write_text(
+            "rules:\n"
+            "  - name: serving-p99\n"
+            "    metric: serving.request_latency_sec\n"
+            "    stat: p99\n"
+            "    op: \"<\"\n"
+            "    threshold: 0.5\n"
+            "    window: 30\n"
+            "  - name: errors\n"
+            "    kind: burn_rate\n"
+            "    bad: serving.errors\n"
+            "    total: serving.completed\n"
+            "    objective: 0.999\n"
+        )
+        rules = health.load_rules(str(ypath))
+        assert [r.name for r in rules] == ["serving-p99", "errors"]
+        assert rules[0].threshold == 0.5
+        assert rules[1].kind == "burn_rate"
+        assert rules[1].budget == pytest.approx(0.001)
+
+    def test_restricted_yaml_fallback_parser(self):
+        # the no-dependency parser directly (PyYAML, when installed,
+        # takes precedence at runtime but must not be required)
+        parsed = health._parse_restricted_yaml_fallback(
+            "# a comment\n"
+            "rules:\n"
+            "  - name: a\n"
+            "    threshold: 1.5   # trailing comment\n"
+            "    flag: true\n"
+            "  - name: 'b'\n"
+            "    window: 30\n"
+        )
+        assert parsed == {"rules": [
+            {"name": "a", "threshold": 1.5, "flag": True},
+            {"name": "b", "window": 30},
+        ]}
+
+    def test_bad_specs_raise(self):
+        with pytest.raises(ValueError, match="unknown op"):
+            health.SloRule({"name": "x", "metric": "m", "op": "~",
+                            "threshold": 1})
+        with pytest.raises(ValueError, match="unknown keys"):
+            health.SloRule({"name": "x", "metric": "m", "threshold": 1,
+                            "bogus": 2})
+        with pytest.raises(ValueError, match="objective"):
+            health.SloRule({"name": "x", "kind": "burn_rate",
+                            "bad": "b", "total": "t", "objective": 1.5})
+        with pytest.raises(ValueError, match="'bad' or 'good'"):
+            health.SloRule({"name": "x", "kind": "burn_rate",
+                            "total": "t", "objective": 0.99})
+        with pytest.raises(ValueError, match="duplicate"):
+            health.SloEngine(
+                health.TimeSeriesStore(),
+                [{"name": "d", "metric": "m", "threshold": 1},
+                 {"name": "d", "metric": "m", "threshold": 2}],
+            )
+
+
+def _store_with(clock, frames):
+    """frames: list of per-scrape {"counters"/"hists"} kwargs dicts."""
+    st = health.TimeSeriesStore(window=1000, clock=clock)
+    reg = MetricsRegistry(enabled=True)
+    for kw in frames:
+        for name, v in kw.get("counters", {}).items():
+            reg.counter(name).inc(v)
+        for name, values in kw.get("hists", {}).items():
+            h = reg.histogram(name)
+            for v in values:
+                h.observe(v)
+        clock.tick()
+        st.append(0, reg.snapshot())
+    return st
+
+
+class TestSloEngine:
+    def test_threshold_fire_and_hysteresis_resolve(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=5, clock=clock)
+        reg = MetricsRegistry(enabled=True)
+        lat = reg.histogram("lat")
+        eng = health.SloEngine(st, [
+            {"name": "lat-p99", "metric": "lat", "stat": "p99",
+             "op": "<", "threshold": 0.1, "window": 5,
+             "clear_after": 2},
+        ], registry=reg)
+        # breach: slow observations
+        for _ in range(10):
+            lat.observe(0.5)
+        clock.tick()
+        st.append(0, reg.snapshot())
+        (fired,) = eng.evaluate()
+        assert fired.state == "firing" and fired.rule == "lat-p99"
+        assert eng.active()[0]["rule"] == "lat-p99"
+        assert reg.counter("health.alerts_fired").value == 1
+        # still firing, no duplicate transition
+        assert eng.evaluate() == []
+        # recovery: the window drains past the slow frames
+        clock.tick(10)
+        for _ in range(10):
+            lat.observe(0.01)
+        st.append(0, reg.snapshot())
+        assert eng.evaluate() == []      # hysteresis: 1 clean round
+        clock.tick()
+        st.append(0, reg.snapshot())
+        (resolved,) = eng.evaluate()     # 2nd clean round resolves
+        assert resolved.state == "resolved"
+        assert eng.active() == []
+        assert reg.counter("health.alerts_resolved").value == 1
+
+    def test_for_count_delays_firing(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        reg = MetricsRegistry(enabled=True)
+        reg.histogram("lat").observe(9.0)
+        clock.tick()
+        st.append(0, reg.snapshot())
+        eng = health.SloEngine(st, [
+            {"name": "r", "metric": "lat", "stat": "p99", "op": "<",
+             "threshold": 0.1, "window": 100, "for_count": 3},
+        ], registry=reg)
+        assert eng.evaluate() == []
+        assert eng.evaluate() == []
+        (fired,) = eng.evaluate()
+        assert fired.state == "firing"
+
+    def test_burn_rate_needs_both_windows(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=1000, clock=clock)
+        reg = MetricsRegistry(enabled=True)
+        bad, total = reg.counter("bad"), reg.counter("total")
+        rule = {"name": "burn", "kind": "burn_rate", "bad": "bad",
+                "total": "total", "objective": 0.99,
+                "short_window": 10, "long_window": 100,
+                "burn_threshold": 2.0}
+        eng = health.SloEngine(st, [rule], registry=reg)
+        # long history of clean traffic
+        for _ in range(20):
+            total.inc(100)
+            clock.tick(5)
+            st.append(0, reg.snapshot())
+        # a SHORT error blip: short window burns, long window does not
+        bad.inc(20)
+        total.inc(100)
+        clock.tick(1)
+        st.append(0, reg.snapshot())
+        assert eng.evaluate() == []  # long window still healthy
+        # sustained errors: both windows burn -> fires
+        for _ in range(20):
+            bad.inc(50)
+            total.inc(100)
+            clock.tick(5)
+            st.append(0, reg.snapshot())
+        (fired,) = eng.evaluate()
+        assert fired.state == "firing"
+        assert fired.value > 2.0
+
+    def test_good_counter_form(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=1000, clock=clock)
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("good").inc(50)
+        reg.counter("total").inc(100)
+        clock.tick()
+        st.append(0, reg.snapshot())
+        rule = health.SloRule(
+            {"name": "g", "kind": "burn_rate", "good": "good",
+             "total": "total", "objective": 0.9, "short_window": 100,
+             "long_window": 100, "burn_threshold": 2.0}
+        )
+        breaching, value, _th, _w = rule.breach(st)
+        # bad = 100-50 = 50; error rate 0.5; budget 0.1 -> burn 5.0
+        assert breaching and value == pytest.approx(5.0)
+
+    def test_per_executor_rule_names_the_offender(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        st.append(0, _snap(hists={"lat": [0.01] * 5}))
+        st.append(3, _snap(hists={"lat": [2.0] * 5}))
+        eng = health.SloEngine(st, [
+            {"name": "r", "metric": "lat", "stat": "p99", "op": "<",
+             "threshold": 0.1, "window": 100, "per_executor": True},
+        ])
+        (fired,) = eng.evaluate()
+        assert fired.executor == 3
+
+
+# ----------------------------------------------------------------------
+# straggler detection
+# ----------------------------------------------------------------------
+
+
+def _fleet_store(clock, per_executor):
+    """per_executor: {eid: {"step": v, "feed": v, "h2d": v,
+    "dispatch": v, "wire": v}} mean seconds; 5 scrapes x 10 obs."""
+    st = health.TimeSeriesStore(window=1000, clock=clock)
+    regs = {eid: MetricsRegistry(enabled=True) for eid in per_executor}
+    names = {"step": "train.step_sec", "feed": "train.feed_wait_sec",
+             "h2d": "train.h2d_sec", "dispatch": "train.dispatch_sec",
+             "wire": "ps.round_trip_sec"}
+    for _scrape in range(5):
+        for eid, phases in per_executor.items():
+            reg = regs[eid]
+            for _ in range(10):
+                for phase, mean in phases.items():
+                    reg.histogram(names[phase]).observe(mean)
+            clock.tick(0.2)
+            st.append(eid, reg.snapshot())
+    return st
+
+
+class TestStragglerDetector:
+    def test_even_fleet_not_flagged(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            e: {"step": 0.01, "feed": 0.002} for e in range(4)
+        })
+        det = health.StragglerDetector(st, window=1000)
+        assert det.diagnose() == []
+
+    def test_feed_straggler_named_with_phase(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            0: {"step": 0.01, "feed": 0.002},
+            1: {"step": 0.01, "feed": 0.15},   # the slow data pipeline
+            2: {"step": 0.01, "feed": 0.002},
+        })
+        det = health.StragglerDetector(st, window=1000)
+        (hint,) = det.diagnose()
+        assert hint["executor"] == 1
+        assert hint["phase"] == "feed"
+        assert hint["excess_sec"] > 0.1
+
+    def test_wire_straggler_attributed(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            0: {"step": 0.02, "wire": 0.003, "feed": 0.001},
+            1: {"step": 0.09, "wire": 0.07, "feed": 0.001},  # slow link
+            2: {"step": 0.02, "wire": 0.003, "feed": 0.001},
+            3: {"step": 0.02, "wire": 0.003, "feed": 0.001},
+        })
+        det = health.StragglerDetector(st, window=1000)
+        (hint,) = det.diagnose()
+        assert hint["executor"] == 1
+        assert hint["phase"] == "wire"
+
+    def test_host_residual_when_no_phase_explains(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            0: {"step": 0.01, "feed": 0.001, "h2d": 0.002,
+                "dispatch": 0.004},
+            1: {"step": 0.30, "feed": 0.001, "h2d": 0.002,
+                "dispatch": 0.004},  # GC-pause / contention shape
+            2: {"step": 0.01, "feed": 0.001, "h2d": 0.002,
+                "dispatch": 0.004},
+        })
+        det = health.StragglerDetector(st, window=1000)
+        (hint,) = det.diagnose()
+        assert hint["executor"] == 1
+        assert hint["phase"] == "host"
+
+    def test_two_node_fleet_uses_ratio_gate(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            0: {"step": 0.01, "feed": 0.001},
+            1: {"step": 0.08, "feed": 0.001},
+        })
+        det = health.StragglerDetector(st, window=1000)
+        (hint,) = det.diagnose()
+        assert hint["executor"] == 1
+
+    def test_min_samples_guards_quiet_nodes(self):
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=1000, clock=clock)
+        st.append(0, _snap(hists={"train.step_sec": [0.01] * 20}))
+        st.append(1, _snap(hists={"train.step_sec": [9.0]}))  # 1 sample
+        det = health.StragglerDetector(st, window=1000, min_samples=3)
+        assert det.diagnose() == []
+
+
+# ----------------------------------------------------------------------
+# the standing plane
+# ----------------------------------------------------------------------
+
+
+class TestHealthPlane:
+    def test_scrape_loop_and_slo_fire(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.histogram("train.step_sec").observe(1.0)
+        plane = health.HealthPlane.local(
+            registry=reg, interval=60,
+            slo=[{"name": "r", "metric": "train.step_sec",
+                  "stat": "p99", "op": "<", "threshold": 1e-6,
+                  "window": 300}],
+        )
+        transitions = plane.scrape_once()
+        assert [a.rule for a in transitions] == ["r"]
+        assert plane.status()["alerts"][0]["rule"] == "r"
+
+    def test_stale_snapshots_skipped(self):
+        calls = {"n": 0}
+
+        def metrics_fn():
+            calls["n"] += 1
+            return {
+                0: {"metrics": _snap(counters={"c": calls["n"]}),
+                    "metrics_age": 0.0},
+                1: {"metrics": _snap(counters={"c": 100}),
+                    "metrics_age": 999.0},   # stopped publishing
+                2: {"heartbeat_age": 0.1},   # no metrics at all
+            }
+
+        plane = health.HealthPlane(metrics_fn, interval=1.0)
+        plane.scrape_once()
+        plane.scrape_once()
+        assert plane.store.executors() == [0]
+
+    def test_straggler_hook_fires_once_per_phase(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            0: {"step": 0.01, "feed": 0.001},
+            1: {"step": 0.01, "feed": 0.2},
+            2: {"step": 0.01, "feed": 0.001},
+        })
+        hooked = []
+        plane = health.HealthPlane(
+            lambda: {}, interval=60, on_straggler=hooked.append,
+            straggler_opts={"window": 1000},
+        )
+        plane.store = st
+        plane.detector = health.StragglerDetector(st, window=1000)
+        plane._diagnose()
+        plane._diagnose()  # same verdict: the hook must not re-fire
+        assert len(hooked) == 1
+        assert hooked[0]["executor"] == 1
+        assert plane.hints[1]["phase"] == "feed"
+        assert plane.status()["stragglers"][0]["executor"] == 1
+
+    def test_raising_hook_does_not_kill_the_plane(self):
+        clock = _Clock()
+        st = _fleet_store(clock, {
+            0: {"step": 0.01, "feed": 0.001},
+            1: {"step": 0.01, "feed": 0.2},
+        })
+
+        def boom(hint):
+            raise RuntimeError("hook down")
+
+        plane = health.HealthPlane(
+            lambda: {}, interval=60, on_straggler=boom,
+        )
+        plane.store = st
+        plane.detector = health.StragglerDetector(st, window=1000)
+        plane._diagnose()   # must not raise
+        assert plane.hints[1]["executor"] == 1
+
+    def test_raising_metrics_fn_is_survived(self):
+        plane = health.HealthPlane(
+            lambda: 1 / 0, interval=60,
+        )
+        assert plane.scrape_once() == []
+
+    def test_status_providers(self):
+        health.register_status_provider("unit-test", lambda: {"ok": 1})
+        health.register_status_provider(
+            "unit-test-broken", lambda: 1 / 0
+        )
+        try:
+            out = health.provider_statuses()
+            assert out["unit-test"] == {"ok": 1}
+            assert "error" in out["unit-test-broken"]
+        finally:
+            health.unregister_status_provider("unit-test")
+            health.unregister_status_provider("unit-test-broken")
+
+    def test_background_loop_scrapes(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("c").inc()
+        plane = health.HealthPlane.local(registry=reg, interval=0.05)
+        plane.start()
+        try:
+            deadline = time.monotonic() + 5
+            while plane.store.scrapes < 3:
+                assert time.monotonic() < deadline
+                time.sleep(0.02)
+        finally:
+            plane.stop()
+
+    def test_merged_snapshot_includes_driver_registry(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("node.c").inc(4)
+        plane = health.HealthPlane.local(registry=reg, interval=60)
+        plane.scrape_once()
+        merged = plane.merged_snapshot()
+        assert merged["counters"]["node.c"] == 4
+        # the plane's own scrape counter (default registry) rides too
+        assert "health.scrapes" in merged["counters"]
+
+
+# ----------------------------------------------------------------------
+# live instrumentation feeds the detector (dp phase histograms)
+# ----------------------------------------------------------------------
+
+
+def test_train_on_feed_populates_phase_histograms():
+    # the detector's h2d/dispatch phase twins must be fed by the real
+    # training loop (parallel/dp.py)
+    import numpy as np
+
+    import optax
+
+    from tensorflowonspark_tpu.parallel import dp
+
+    telemetry.set_enabled(True)
+    reg = telemetry.get_registry()
+    base = reg.snapshot()
+
+    def loss_fn(params, batch, rng):
+        pred = batch["x"] @ params["w"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    trainer = dp.SyncTrainer(loss_fn, optax.sgd(0.01))
+    state = trainer.create_state({"w": np.zeros((3,), np.float32)})
+
+    class _Feed:
+        def __init__(self, batches):
+            self.batches = list(batches)
+
+        def next_batch(self, n):
+            return self.batches.pop(0) if self.batches else []
+
+        def should_stop(self):
+            return not self.batches
+
+    rng = np.random.RandomState(0)
+    rows = [
+        {"x": rng.randn(3).astype(np.float32),
+         "y": np.float32(rng.randn())}
+        for _ in range(8)
+    ]
+    trainer.train_on_feed(
+        state, _Feed([list(rows)] * 6), 8, max_steps=5, log_every=0,
+        terminate_on_max_steps=False,
+    )
+    delta = telemetry.snapshot_delta(reg.snapshot(), base)
+    for name in ("train.step_sec", "train.h2d_sec",
+                 "train.dispatch_sec"):
+        assert delta["histograms"][name]["count"] >= 5, name
+    # and the health plane can consume them end to end
+    plane = health.HealthPlane.local(interval=60)
+    plane.scrape_once()
+    assert plane.store.hist_over("train.dispatch_sec")["count"] >= 5
+
+
+def test_cluster_monitor_note_straggler():
+    from tensorflowonspark_tpu.cluster.cluster import ClusterMonitor
+
+    class _Liveness:
+        interval = 1.0
+
+    class _Server:
+        liveness = _Liveness()
+
+    mon = ClusterMonitor(_Server(), [])
+    mon.note_straggler({"executor": 2, "phase": "feed",
+                        "excess_sec": 0.5})
+    assert mon.health_hints[2]["phase"] == "feed"

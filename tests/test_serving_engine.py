@@ -544,3 +544,70 @@ def test_stats_surface_robustness_counters():
                 "watchdog_fires", "recovered"):
         assert key in stats, key
     assert stats["completed"] == 2 and stats["errors"] == 0
+
+
+# ----------------------------------------------------------------------
+# latency accounting: histogram vs raw-list parity (ISSUE 10 satellite)
+# ----------------------------------------------------------------------
+
+
+class TestLatencyParity:
+    def test_histogram_and_raw_list_agree_at_bucket_tolerance(self):
+        """The shared telemetry histogram is AUTHORITATIVE (docs/
+        serving.md "Latency accounting"); stats["latency_sec"] keeps
+        the raw per-request values (the TFOS_TELEMETRY=0 fallback).
+        The histogram's rule is inverted-CDF (smallest bucket whose
+        cumulative count reaches the rank) with within-bucket linear
+        interpolation; a raw list percentiled with numpy's DEFAULT
+        linear method can diverge arbitrarily on bimodal data (the
+        median falling in the gap between a fast and a slow mode —
+        exactly what compile-skewed serving latencies look like).  At
+        the MATCHED rank method the two agree to the geometric bucket
+        width — one bucket spans [lo, 1.25*lo], so a sparse tail can
+        land anywhere inside it: rel 0.25 is the worst case — on BOTH
+        schedules.  That is the parity contract documented in
+        docs/serving.md."""
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.set_enabled(True)
+        _, _, predict = _gen_predict(max_new=4)
+        for schedule in ("continuous", "static"):
+            _prompts_, rows = _rows([4, 5, 7, 6, 9, 5, 8, 4])
+            stats = {}
+            base = serving_engine.latency_histogram().snapshot()
+            list(serving.predict_rows(
+                predict, rows, {"prompt": "tokens"}, batch_size=4,
+                schedule=schedule, stats=stats,
+            ))
+            summ = serving_engine.latency_summary(since=base)
+            raw = [1e3 * v for v in stats["latency_sec"].values()]
+            assert summ["count"] == len(raw) == len(rows), schedule
+            for q, key in ((50, "p50_ms"), (99, "p99_ms")):
+                want = float(np.percentile(
+                    np.asarray(raw), q, method="inverted_cdf"
+                ))
+                assert summ[key] == pytest.approx(
+                    want, rel=0.25, abs=0.5
+                ), (schedule, q, summ, want)
+
+    def test_raw_list_is_the_disabled_fallback(self):
+        # with telemetry off the histogram records nothing — the raw
+        # list is all a consumer has, and the summary reports zeros
+        # rather than lying
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.set_enabled(False)
+        try:
+            _, _, predict = _gen_predict(max_new=4)
+            _prompts_, rows = _rows([4, 6])
+            stats = {}
+            base = serving_engine.latency_histogram().snapshot()
+            list(serving.predict_rows(
+                predict, rows, {"prompt": "tokens"}, batch_size=2,
+                schedule="continuous", stats=stats,
+            ))
+            assert len(stats["latency_sec"]) == 2  # raw list intact
+            summ = serving_engine.latency_summary(since=base)
+            assert summ["count"] == 0  # histogram path off
+        finally:
+            telemetry.set_enabled(True)

@@ -52,6 +52,12 @@ def _rows(lens, vocab=64, seed=13):
     ]
 
 
+def sa_wrap(hist_snapshot):
+    """Wrap one histogram snapshot as a full registry snapshot."""
+    return {"counters": {}, "gauges": {},
+            "histograms": {"h": hist_snapshot}}
+
+
 @pytest.fixture(autouse=True)
 def _telemetry_on():
     """Every test starts from an enabled, clean default registry and
@@ -153,6 +159,38 @@ class TestRegistry:
         assert m["histograms"]["lat"]["max"] == pytest.approx(0.5)
         assert m["histograms"]["lat"]["p99"] == pytest.approx(0.5, rel=0.3)
 
+    def test_histogram_sum_exact_through_delta_and_merge(self):
+        # ISSUE 10 satellite: the exact running sum (never rounded,
+        # never bucket-derived) threads through snapshot, delta, and
+        # merge — means are exact everywhere
+        vals_a = [0.0123456789, 0.987654321, 1.5e-4, 3.14159]
+        vals_b = [0.5, 0.25, 0.125]
+        a = registry_mod.MetricsRegistry(enabled=True)
+        b = registry_mod.MetricsRegistry(enabled=True)
+        for v in vals_a:
+            a.histogram("h").observe(v)
+        for v in vals_b:
+            b.histogram("h").observe(v)
+        sa = a.snapshot()["histograms"]["h"]
+        assert sa["sum"] == sum(vals_a)  # bit-exact
+        assert sa["mean"] == sum(vals_a) / len(vals_a)
+        # delta: only the new observations' exact sum
+        base = a.snapshot()
+        extra = [0.777, 0.001]
+        for v in extra:
+            a.histogram("h").observe(v)
+        d = registry_mod.snapshot_delta(a.snapshot(), base)
+        dh = d["histograms"]["h"]
+        assert dh["sum"] == pytest.approx(sum(extra), rel=0, abs=1e-15)
+        assert dh["mean"] == pytest.approx(
+            sum(extra) / 2, rel=0, abs=1e-15
+        )
+        # merge: exact sum of sums
+        m = telemetry.merge_snapshots([sa_wrap(sa), b.snapshot()])
+        mh = m["histograms"]["h"]
+        assert mh["sum"] == sum(vals_a) + sum(vals_b)
+        assert mh["mean"] == (sum(vals_a) + sum(vals_b)) / 7
+
 
 class TestDisabledFastPath:
     def test_null_singletons_no_allocation(self):
@@ -240,6 +278,29 @@ class TestTracer:
         spans = tr.spans()
         assert len(spans) == 10
         assert spans[-1]["name"] == "m49"
+
+    def test_dropped_spans_counted(self):
+        # ISSUE 10 satellite: the bounded store's silent evictions are
+        # visible — the tracer counts them and publishes into the
+        # registry (tracing.dropped_spans) so truncated traces don't
+        # read as "nothing happened"
+        telemetry.set_enabled(True)
+        base = telemetry.get_registry().counter(
+            "tracing.dropped_spans"
+        ).value
+        tr = Tracer(enabled=True, max_spans=10)
+        for i in range(10):
+            tr.mark("m%d" % i)
+        assert tr.dropped_spans == 0  # full but nothing evicted yet
+        for i in range(7):
+            tr.mark("x%d" % i)
+        assert tr.dropped_spans == 7
+        assert telemetry.get_registry().counter(
+            "tracing.dropped_spans"
+        ).value == base + 7
+        # the counter rides snapshot() like any other metric
+        snap = telemetry.get_registry().snapshot()
+        assert snap["counters"]["tracing.dropped_spans"] >= 7
 
 
 # ----------------------------------------------------------------------
