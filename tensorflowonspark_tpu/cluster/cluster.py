@@ -194,6 +194,16 @@ class ClusterMonitor(object):
             hint.get("excess_sec", 0.0),
         )
 
+    def clear_straggler(self, executor_id):
+        """Drop a recovered executor's straggler hint (the health
+        plane's ``on_straggler_cleared`` mirror of
+        :meth:`note_straggler`)."""
+        if self.health_hints.pop(int(executor_id), None) is not None:
+            logger.info(
+                "monitor: health plane cleared the straggler flag on "
+                "executor %s", executor_id,
+            )
+
     def metrics(self):
         """Per-executor telemetry snapshots merged with liveness (the
         in-process half of ``TFCluster.metrics()`` — usable on a bare
@@ -881,6 +891,10 @@ class TPUCluster(object):
                 log_dir=profile_dir, hint=hint,
             )
 
+        def on_straggler_cleared(eid):
+            monitor.clear_straggler(eid)
+            self._clear_health_hint(eid)
+
         plane = _health.HealthPlane(
             monitor.metrics,
             interval=interval,
@@ -889,6 +903,7 @@ class TPUCluster(object):
             straggler=straggler,
             straggler_opts=straggler_opts,
             on_straggler=on_straggler,
+            on_straggler_cleared=on_straggler_cleared,
             liveness_fn=self.server.liveness.health,
         )
         _health.register_status_provider("ledger", self._ledger_status)
@@ -954,6 +969,26 @@ class TPUCluster(object):
             req["seq"], executor_id, log_dir, steps,
         )
         return req
+
+    def _clear_health_hint(self, executor_id):
+        """Erase a recovered node's ``health_hint`` kv so its
+        supervisor stops flagging ``health.straggler`` on the beat —
+        the recovery mirror of :meth:`_request_profile`'s hint
+        write."""
+        node_meta = next(
+            (n for n in self.cluster_info
+             if n["executor_id"] == int(executor_id)), None,
+        )
+        if node_meta is None:
+            return
+        try:
+            m = self._connect(node_meta)
+            m.set("health_hint", None)
+        except Exception:  # noqa: BLE001 - node mid-restart: its
+            logger.warning(  # stale flag clears on the next rebirth
+                "unable to clear health hint on executor %s",
+                executor_id, exc_info=True,
+            )
 
     def tensorboard_url(self):
         """URL of the cluster's tensorboard, if one was launched

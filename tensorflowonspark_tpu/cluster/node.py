@@ -797,6 +797,27 @@ def build_cluster_spec(cluster_info):
 # ----------------------------------------------------------------------
 
 
+def _queue_put_retry(queue, obj):
+    """``queue.put`` with one reconnect-retry.
+
+    Manager proxies share one socket per (address, thread); a GC pass
+    in the feeder thread can run ``BaseProxy._decref`` for an unrelated
+    dead proxy and close that shared connection while this put is
+    mid-``send`` (``TypeError: 'NoneType' ...`` from the nulled handle,
+    or ``OSError`` on a partially-written frame).  Either way the
+    request never completed server-side, and the next proxy call
+    transparently opens a fresh connection — so one retry is safe
+    (no duplicate put) and a genuinely dead manager still raises."""
+    try:
+        queue.put(obj, block=True)
+    except (OSError, TypeError):
+        logger.warning(
+            "feed queue put hit a closed manager connection; "
+            "retrying once on a fresh connection", exc_info=True,
+        )
+        queue.put(obj, block=True)
+
+
 class _PipelinedShipper(object):
     """Feeder-side decode pipeline (the 'pipelined decode' stage of the
     narrow-dtype data plane, docs/data_plane.md): a small worker pool
@@ -1061,7 +1082,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             thread (the ring is SPSC: one producer)."""
             kind = action[0]
             if kind == "queue":
-                queue.put(action[1], block=True)
+                _queue_put_retry(queue, action[1])
                 return
             if kind == "pushv":
                 ring.pushv(
@@ -1211,11 +1232,11 @@ def inference(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             count += 1
             block.append(item)
             if len(block) >= FEED_BLOCK_SIZE:
-                queue_in.put(Block(block), block=True)
+                _queue_put_retry(queue_in, Block(block))
                 block = []
         if block:
-            queue_in.put(Block(block), block=True)
-        queue_in.put(EndPartition())
+            _queue_put_retry(queue_in, Block(block))
+        _queue_put_retry(queue_in, EndPartition())
         if count == 0:
             return []
         err_q = mgr.get_queue("error")

@@ -201,6 +201,16 @@ class Supervisor(object):
                     self.ctx.executor_id, hint.get("phase"),
                 )
             gauges["health.straggler"] = 1.0
+        elif self._hint_logged:
+            # the driver cleared the hint (recovery): drop the gauge
+            # explicitly for one beat so observers see the transition,
+            # and re-arm the log for a future regression
+            self._hint_logged = False
+            gauges["health.straggler"] = 0.0
+            logger.info(
+                "executor %d straggler flag cleared by the fleet "
+                "health plane", self.ctx.executor_id,
+            )
         return snap
 
     def _proc_alive(self):

@@ -95,11 +95,27 @@ def _reset_safe_delta(cur, base):
     for name, v in list(d.get("counters", {}).items()):
         if v < 0:
             d["counters"][name] = cur.get("counters", {}).get(name, 0)
+    bh = (base or {}).get("histograms", {})
     for name, h in list(d.get("histograms", {}).items()):
-        if h.get("count", 0) < 0:
-            d["histograms"][name] = dict(
-                cur.get("histograms", {}).get(name) or {}
+        # a restarted executor can accumulate a HIGHER count than the
+        # pre-restart base (count delta positive) while individual
+        # buckets shrink — any bucket going backwards (or a negative
+        # count/sum delta) means the base is from a previous life, so
+        # substitute the raw post-restart snapshot
+        cur_h = cur.get("histograms", {}).get(name) or {}
+        cur_counts = {
+            (lo, hi): c for lo, hi, c in cur_h.get("buckets") or ()
+        }
+        b = bh.get(name) or {}
+        if (
+            h.get("count", 0) < 0
+            or h.get("sum", 0.0) < 0
+            or any(
+                cur_counts.get((lo, hi), 0) < c
+                for lo, hi, c in b.get("buckets") or ()
             )
+        ):
+            d["histograms"][name] = dict(cur_h)
     return d
 
 
@@ -319,6 +335,10 @@ _OPS = {
     ">=": lambda v, t: v >= t,
 }
 
+#: Stats a threshold rule may evaluate (validated at construction so a
+#: typo'd rule fails at load time, not inside the standing loop).
+_STATS = ("p50", "p90", "p99", "mean", "rate", "count", "gauge")
+
 
 class SloRule(object):
     """One declarative SLO rule (docs/observability.md has the
@@ -357,6 +377,12 @@ class SloRule(object):
         if self.kind == "threshold":
             self.metric = str(spec.pop("metric"))
             self.stat = str(spec.pop("stat", "p99"))
+            if self.stat not in _STATS:
+                raise ValueError(
+                    "rule {0!r}: unknown stat {1!r} (one of {2})".format(
+                        self.name, self.stat, "/".join(_STATS)
+                    )
+                )
             self.op = str(spec.pop("op", "<"))
             if self.op not in _OPS:
                 raise ValueError(
@@ -870,7 +896,20 @@ class HealthPlane(object):
         ``straggler_opts``).
       on_straggler: ``fn(hint_dict)`` called ONCE per (executor, phase)
         flag — the profiler trigger (``TPUCluster.start_health_plane``
-        wires it to the flagged node's ``profile_request`` kv).
+        wires it to the flagged node's ``profile_request`` kv).  The
+        dedup clears when the executor recovers (see
+        ``straggler_clear_rounds``), so a regression that recurs after
+        a recovery fires the hook again.
+      on_straggler_cleared: ``fn(executor_id)`` called when a
+        previously-flagged executor has been absent from
+        ``straggler_clear_rounds`` consecutive diagnosis rounds — the
+        recovery mirror of ``on_straggler``
+        (``TPUCluster.start_health_plane`` wires it to clear the
+        node's ``health_hint`` kv so its ``health.straggler`` gauge
+        drops).
+      straggler_clear_rounds: consecutive clean diagnosis rounds
+        before a straggler hint expires from ``/status`` and the
+        (executor, phase) dedup resets.
       liveness_fn: zero-arg callable returning the liveness health
         summary (``reservation.Liveness.health()``); feeds
         ``/healthz``.
@@ -878,11 +917,17 @@ class HealthPlane(object):
         ``metrics_age`` field — executor stopped publishing) are
         SKIPPED instead of re-appended, so a dead node's last frame is
         never double-counted into rates.
+      merge_own_registry: append this (driver) process's own registry
+        snapshot to :meth:`merged_snapshot`.  :meth:`local` turns this
+        OFF when the scraped registry IS the plane's registry —
+        otherwise every local-mode metric would be exposed doubled.
     """
 
     def __init__(self, metrics_fn, interval=None, window=None, slo=None,
                  straggler=True, straggler_opts=None, on_straggler=None,
-                 liveness_fn=None, max_snapshot_age=None, registry=None):
+                 on_straggler_cleared=None, straggler_clear_rounds=5,
+                 liveness_fn=None, max_snapshot_age=None, registry=None,
+                 merge_own_registry=True):
         self.metrics_fn = metrics_fn
         self.interval = SCRAPE_INTERVAL if interval is None else float(
             interval
@@ -897,6 +942,9 @@ class HealthPlane(object):
             if straggler else None
         )
         self.on_straggler = on_straggler
+        self.on_straggler_cleared = on_straggler_cleared
+        self.straggler_clear_rounds = max(1, int(straggler_clear_rounds))
+        self.merge_own_registry = bool(merge_own_registry)
         self.liveness_fn = liveness_fn
         self.max_snapshot_age = (
             3 * self.interval if max_snapshot_age is None
@@ -910,10 +958,15 @@ class HealthPlane(object):
         self._m_flagged = self._registry.counter(
             "health.stragglers_flagged"
         )
+        self._m_cleared = self._registry.counter(
+            "health.stragglers_cleared"
+        )
         #: executor → newest straggler hint (also pushed to
-        #: ``on_straggler`` and visible in ``/status``)
+        #: ``on_straggler`` and visible in ``/status``); expires after
+        #: ``straggler_clear_rounds`` clean diagnosis rounds
         self.hints = {}
         self._hinted = set()  # (executor, phase) already actioned
+        self._clean_rounds = {}  # executor → consecutive unflagged rounds
         self.started_at = time.time()
         self._stop = threading.Event()
         self._thread = None
@@ -922,7 +975,10 @@ class HealthPlane(object):
     @classmethod
     def local(cls, registry=None, **kwargs):
         """A single-process plane scraping this process's own registry
-        as executor 0 — the serving-only / bench deployment shape."""
+        as executor 0 — the serving-only / bench deployment shape.
+        The plane's own counters live in the scraped registry, which
+        is therefore NOT re-appended by :meth:`merged_snapshot`
+        (otherwise every metric on ``/metrics`` would read doubled)."""
         from tensorflowonspark_tpu import telemetry as _t
 
         reg = registry or _t.get_registry()
@@ -930,7 +986,8 @@ class HealthPlane(object):
         def metrics_fn():
             return {0: {"metrics": reg.snapshot(), "metrics_age": 0.0}}
 
-        return cls(metrics_fn, **kwargs)
+        kwargs.setdefault("merge_own_registry", False)
+        return cls(metrics_fn, registry=reg, **kwargs)
 
     @classmethod
     def for_reservation_server(cls, server, **kwargs):
@@ -978,9 +1035,19 @@ class HealthPlane(object):
                     eid, exc_info=True,
                 )
         self._m_scrapes.inc()
-        transitions = self.slo.evaluate() if self.slo else []
+        transitions = []
+        if self.slo is not None:
+            try:
+                transitions = self.slo.evaluate()
+            except Exception:  # noqa: BLE001 - one bad rule must not
+                logger.warning(  # kill the standing loop
+                    "SLO evaluation failed", exc_info=True
+                )
         if self.detector is not None:
-            self._diagnose()
+            try:
+                self._diagnose()
+            except Exception:  # noqa: BLE001 - diagnosis is advisory
+                logger.warning("straggler diagnosis failed", exc_info=True)
         return transitions
 
     def _diagnose(self):
@@ -989,6 +1056,7 @@ class HealthPlane(object):
         except Exception:  # noqa: BLE001 - diagnosis is advisory
             logger.warning("straggler diagnosis failed", exc_info=True)
             return
+        self._expire_hints({h["executor"] for h in stragglers})
         for hint in stragglers:
             eid = hint["executor"]
             self.hints[eid] = hint
@@ -1018,16 +1086,54 @@ class HealthPlane(object):
                         exc_info=True,
                     )
 
+    def _expire_hints(self, flagged):
+        """Age out recovered stragglers: an executor absent from
+        ``straggler_clear_rounds`` consecutive diagnosis rounds drops
+        its hint from ``/status``, resets the (executor, phase) dedup
+        (so a recurrence re-fires ``on_straggler``), and notifies
+        ``on_straggler_cleared`` (the driver clears the node's
+        ``health_hint`` kv so its ``health.straggler`` gauge drops)."""
+        for eid in flagged:
+            self._clean_rounds.pop(eid, None)
+        for eid in [e for e in self.hints if e not in flagged]:
+            clean = self._clean_rounds.get(eid, 0) + 1
+            if clean < self.straggler_clear_rounds:
+                self._clean_rounds[eid] = clean
+                continue
+            self._clean_rounds.pop(eid, None)
+            self.hints.pop(eid, None)
+            self._hinted = {k for k in self._hinted if k[0] != eid}
+            self._m_cleared.inc()
+            self._tracer.mark(
+                "straggler_cleared", trace="health", executor=eid,
+            )
+            logger.info(
+                "straggler: executor %d recovered (%d clean rounds) — "
+                "clearing the flag", eid, clean,
+            )
+            if self.on_straggler_cleared is not None:
+                try:
+                    self.on_straggler_cleared(eid)
+                except Exception:  # noqa: BLE001 - recovery is advisory
+                    logger.warning(
+                        "straggler-cleared hook failed for executor %d",
+                        eid, exc_info=True,
+                    )
+
     # -- consumption surfaces ------------------------------------------
 
     def merged_snapshot(self):
         """Fleet-merged view for ``/metrics``: every executor's newest
         raw snapshot plus this (driver) process's own registry — the
-        scrape/SLO/alert counters live here."""
+        scrape/SLO/alert counters live here.  When the plane's
+        registry is itself one of the scraped sources
+        (:meth:`local`), it is NOT re-appended: that would expose
+        every metric doubled."""
         snaps = [
             rec for rec in self.store.latest_raw().values() if rec
         ]
-        snaps.append(self._registry.snapshot())
+        if self.merge_own_registry:
+            snaps.append(self._registry.snapshot())
         return _aggregate.merge_snapshots(snaps)
 
     def healthz(self):

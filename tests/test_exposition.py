@@ -176,6 +176,10 @@ class TestHttpRoutes:
         assert code == 200
         fams = exposition.parse_openmetrics(body)
         assert "train_steps" in fams
+        # local mode: the scraped registry IS the plane registry —
+        # /metrics must expose each value once, not doubled
+        (_n, _l, v), = fams["train_steps"]["samples"]
+        assert v == 5
 
     def test_status_json(self, plane):
         p, srv = plane

@@ -77,6 +77,22 @@ class TestTimeSeriesStore:
         h = st.hist_over("h")
         assert h["count"] == 52  # 50 + the 2 post-reset, none negative
 
+    def test_histogram_reset_with_higher_post_restart_count(self):
+        # a restarted executor can rack up MORE observations than the
+        # pre-restart base: the count delta is positive, so the reset
+        # only shows as negative per-bucket deltas — those must trip
+        # the reset rule too, or windowed percentiles corrupt
+        clock = _Clock()
+        st = health.TimeSeriesStore(window=100, clock=clock)
+        st.append(0, _snap(hists={"h": [0.001] * 5}))
+        clock.tick()
+        # restart: 6 fresh observations in a DIFFERENT bucket
+        st.append(0, _snap(hists={"h": [5.0] * 6}))
+        h = st.hist_over("h")
+        assert h["count"] == 11  # 5 + the 6 post-restart
+        assert all(c >= 0 for _lo, _hi, c in h["buckets"])
+        assert h["sum"] == pytest.approx(5 * 0.001 + 6 * 5.0)
+
     def test_out_of_window_frames_excluded(self):
         # the staleness rule: frames older than the window must not
         # leak into (= double-count in) windowed queries
@@ -251,6 +267,11 @@ class TestRuleGrammar:
     def test_bad_specs_raise(self):
         with pytest.raises(ValueError, match="unknown op"):
             health.SloRule({"name": "x", "metric": "m", "op": "~",
+                            "threshold": 1})
+        # a typo'd stat must fail at LOAD time — raising on first
+        # evaluation instead would kill the standing health-plane loop
+        with pytest.raises(ValueError, match="unknown stat"):
+            health.SloRule({"name": "x", "metric": "m", "stat": "p95",
                             "threshold": 1})
         with pytest.raises(ValueError, match="unknown keys"):
             health.SloRule({"name": "x", "metric": "m", "threshold": 1,
@@ -605,8 +626,119 @@ class TestHealthPlane:
         plane.scrape_once()
         merged = plane.merged_snapshot()
         assert merged["counters"]["node.c"] == 4
-        # the plane's own scrape counter (default registry) rides too
+        # the plane's own scrape counter rides too (it lives in the
+        # scraped registry in local mode)
         assert "health.scrapes" in merged["counters"]
+
+    def test_local_mode_metrics_not_doubled(self):
+        # local mode scrapes the plane's OWN registry as executor 0:
+        # merged_snapshot must not re-append it, or every value on
+        # /metrics reads exactly doubled
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("c").inc(10)
+        reg.histogram("h").observe(0.25)
+        plane = health.HealthPlane.local(registry=reg, interval=60)
+        plane.scrape_once()
+        plane.scrape_once()  # re-scraping must not double either
+        merged = plane.merged_snapshot()
+        assert merged["counters"]["c"] == 10
+        assert merged["histograms"]["h"]["count"] == 1
+        assert merged["counters"]["health.scrapes"] >= 1
+
+    def test_fleet_mode_still_merges_driver_registry(self):
+        # non-local planes scrape executor registries the driver does
+        # NOT own: its own counters must still reach /metrics
+        reg = MetricsRegistry(enabled=True)
+        plane = health.HealthPlane(
+            lambda: {0: {"metrics": _snap(counters={"node.c": 3}),
+                         "metrics_age": 0.0}},
+            interval=60, registry=reg,
+        )
+        plane.scrape_once()
+        merged = plane.merged_snapshot()
+        assert merged["counters"]["node.c"] == 3
+        assert merged["counters"]["health.scrapes"] == 1
+
+    def test_raising_slo_engine_does_not_kill_the_scrape(self):
+        # "Never raises" must hold through the SLO engine too: a rule
+        # that blows up at evaluation time is logged, not propagated
+        # into the standing daemon thread
+        reg = MetricsRegistry(enabled=True)
+        plane = health.HealthPlane.local(
+            registry=reg, interval=60,
+            slo=[{"name": "r", "metric": "m", "stat": "p99",
+                  "op": "<", "threshold": 1.0, "window": 30}],
+        )
+
+        def boom():
+            raise ValueError("bad rule")
+
+        plane.slo.evaluate = boom
+        assert plane.scrape_once() == []   # survived
+        assert plane.store.scrapes >= 1    # and the scrape landed
+
+    def test_straggler_hint_expires_and_refires(self):
+        hooked, cleared = [], []
+
+        class _FakeDetector:
+            out = []
+
+            def diagnose(self):
+                return list(self.out)
+
+        hint = {"executor": 1, "phase": "feed", "step_sec": 0.2,
+                "fleet_median_sec": 0.01, "excess_sec": 0.19,
+                "phase_excess_sec": 0.19, "window": 60}
+        plane = health.HealthPlane(
+            lambda: {}, interval=60,
+            on_straggler=hooked.append,
+            on_straggler_cleared=cleared.append,
+            straggler_clear_rounds=2,
+        )
+        det = plane.detector = _FakeDetector()
+        det.out = [hint]
+        plane._diagnose()
+        assert len(hooked) == 1 and 1 in plane.hints
+        # recovery: absent for clear_rounds consecutive rounds
+        det.out = []
+        plane._diagnose()
+        assert 1 in plane.hints            # 1 clean round: still shown
+        plane._diagnose()
+        assert plane.hints == {}           # 2nd clean round: expired
+        assert cleared == [1]
+        assert plane._registry.counter(
+            "health.stragglers_cleared"
+        ).value == 1
+        # recurrence after recovery re-fires the hook (the dedup reset)
+        det.out = [hint]
+        plane._diagnose()
+        assert len(hooked) == 2
+        assert plane.hints[1]["phase"] == "feed"
+
+    def test_straggler_clear_hook_failure_is_survived(self):
+        class _FakeDetector:
+            out = []
+
+            def diagnose(self):
+                return list(self.out)
+
+        hint = {"executor": 1, "phase": "feed", "step_sec": 0.2,
+                "fleet_median_sec": 0.01, "excess_sec": 0.19,
+                "phase_excess_sec": 0.19, "window": 60}
+
+        def boom(eid):
+            raise RuntimeError("node gone")
+
+        plane = health.HealthPlane(
+            lambda: {}, interval=60, on_straggler_cleared=boom,
+            straggler_clear_rounds=1,
+        )
+        det = plane.detector = _FakeDetector()
+        det.out = [hint]
+        plane._diagnose()
+        det.out = []
+        plane._diagnose()   # must not raise
+        assert plane.hints == {}
 
 
 # ----------------------------------------------------------------------
@@ -677,3 +809,7 @@ def test_cluster_monitor_note_straggler():
     mon.note_straggler({"executor": 2, "phase": "feed",
                         "excess_sec": 0.5})
     assert mon.health_hints[2]["phase"] == "feed"
+    # the health plane's recovery mirror clears the hint again
+    mon.clear_straggler(2)
+    assert mon.health_hints == {}
+    mon.clear_straggler(2)  # idempotent

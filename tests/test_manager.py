@@ -87,3 +87,46 @@ def test_drain_nonblocking_sweep():
     t0 = time.monotonic()
     assert manager.drain(q, timeout=0) == 3
     assert time.monotonic() - t0 < 0.5
+
+
+class _FlakyOnceQueue(object):
+    """A queue proxy whose first put dies the way a GC-closed manager
+    connection does (BaseProxy._decref nulls the shared socket mid-send);
+    the retry path must land the item exactly once."""
+
+    def __init__(self, exc):
+        self.exc = exc
+        self.items = []
+        self.attempts = 0
+
+    def put(self, item, block=True):
+        self.attempts += 1
+        if self.attempts == 1:
+            raise self.exc
+        self.items.append(item)
+
+
+def test_queue_put_retry_recovers_from_closed_connection():
+    from tensorflowonspark_tpu.cluster import node
+
+    for exc in (
+        TypeError("'NoneType' object cannot be interpreted as an integer"),
+        OSError("handle is closed"),
+    ):
+        q = _FlakyOnceQueue(exc)
+        node._queue_put_retry(q, "block-1")
+        assert q.items == ["block-1"]
+        assert q.attempts == 2
+
+
+def test_queue_put_retry_reraises_persistent_failure():
+    import pytest
+
+    from tensorflowonspark_tpu.cluster import node
+
+    class _DeadQueue(object):
+        def put(self, item, block=True):
+            raise OSError("handle is closed")
+
+    with pytest.raises(OSError):
+        node._queue_put_retry(_DeadQueue(), "block-1")
