@@ -23,6 +23,7 @@ Design changes for the TPU build:
 
 import itertools
 import logging
+import os
 import threading
 import time
 import uuid
@@ -127,6 +128,7 @@ class ClusterMonitor(object):
                 ).inc(rec["generation"] - known)
                 telemetry.get_tracer().mark(
                     "executor_restart", trace="executor%d" % eid,
+                    severity="warn",
                     executor_id=eid, generation=rec["generation"],
                 )
         dead = self.server.liveness.dead()
@@ -173,6 +175,16 @@ class ClusterMonitor(object):
             if err:
                 msg += "\nlast error from executor {0}:\n{1}".format(eid, err)
         logger.error("cluster monitor: %s", msg)
+        # page-severity journal event: the forensics plane's
+        # dead-executor trigger (the driver-side flight recorder dumps
+        # on it, telemetry/blackbox.py)
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "executor_dead", trace="executor%d" % eid, severity="page",
+            executor_id=eid, reason=diag["reason"],
+            host=diag.get("host") or node_meta.get("host", "?"),
+        )
         self.error = msg
         self.dead_executor_id = eid
 
@@ -212,6 +224,7 @@ class ClusterMonitor(object):
         "compute_alive", "host"}}``."""
         store = self.server.metrics.snapshot()
         liveness = self.server.liveness.snapshot()
+        clocks = self.server.clocks.snapshot()
         per = {}
         for eid_s in set(store) | set(liveness):
             rec = {}
@@ -225,6 +238,12 @@ class ClusterMonitor(object):
                 rec["generation"] = lv["generation"]
                 rec["compute_alive"] = lv["compute_alive"]
                 rec["host"] = lv["host"]
+            clk = clocks.get(eid_s)
+            if clk is not None:
+                # seconds to ADD to this executor's wall timestamps to
+                # land them on the driver clock (reservation.ClockSync)
+                rec["clock_offset"] = clk["offset"]
+                rec["clock_rtt"] = clk["rtt"]
             per[int(eid_s)] = rec
         return per
 
@@ -842,7 +861,66 @@ class TPUCluster(object):
             self.monitor.restart_events if self.monitor is not None else 0
         )
         view["generation"] = self.server.generation
+        # the SLO engine's bounded alert HISTORY (fired + resolved,
+        # ISSUE 11 satellite): what paged during a window that already
+        # cleared, visible without the HTTP surface
+        if self.health is not None and self.health.slo is not None:
+            view["fleet"]["alert_history"] = (
+                self.health.slo.alert_history()
+            )
         return view
+
+    def journal(self, limit=None):
+        """The fleet's typed-event record (ISSUE 11): every executor's
+        journal events shipped over the heartbeat piggyback into the
+        reservation server's EventStore, merged time-ordered, plus the
+        per-executor clock offsets that align them onto the driver
+        clock.  Returns ``{"events": [event dicts], "clocks":
+        {executor: {"offset", "rtt"}}}`` — exactly what ``python -m
+        tensorflowonspark_tpu.forensics explain`` consumes (pass
+        ``json.dump`` output of this, or a flight-recorder bundle)."""
+        return {
+            "events": self.server.events.snapshot(limit=limit),
+            "clocks": self.server.clocks.snapshot(),
+        }
+
+    def collect_dumps(self, dest=None):
+        """Collect every node's flight-recorder dump index (ISSUE 11):
+        reads each worker's ``blackbox_dumps`` kv (published by the
+        recorder on every dump — telemetry/blackbox.py) through the
+        existing manager connections.  Returns ``{executor_id: [dump
+        record dicts]}``; with ``dest``, bundle files reachable on
+        this host are also copied there (LocalEngine clusters share
+        the filesystem; remote fleets ship paths for out-of-band
+        collection)."""
+        out = {}
+        for n in self.cluster_info:
+            try:
+                m = self._connect(n)
+                recs = m.get("blackbox_dumps")
+                if hasattr(recs, "_getvalue"):
+                    recs = recs._getvalue()
+            except Exception:  # noqa: BLE001 - node mid-restart/gone
+                continue
+            if not isinstance(recs, list) or not recs:
+                continue
+            out[n["executor_id"]] = recs
+        if dest is not None:
+            import shutil
+
+            os.makedirs(dest, exist_ok=True)
+            for eid, recs in out.items():
+                for rec in recs:
+                    path = rec.get("path")
+                    if path and os.path.exists(path):
+                        try:
+                            shutil.copy2(path, dest)
+                        except OSError:
+                            logger.warning(
+                                "unable to copy dump %s", path,
+                                exc_info=True,
+                            )
+        return out
 
     # -- fleet health plane (ISSUE 10; docs/observability.md) ----------
 
@@ -905,6 +983,7 @@ class TPUCluster(object):
             on_straggler=on_straggler,
             on_straggler_cleared=on_straggler_cleared,
             liveness_fn=self.server.liveness.health,
+            journal_fn=self.journal,
         )
         _health.register_status_provider("ledger", self._ledger_status)
         plane.start()
@@ -1189,6 +1268,11 @@ def run(
         num_executors, heartbeat_interval=heartbeat_interval
     )
     server_addr = server.start()
+    # driver-side fault events (the monitor's executor_dead verdict)
+    # never ride a heartbeat — bridge this process's journal into the
+    # fleet EventStore so TPUCluster.journal() carries the driver's
+    # view of an incident too (executor -1 = the driver)
+    server.attach_local_journal()
 
     cluster_meta = {
         "id": "{0}-{1}".format(name, uuid.uuid4().hex[:8]),

@@ -385,6 +385,18 @@ def _compute_process_main(fn_bytes, args, ctx):
     from tensorflowonspark_tpu import telemetry as _telemetry
 
     _publisher = _telemetry.start_node_publisher(ctx.mgr)
+    # incident forensics (ISSUE 11): stamp this process's journal with
+    # its executor id and arm the flight recorder — fault events
+    # (watchdog fires, swap rollbacks, ...) freeze the recent rings
+    # into a dump bundle, indexed into the node kv so the driver's
+    # collect_dumps() finds them (telemetry/blackbox.py; install()
+    # returns None when disabled)
+    _telemetry.get_journal().set_identity(ctx.executor_id)
+    from tensorflowonspark_tpu.telemetry import blackbox as _blackbox
+
+    _recorder = _blackbox.install()
+    if _recorder is not None:
+        _recorder.attach_kv(ctx.mgr)
     # on-demand device profiling: TFOS_PROFILE_DIR / TFOS_PROFILE_STEPS
     # start a jax.profiler trace for this compute process (graceful
     # no-op when the build lacks the profiler — see tensorboard.py)
@@ -729,6 +741,28 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
             ctx.mgr = mgr
             from tensorflowonspark_tpu import telemetry as _telemetry
 
+            _events_fn = None
+            if _telemetry.enabled():
+                # forensics plane (ISSUE 11): same contract as the
+                # supervisor path — journal identity, fault-triggered
+                # flight recorder with its kv dump index, and journal
+                # events shipped on the beats
+                _telemetry.get_journal().set_identity(executor_id)
+                from tensorflowonspark_tpu.telemetry import (
+                    blackbox as _blackbox,
+                )
+
+                _fg_recorder = _blackbox.install()
+                if _fg_recorder is not None:
+                    _fg_recorder.attach_kv(mgr)
+
+                def _events_fn():
+                    return [
+                        e.to_dict()
+                        for e in _telemetry.get_journal()
+                        .drain_unshipped(64)
+                    ]
+
             hb = reservation.Heartbeater(
                 cluster_meta["server_addr"],
                 executor_id,
@@ -740,6 +774,7 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
                     _telemetry.get_registry().snapshot
                     if _telemetry.enabled() else None
                 ),
+                events_fn=_events_fn,
             ).start()
             try:
                 fn(args, ctx)

@@ -26,6 +26,7 @@ the coordinator address + topology and enforces the startup barrier
 (SURVEY.md §5 'Distributed communication backend').
 """
 
+import collections
 import json
 import logging
 import os
@@ -266,6 +267,130 @@ class MetricsStore(object):
             }
 
 
+class ClockSync(object):
+    """Per-executor clock-offset estimation from heartbeat RTTs.
+
+    NTP's client-side sample: the heartbeater records ``t0`` (its wall
+    clock before the frame), the server's reply carries
+    ``server_time``, and ``t1`` lands on receipt; assuming a symmetric
+    path, ``offset = server_time - (t0 + t1) / 2`` with uncertainty
+    bounded by ``rtt = t1 - t0``.  The node reports each sample on its
+    next beat and this registry keeps, per executor, the sample with
+    the SMALLEST rtt among the last :data:`CLOCK_WINDOW` — minimum-rtt
+    selection is the standard defense against queueing-delay asymmetry
+    (one cleanly-timed exchange beats an average of congested ones).
+
+    ``offset(eid)`` is the seconds to ADD to that executor's local
+    wall-clock timestamps to land them on the server (driver) clock —
+    what the forensics analyzer and
+    :func:`~tensorflowonspark_tpu.telemetry.tracing.merge_traces`
+    align merged fleet timelines with (ISSUE 11 tentpole).
+    """
+
+    #: Samples retained per executor for the min-rtt pick.
+    CLOCK_WINDOW = 8
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = {}  # eid -> deque[(rtt, offset)]
+
+    def update(self, executor_id, offset, rtt):
+        try:
+            offset, rtt = float(offset), float(rtt)
+        except (TypeError, ValueError):
+            return
+        if rtt < 0:
+            return
+        with self._lock:
+            dq = self._samples.setdefault(
+                int(executor_id),
+                collections.deque(maxlen=self.CLOCK_WINDOW),
+            )
+            dq.append((rtt, offset))
+
+    def offset(self, executor_id):
+        """Best (min-rtt) offset estimate in seconds, or None when the
+        executor never reported a sample."""
+        with self._lock:
+            dq = self._samples.get(int(executor_id))
+            if not dq:
+                return None
+            return min(dq, key=lambda s: s[0])[1]
+
+    def snapshot(self):
+        """``{executor_id(str): {"offset": secs, "rtt": secs}}`` for
+        every tracked executor (string keys — JSON wire format)."""
+        with self._lock:
+            out = {}
+            for eid, dq in self._samples.items():
+                if not dq:
+                    continue
+                rtt, off = min(dq, key=lambda s: s[0])
+                out[str(eid)] = {"offset": off, "rtt": rtt}
+            return out
+
+
+def estimate_offset(t0, server_time, t1):
+    """One NTP-style sample: ``(offset, rtt)`` from a request sent at
+    ``t0`` (client clock), answered with ``server_time`` (server
+    clock), received at ``t1`` (client clock)."""
+    return float(server_time) - (float(t0) + float(t1)) / 2.0, (
+        float(t1) - float(t0)
+    )
+
+
+class EventStore(object):
+    """Server-side fleet journal: the newest typed events per executor,
+    shipped piggybacked on HEARTBEAT frames (the journal half of the
+    telemetry piggyback path — see telemetry/journal.py).
+
+    One bounded ring fleet-wide (env-tunable:
+    TFOS_FLEET_JOURNAL_MAX).  Per-(executor, pid) seq high-water marks
+    dedup re-sent frames: journal seqs are process-monotonic, so an
+    event with ``seq <= seen[(eid, pid)]`` was already stored — and a
+    RESTARTED compute process (new pid) starts a fresh watermark
+    instead of being masked by its dead predecessor's.
+    """
+
+    MAX_EVENTS = int(os.environ.get("TFOS_FLEET_JOURNAL_MAX", "8192"))
+
+    def __init__(self, max_events=None):
+        self._lock = threading.Lock()
+        self._events = collections.deque(
+            maxlen=self.MAX_EVENTS if max_events is None else int(max_events)
+        )
+        self._seen = {}  # (eid, pid) -> max seq stored
+
+    def extend(self, executor_id, events):
+        if not events:
+            return 0
+        eid = int(executor_id)
+        stored = 0
+        with self._lock:
+            for ev in events:
+                if not isinstance(ev, dict):
+                    continue
+                key = (eid, ev.get("pid", 0))
+                seq = ev.get("seq", 0)
+                if seq and seq <= self._seen.get(key, 0):
+                    continue
+                self._seen[key] = max(self._seen.get(key, 0), seq)
+                rec = dict(ev)
+                rec.setdefault("executor", eid)
+                self._events.append(rec)
+                stored += 1
+        return stored
+
+    def snapshot(self, limit=None):
+        """Time-ordered list of stored event dicts (newest last)."""
+        with self._lock:
+            out = list(self._events)
+        out.sort(key=lambda e: e.get("ts", 0.0))
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+
 class MessageSocket(object):
     """Length-prefixed JSON framing over a TCP socket
     (reference: reservation.py:68-97, re-done without pickle)."""
@@ -308,9 +433,14 @@ class Server(MessageSocket):
         self.reservations = Reservations(count)
         self.liveness = Liveness(heartbeat_interval, miss_threshold)
         self.metrics = MetricsStore()
+        #: fleet journal + per-executor clock offsets (ISSUE 11): both
+        #: fed by HEARTBEAT frames, read back via the JOURNAL wire op
+        self.events = EventStore()
+        self.clocks = ClockSync()
         self.done = threading.Event()
         self._stop_requested = threading.Event()
         self._listener = None
+        self._journal_listener = None
         #: elastic re-rendezvous generation — bumped by REBIRTH frames
         self._generation = 0
         self._gen_lock = threading.Lock()
@@ -432,14 +562,29 @@ class Server(MessageSocket):
                 self.metrics.update(
                     msg.get("executor_id", -1), msg["metrics"]
                 )
+            # journal events + the node's NTP-style clock sample ride
+            # the same frame (ISSUE 11 — still one connection)
+            if msg.get("events"):
+                self.events.extend(
+                    msg.get("executor_id", -1), msg["events"]
+                )
+            clk = msg.get("clock")
+            if isinstance(clk, dict):
+                self.clocks.update(
+                    msg.get("executor_id", -1),
+                    clk.get("offset"), clk.get("rtt"),
+                )
             # stop flag + cluster generation piggyback on the reply, so
-            # heartbeaters double as the survivors' rebirth signal
+            # heartbeaters double as the survivors' rebirth signal;
+            # server_time is the clock-sync sample the NEXT beat
+            # reports back (estimate_offset)
             self.send(
                 sock,
                 {
                     "type": "OK",
                     "stop": self.stop_requested,
                     "generation": self.generation,
+                    "server_time": time.time(),
                 },
             )
         elif mtype == "FAREWELL":
@@ -461,6 +606,21 @@ class Server(MessageSocket):
                     "type": "METRICS_RESP",
                     "executors": self.metrics.snapshot(),
                     "liveness": self.liveness.snapshot(),
+                    "clocks": self.clocks.snapshot(),
+                    "generation": self.generation,
+                },
+            )
+        elif mtype == "JOURNAL":
+            # the forensics pull: the fleet's merged typed-event record
+            # plus the clock offsets that align it (ISSUE 11)
+            self.send(
+                sock,
+                {
+                    "type": "JOURNAL_RESP",
+                    "events": self.events.snapshot(
+                        limit=msg.get("limit")
+                    ),
+                    "clocks": self.clocks.snapshot(),
                     "generation": self.generation,
                 },
             )
@@ -517,8 +677,35 @@ class Server(MessageSocket):
         logger.info("all reservations completed")
         return self.reservations.get()
 
+    def attach_local_journal(self, executor_id=-1):
+        """Feed THIS process's journal into the fleet EventStore.
+
+        The server lives in the driver, and driver-side fault events
+        (the monitor's ``executor_dead`` verdict, requeue decisions)
+        never ride a heartbeat — without this bridge the fleet record
+        would lack exactly the driver's view of the incident.
+        ``executor_id`` defaults to ``-1``, the driver sentinel.
+        Idempotent; the listener detaches on :meth:`stop`."""
+        if self._journal_listener is not None:
+            return self
+        from tensorflowonspark_tpu.telemetry import journal as _journal
+
+        store, eid = self.events, int(executor_id)
+
+        def _listener(ev):
+            store.extend(eid, [ev.to_dict()])
+
+        _journal.get_journal().add_listener(_listener)
+        self._journal_listener = _listener
+        return self
+
     def stop(self):
         self.done.set()
+        if self._journal_listener is not None:
+            from tensorflowonspark_tpu.telemetry import journal as _journal
+
+            _journal.get_journal().remove_listener(self._journal_listener)
+            self._journal_listener = None
         if self._listener is not None:
             try:
                 self._listener.close()
@@ -635,12 +822,15 @@ class Client(MessageSocket):
         return self._request({"type": "STOP"})
 
     def heartbeat(self, executor_id, generation=0, compute_alive=True,
-                  host="", metrics=None):
+                  host="", metrics=None, events=None, clock=None):
         """Send one HEARTBEAT frame; returns the server's reply (which
         carries the cluster-wide ``stop`` flag, so heartbeaters double
         as stop-signal listeners).  ``metrics`` optionally piggybacks a
         telemetry registry snapshot (plain dict) for the server's
-        :class:`MetricsStore`."""
+        :class:`MetricsStore`; ``events`` a list of journal event
+        dicts for its :class:`EventStore`; ``clock`` the node's latest
+        ``{"offset", "rtt"}`` NTP-style sample for its
+        :class:`ClockSync`."""
         frame = {
             "type": "HEARTBEAT",
             "executor_id": int(executor_id),
@@ -650,6 +840,10 @@ class Client(MessageSocket):
         }
         if metrics is not None:
             frame["metrics"] = metrics
+        if events:
+            frame["events"] = list(events)
+        if clock is not None:
+            frame["clock"] = clock
         return self._request(frame)
 
     def get_metrics(self):
@@ -659,6 +853,17 @@ class Client(MessageSocket):
         :func:`tensorflowonspark_tpu.telemetry.aggregate.merge_snapshots`."""
         resp = self._request({"type": "METRICS"})
         return resp["executors"], resp.get("liveness", {})
+
+    def get_journal(self, limit=None):
+        """Fetch the fleet journal: ``(events, clocks)`` — the merged
+        typed-event record (list of event dicts, time-ordered) and the
+        per-executor clock offsets that align it (string executor
+        keys — JSON wire format)."""
+        frame = {"type": "JOURNAL"}
+        if limit is not None:
+            frame["limit"] = int(limit)
+        resp = self._request(frame)
+        return resp["events"], resp.get("clocks", {})
 
     def get_liveness(self):
         """Fetch the server's liveness snapshot: ``(executors, dead)``
@@ -717,16 +922,31 @@ class Heartbeater(object):
         node half of the fleet telemetry plane (telemetry/aggregate.py).
         A None/falsy return or a raising fn simply ships a bare beat:
         liveness must never depend on observability.
+      events_fn: optional zero-arg callable returning journal event
+        dicts to piggyback (the node half of the fleet journal,
+        ISSUE 11).  Events whose beat failed are RETAINED (bounded)
+        and re-shipped on the next successful beat — the server-side
+        EventStore dedups by (pid, seq), so a retry is safe and a
+        fault record survives one dropped frame.
 
     A beat that cannot reach the server is logged and *dropped* — the
     next interval retries with a fresh connection.  Missing frames is
     precisely the failure signal the server-side registry measures, so
     the heartbeater must never block or die trying to be reliable.
+
+    Every beat also takes one NTP-style clock sample: ``t0`` before
+    the frame, the reply's ``server_time``, ``t1`` on receipt →
+    ``estimate_offset``; the sample ships on the NEXT frame so the
+    server's :class:`ClockSync` can align this node's timestamps.
     """
+
+    #: Cap on retained-but-unshipped journal events (a long partition
+    #: must not grow the backlog without bound; the newest survive).
+    MAX_EVENT_BACKLOG = 512
 
     def __init__(self, server_addr, executor_id, interval=None,
                  alive_fn=None, generation_fn=None, host="", chaos_fn=None,
-                 metrics_fn=None):
+                 metrics_fn=None, events_fn=None):
         self.server_addr = tuple(server_addr)
         self.executor_id = int(executor_id)
         self.interval = (
@@ -737,10 +957,15 @@ class Heartbeater(object):
         self.host = host
         self.chaos_fn = chaos_fn
         self.metrics_fn = metrics_fn
+        self.events_fn = events_fn
         self.stop_seen = False  # server's stop flag, piggybacked on beats
         #: newest cluster generation seen in a reply — supervisors poll
         #: this to learn a peer was reborn (their cue to park/respawn)
         self.cluster_generation = 0
+        #: latest NTP-style sample of THIS node vs the server
+        #: (``{"offset", "rtt"}``), shipped on the next beat
+        self.clock = None
+        self._event_backlog = []
         self._stop = threading.Event()
         self._client = None
         self._thread = None
@@ -768,15 +993,38 @@ class Heartbeater(object):
                 metrics = self.metrics_fn()
             except Exception:  # noqa: BLE001 - see metrics_fn docstring
                 metrics = None
-        if self._client is None:
-            self._client = Client(
-                self.server_addr,
-                retry_deadline=max(1.0, self.interval),
+        events = list(self._event_backlog)
+        if self.events_fn is not None:
+            try:
+                events.extend(self.events_fn() or ())
+            except Exception:  # noqa: BLE001 - journal is best effort
+                pass
+        events = events[-self.MAX_EVENT_BACKLOG:]
+        t0 = time.time()
+        try:
+            if self._client is None:
+                self._client = Client(
+                    self.server_addr,
+                    retry_deadline=max(1.0, self.interval),
+                )
+            resp = self._client.heartbeat(
+                self.executor_id, generation=gen, compute_alive=alive,
+                host=self.host, metrics=metrics, events=events or None,
+                clock=self.clock,
             )
-        resp = self._client.heartbeat(
-            self.executor_id, generation=gen, compute_alive=alive,
-            host=self.host, metrics=metrics,
-        )
+        except Exception:
+            # the beat is dropped by contract, but the journal events
+            # it carried must not be: retain for the next beat (the
+            # server dedups by (pid, seq) if some actually landed)
+            self._event_backlog = events
+            raise
+        t1 = time.time()
+        self._event_backlog = []
+        if resp.get("server_time") is not None:
+            offset, rtt = estimate_offset(t0, resp["server_time"], t1)
+            self.clock = {
+                "offset": round(offset, 6), "rtt": round(rtt, 6),
+            }
         if resp.get("stop"):
             self.stop_seen = True
         self.cluster_generation = max(

@@ -95,6 +95,10 @@ class Supervisor(object):
         heartbeat (threaded through to :class:`reservation.Heartbeater`).
     """
 
+    #: kv key the compute-side NodePublisher mirrors its journal into
+    #: (telemetry/aggregate.py NodePublisher.KV_JOURNAL_KEY)
+    KV_JOURNAL_KEY = "journal_events"
+
     def __init__(self, fn_bytes, args, ctx, mgr, cluster_meta,
                  compute_eids, node_meta, chaos_fn=None):
         self.fn_bytes = fn_bytes
@@ -121,12 +125,27 @@ class Supervisor(object):
         self._thread = None
         self._chaos_fn = chaos_fn
         self._hint_logged = False
+        #: (pid, seq) cursor over the compute process's kv-mirrored
+        #: journal (telemetry/aggregate.py publish_journal) — a respawn
+        #: changes the pid and resets the cursor
+        self._journal_cursor = (0, 0)
 
     # -- lifecycle -----------------------------------------------------
 
     def start(self):
         """Spawn the compute process, prime the liveness registry, and
         start the watch thread.  Returns self."""
+        # this (executor) process records faults too: its journal gets
+        # the restart/leader-election events below, and the flight
+        # recorder dumps on them even when the compute process is too
+        # dead to dump for itself (telemetry/blackbox.py; None when
+        # TFOS_BLACKBOX=0 or telemetry disabled)
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_journal().set_identity(self.ctx.executor_id)
+        from tensorflowonspark_tpu.telemetry import blackbox
+
+        blackbox.install()
         self._spawn()
         self.heartbeater = reservation.Heartbeater(
             self.server_addr,
@@ -137,6 +156,7 @@ class Supervisor(object):
             host=self.node_meta.get("host", ""),
             chaos_fn=self._chaos_fn,
             metrics_fn=self._node_metrics,
+            events_fn=self._node_events,
         )
         try:
             # prime: death-by-silence is measured from "now", and the
@@ -212,6 +232,45 @@ class Supervisor(object):
                 "health plane", self.ctx.executor_id,
             )
         return snap
+
+    def _node_events(self):
+        """Journal events this beat ships to the reservation server's
+        fleet EventStore (ISSUE 11): this executor process's own
+        unshipped events (supervisor restarts, leader elections —
+        drained by cursor) plus the compute process's, mirrored into
+        the ``journal_events`` kv by its NodePublisher and shipped by
+        (pid, seq) watermark so nothing ships twice and a respawned
+        process (fresh pid) starts a fresh watermark."""
+        from tensorflowonspark_tpu import telemetry
+
+        out = [
+            dict(e.to_dict(), executor=self.ctx.executor_id)
+            for e in telemetry.get_journal().drain_unshipped(64)
+        ]
+        try:
+            rec = self.mgr.get(self.KV_JOURNAL_KEY)
+            if hasattr(rec, "_getvalue"):
+                rec = rec._getvalue()
+        except Exception:  # noqa: BLE001 - kv is best effort
+            rec = None
+        if isinstance(rec, dict) and rec.get("events"):
+            pid = rec.get("pid", 0)
+            cur_pid, cur_seq = self._journal_cursor
+            if pid != cur_pid:
+                cur_seq = 0
+            fresh = [
+                e for e in rec["events"]
+                if isinstance(e, dict) and e.get("seq", 0) > cur_seq
+            ]
+            if fresh:
+                self._journal_cursor = (
+                    pid, max(e.get("seq", 0) for e in fresh)
+                )
+                out.extend(
+                    dict(e, executor=self.ctx.executor_id)
+                    for e in fresh
+                )
+        return out or None
 
     def _proc_alive(self):
         """What the heartbeat's ``compute_alive`` flag reports.  A
@@ -329,6 +388,14 @@ class Supervisor(object):
             )
         )
         logger.error(msg)
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "restart_budget_exhausted",
+            trace="executor%d" % self.ctx.executor_id, severity="page",
+            executor_id=self.ctx.executor_id, restarts=self.restarts,
+            exitcode=self.proc.exitcode,
+        )
         try:
             self.mgr.get_queue("error").put(msg)
             self.mgr.set("compute_state", "failed")
@@ -355,6 +422,7 @@ class Supervisor(object):
 
         telemetry.get_tracer().mark(
             "restart", trace="executor%d" % self.ctx.executor_id,
+            severity="warn",
             executor_id=self.ctx.executor_id, exitcode=exitcode,
             restart=self.restarts,
         )

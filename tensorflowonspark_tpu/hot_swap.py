@@ -374,8 +374,8 @@ class CheckpointWatcher(object):
         self.stats["quarantined"] += 1
         self._m_quar.inc()
         self._tracer.mark(
-            "checkpoint_quarantined", trace="swap", step=step,
-            kind=kind,
+            "checkpoint_quarantined", trace="swap", severity="warn",
+            step=step, kind=kind,
         )
         logger.warning(
             "hot-swap: quarantined checkpoint step %s (%s): %s",

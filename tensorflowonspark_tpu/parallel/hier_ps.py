@@ -880,6 +880,14 @@ class HierTrainer(object):
         dead_link = self._link
         self._dead.add(dead_link.member_id)
         self._m_failover.inc()
+        # flight-recorder dump trigger (telemetry/blackbox.py): the
+        # DCN leader died mid-push — exactly the incident the
+        # forensics analyzer reconstructs from this process's rings
+        self._tracer.mark(
+            "leader_failover", trace="hier", severity="page",
+            pod=self.pod_id, dead_member=dead_link.member_id,
+            error=str(err),
+        )
         logger.warning(
             "pod %s leader (member %s) died mid-push (%s); re-electing",
             self.pod_id, dead_link.member_id, err,

@@ -477,6 +477,12 @@ class ServingEngine(object):
         # request under trace id "req<idx>" (docs/observability.md)
         reg = telemetry.get_registry()
         self._tracer = telemetry.get_tracer()
+        # always-on flight recorder (ISSUE 11): watchdog fires and
+        # swap rollbacks below freeze the recent rings into a dump
+        # bundle (telemetry/blackbox.py; None when disabled)
+        from tensorflowonspark_tpu.telemetry import blackbox as _blackbox
+
+        _blackbox.install()
         self._m_lat = reg.histogram(LATENCY_METRIC)
         self._m_queue_wait = reg.histogram("serving.queue_wait_sec")
         self._m = {
@@ -717,7 +723,7 @@ class ServingEngine(object):
                 self.stats["shed"] += 1
                 self._m["shed"].inc()
                 self._tracer.mark(
-                    "shed", trace="req%d" % req["idx"],
+                    "shed", trace="req%d" % req["idx"], severity="warn",
                     request_index=req["idx"],
                     queue_depth=self.queue_depth,
                 )
@@ -944,6 +950,9 @@ class ServingEngine(object):
             )
             self._tracer.mark(
                 mark_event, trace="req%d" % req["idx"],
+                severity=(
+                    "warn" if mark_event == "watchdog_recover" else "info"
+                ),
                 request_index=req["idx"], tokens_committed=len(committed),
             )
         self._pending[:0] = inflight
@@ -962,7 +971,7 @@ class ServingEngine(object):
             # new generation — roll back at the next scheduling pass
             self._probation_errors += 1
         self._tracer.mark(
-            "watchdog_fire", trace="serve",
+            "watchdog_fire", trace="serve", severity="page",
             inflight=len(self._slot_req), chunk=self._chunk_index - 1,
         )
         recovered = self._teardown_and_requeue("watchdog_recover")
@@ -1066,8 +1075,8 @@ class ServingEngine(object):
                     "back to the previous generation".format(w.step),
                 )
                 self._tracer.mark(
-                    "swap_rollback", trace="swap", step=w.step,
-                    reason="canary_failed",
+                    "swap_rollback", trace="swap", severity="page",
+                    step=w.step, reason="canary_failed",
                 )
                 self._set_generation()
                 return
@@ -1128,8 +1137,8 @@ class ServingEngine(object):
         )
         gen = self._set_generation()
         self._tracer.mark(
-            "swap_rollback", trace="swap", step=w.step,
-            generation=gen, reason=why,
+            "swap_rollback", trace="swap", severity="page",
+            step=w.step, generation=gen, reason=why,
         )
         logger.warning(
             "hot-swap: rolled back step %s -> generation %d (%s)",
@@ -1242,6 +1251,7 @@ class ServingEngine(object):
         self._m["expired"].inc()
         self._tracer.mark(
             "deadline_cancel", trace="req%d" % req["idx"],
+            severity="warn",
             request_index=req["idx"], tokens_done=len(committed),
         )
         self._record(

@@ -27,7 +27,20 @@ driver.  This package is the one place they all publish now
   and its dominant phase and auto-fires the profiler on it;
 - :mod:`~tensorflowonspark_tpu.telemetry.exposition` — the HTTP
   scrape surface: ``/metrics`` in OpenMetrics text (with the strict
-  parser the tests round-trip through), ``/healthz``, ``/status``.
+  parser the tests round-trip through), ``/healthz``, ``/status``,
+  ``/journal``;
+- :mod:`~tensorflowonspark_tpu.telemetry.journal` — the typed event
+  journal (ISSUE 11): bounded severity-split rings + rotated JSONL
+  persistence, auto-bridged from every ``Tracer.mark()`` site and
+  shipped fleet-wide over the heartbeat piggyback to the reservation
+  server's EventStore (clock-aligned via its heartbeat-RTT
+  ``ClockSync``);
+- :mod:`~tensorflowonspark_tpu.telemetry.blackbox` — the per-process
+  flight recorder: always-on rings frozen into dump bundles on fault
+  triggers (watchdog fire, swap rollback, supervisor restart, dead
+  executor, leader failover, page-severity alerts), analyzed
+  post-mortem by ``python -m tensorflowonspark_tpu.forensics
+  explain``.
 
 **Zero-cost-when-disabled**: ``TFOS_TELEMETRY=0`` (or
 ``set_enabled(False)``) makes every registry accessor return a shared
@@ -54,6 +67,18 @@ from tensorflowonspark_tpu.telemetry.registry import (  # noqa: F401
 from tensorflowonspark_tpu.telemetry.tracing import (  # noqa: F401
     Tracer,
     get_tracer,
+    merge_traces,
+)
+from tensorflowonspark_tpu.telemetry.journal import (  # noqa: F401
+    Event,
+    EventJournal,
+    get_journal,
+    load_journal,
+)
+from tensorflowonspark_tpu.telemetry.blackbox import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    load_dump,
 )
 from tensorflowonspark_tpu.telemetry.aggregate import (  # noqa: F401
     NodePublisher,

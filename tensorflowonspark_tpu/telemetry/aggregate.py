@@ -122,19 +122,26 @@ class NodePublisher(object):
     was triggered on the flagged node only."""
 
     KV_KEY = "metrics"
+    KV_JOURNAL_KEY = "journal_events"
     PROFILE_REQ_KEY = "profile_request"
     PROFILE_STATE_KEY = "profile_state"
 
-    def __init__(self, mgr, interval=None, registry=None):
+    #: Newest journal events kept in the kv (the supervisor ships by
+    #: seq cursor, so this only has to cover a few publish intervals).
+    JOURNAL_PUBLISH_MAX = 256
+
+    def __init__(self, mgr, interval=None, registry=None, journal=None):
         self.mgr = mgr
         self.interval = PUBLISH_INTERVAL if interval is None else float(
             interval
         )
         self.registry = registry
+        self.journal = journal
         self._stop = threading.Event()
         self._warned = False
         self._thread = None
         self._profile_seq = 0
+        self._journal_seq = 0
 
     def _snapshot(self):
         reg = self.registry or _registry.get_registry()
@@ -145,7 +152,6 @@ class NodePublisher(object):
         final state of a finished compute process is visible)."""
         try:
             self.mgr.set(self.KV_KEY, self._snapshot())
-            return True
         except Exception as e:  # noqa: BLE001 - observability best effort
             if not self._warned:
                 self._warned = True
@@ -154,6 +160,34 @@ class NodePublisher(object):
                     "(%s); will keep retrying quietly", e,
                 )
             return False
+        self.publish_journal()
+        return True
+
+    def publish_journal(self):
+        """Mirror this process's newest journal events into the node
+        kv (``journal_events``) — the compute half of the fleet
+        journal's heartbeat piggyback (ISSUE 11).  The kv holds one
+        cumulative window tagged with this pid; the supervisor ships
+        events whose seq is beyond its cursor (a restarted process's
+        fresh pid resets that cursor), so a publisher/reader race can
+        only re-send, never lose — and the server-side EventStore
+        dedups re-sends by (pid, seq)."""
+        from tensorflowonspark_tpu.telemetry import journal as _journal
+
+        j = self.journal or _journal.get_journal()
+        evs = j.tail(self.JOURNAL_PUBLISH_MAX)
+        newest = evs[-1].seq if evs else 0
+        if newest <= self._journal_seq:
+            return False
+        try:
+            self.mgr.set(self.KV_JOURNAL_KEY, {
+                "pid": os.getpid(),
+                "events": [e.to_dict() for e in evs],
+            })
+        except Exception:  # noqa: BLE001 - observability best effort
+            return False
+        self._journal_seq = newest
+        return True
 
     def check_profile_request(self):
         """Start a profiler capture when the driver requested one via

@@ -300,10 +300,20 @@ class _Handler(BaseHTTPRequestHandler):
                     200, "application/json",
                     json.dumps(plane.status()).encode("utf-8"),
                 )
+            elif path == "/journal" and hasattr(
+                plane, "journal_events"
+            ):
+                # the fleet's typed-event record (ISSUE 11): what the
+                # post-mortem analyzer consumes, as JSON
+                self._reply(
+                    200, "application/json",
+                    json.dumps(plane.journal_events()).encode("utf-8"),
+                )
             else:
                 self._reply(
                     404, "text/plain",
-                    b"not found; routes: /metrics /healthz /status\n",
+                    b"not found; routes: /metrics /healthz /status "
+                    b"/journal\n",
                 )
         except Exception as e:  # noqa: BLE001 - a scrape must see 500,
             logger.warning(  # not a dropped connection

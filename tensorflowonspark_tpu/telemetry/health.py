@@ -649,10 +649,18 @@ class SloEngine(object):
                     transitions.append(a)
                     self.history.append(a)
                     self._m_fired.inc()
+                    # the mark's severity IS the rule's severity — a
+                    # page-severity firing is a flight-recorder dump
+                    # trigger (telemetry/blackbox.py)
                     self._tracer.mark(
                         "alert_firing", trace="slo",
+                        severity=(
+                            rule.severity
+                            if rule.severity in ("warn", "page")
+                            else "warn"
+                        ),
                         rule=rule.name, value=value, threshold=threshold,
-                        executor=executor, severity=rule.severity,
+                        executor=executor,
                     )
                     logger.warning("SLO alert firing: %s", a.message)
             else:
@@ -690,6 +698,17 @@ class SloEngine(object):
             for name, s in sorted(self._state.items())
             if s["firing"]
         ]
+
+    def alert_history(self, limit=50):
+        """The bounded alert HISTORY (ISSUE 11 satellite): every
+        fired/resolved transition with its timestamp, newest last — so
+        an operator can see what paged during a window that already
+        cleared.  Rides ``/status`` (``alert_history``) and
+        ``TPUCluster.metrics()["fleet"]["alert_history"]``."""
+        out = [a.to_dict() for a in self.history]
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
 
 
 # ----------------------------------------------------------------------
@@ -927,8 +946,13 @@ class HealthPlane(object):
                  straggler=True, straggler_opts=None, on_straggler=None,
                  on_straggler_cleared=None, straggler_clear_rounds=5,
                  liveness_fn=None, max_snapshot_age=None, registry=None,
-                 merge_own_registry=True):
+                 merge_own_registry=True, journal_fn=None):
         self.metrics_fn = metrics_fn
+        #: zero-arg callable backing the ``/journal`` route: the fleet
+        #: event record (``TPUCluster.start_health_plane`` wires the
+        #: reservation server's EventStore; default = this process's
+        #: own journal — the local/serving-only shape)
+        self.journal_fn = journal_fn
         self.interval = SCRAPE_INTERVAL if interval is None else float(
             interval
         )
@@ -971,6 +995,12 @@ class HealthPlane(object):
         self._stop = threading.Event()
         self._thread = None
         self._exposition = None
+        # arm the process-wide flight recorder: the executor_dead /
+        # page-alert marks this plane emits are dump triggers
+        # (telemetry/blackbox.py; None when disabled)
+        from tensorflowonspark_tpu.telemetry import blackbox as _blackbox
+
+        _blackbox.install()
 
     @classmethod
     def local(cls, registry=None, **kwargs):
@@ -1066,7 +1096,7 @@ class HealthPlane(object):
             self._hinted.add(key)
             self._m_flagged.inc()
             self._tracer.mark(
-                "straggler_flagged", trace="health",
+                "straggler_flagged", trace="health", severity="warn",
                 executor=eid, phase=hint["phase"],
                 excess_sec=hint["excess_sec"],
             )
@@ -1185,6 +1215,11 @@ class HealthPlane(object):
             "scrapes": self.store.scrapes,
             "executors": per,
             "alerts": self.slo.active() if self.slo else [],
+            # fired/resolved transitions, newest last (ISSUE 11
+            # satellite): what paged even if it already cleared
+            "alert_history": (
+                self.slo.alert_history() if self.slo else []
+            ),
             "stragglers": sorted(
                 self.hints.values(), key=lambda h: h["executor"]
             ),
@@ -1194,6 +1229,23 @@ class HealthPlane(object):
             "providers": provider_statuses(),
         }
         return out
+
+    def journal_events(self, limit=None):
+        """The ``/journal`` payload: the fleet event record via
+        ``journal_fn`` when wired, else this process's own journal."""
+        if self.journal_fn is not None:
+            out = self.journal_fn()
+            if isinstance(out, dict):
+                return out
+            return {"events": out}
+        from tensorflowonspark_tpu.telemetry import journal as _journal
+
+        return {
+            "events": [
+                e.to_dict()
+                for e in _journal.get_journal().events(limit=limit)
+            ],
+        }
 
     # -- lifecycle ------------------------------------------------------
 

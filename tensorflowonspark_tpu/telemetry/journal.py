@@ -1,0 +1,388 @@
+"""Typed event journal: the fleet's durable incident record.
+
+Before this module, the only trace of a fault was an in-memory tracer
+mark — gone with the process, invisible across executors, and never
+written anywhere an operator could read after the fact.  The journal is
+the audited-event substrate ROADMAP item 4's policy engine will act
+through (ISSUE 11 tentpole; docs/observability.md "Incident
+forensics"):
+
+- :class:`Event` — one typed, structured occurrence: wall-clock ``ts``,
+  monotonic per-process ``seq``, ``executor``, ``severity``
+  (info/warn/page), ``kind``, optional ``trace`` id, and a flat
+  ``attrs`` dict.  Plain-dict serializable (``to_dict``/``from_dict``)
+  so events ride heartbeat frames, kv stores, and JSONL files
+  unchanged;
+- :class:`EventJournal` — the bounded per-process store.  TWO rings,
+  split by severity: routine ``info`` events (per-request ``emit``
+  marks, leader elections) and ``warn``/``page`` fault events each get
+  their own ``deque(maxlen=...)``, so a flood of routine events can
+  never evict the fault record an incident analysis needs.  Optional
+  size-rotated JSONL persistence (``journal.jsonl`` →
+  ``journal.jsonl.1`` → ...) makes the record survive the process;
+- **mark bridge** — every
+  :meth:`~tensorflowonspark_tpu.telemetry.tracing.Tracer.mark` call on
+  an enabled tracer forwards to its journal (the global one by
+  default), so the fault/action sites instrumented since PR 7
+  (supervisor restarts, watchdog fires, shed/deadline cancels,
+  swap/rollback/quarantine, leader elections, SLO alerts, straggler
+  flags) become journal events with zero new call-site code;
+- **listeners** — ``add_listener(fn)`` is the in-process event bus the
+  :mod:`~tensorflowonspark_tpu.telemetry.blackbox` flight recorder
+  subscribes its dump triggers to;
+- **shipping cursor** — ``drain_unshipped()`` / ``events_since(seq)``
+  feed the heartbeat piggyback path (cluster/reservation.py): each
+  node's supervisor ships new events to the reservation server's
+  fleet-wide :class:`~tensorflowonspark_tpu.cluster.reservation.
+  EventStore`, where the forensics analyzer and ``TPUCluster.
+  journal()`` read the merged, clock-alignable record.
+
+Disabled mode (``TFOS_TELEMETRY=0``): ``emit`` returns None and stores
+nothing — the journal follows the registry/tracer kill switch.
+"""
+
+import collections
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+
+from tensorflowonspark_tpu.telemetry import registry as _registry
+
+logger = logging.getLogger(__name__)
+
+#: The severity vocabulary, mirroring the SLO rule severities.  An
+#: unknown severity normalizes to ``warn`` — a fault site typo must
+#: surface loudly (in the fault ring), never vanish quietly.
+SEVERITIES = ("info", "warn", "page")
+
+#: Ring bound PER severity class (info ring and warn/page ring each,
+#: env-tunable: TFOS_JOURNAL_MAX_EVENTS).
+MAX_EVENTS = int(os.environ.get("TFOS_JOURNAL_MAX_EVENTS", "4096"))
+
+#: JSONL rotation threshold in bytes (env-tunable:
+#: TFOS_JOURNAL_MAX_BYTES) and rotated-file count
+#: (TFOS_JOURNAL_MAX_FILES).
+MAX_BYTES = int(os.environ.get("TFOS_JOURNAL_MAX_BYTES", str(1 << 20)))
+MAX_FILES = int(os.environ.get("TFOS_JOURNAL_MAX_FILES", "3"))
+
+#: Directory for the GLOBAL journal's JSONL persistence; unset (the
+#: default) keeps the global journal memory-only — zero disk writes
+#: unless an operator opts in.
+JOURNAL_DIR_ENV = "TFOS_JOURNAL_DIR"
+
+
+class Event(object):
+    """One typed journal event (see module docstring)."""
+
+    __slots__ = ("ts", "seq", "executor", "severity", "kind", "trace",
+                 "attrs", "pid")
+
+    def __init__(self, kind, ts=None, seq=0, executor=None,
+                 severity="info", trace=None, attrs=None, pid=None):
+        self.kind = str(kind)
+        self.ts = time.time() if ts is None else float(ts)
+        self.seq = int(seq)
+        self.executor = executor
+        self.severity = severity if severity in SEVERITIES else "warn"
+        self.trace = trace
+        self.attrs = dict(attrs) if attrs else {}
+        self.pid = os.getpid() if pid is None else int(pid)
+
+    def to_dict(self):
+        out = {
+            "ts": self.ts, "seq": self.seq, "kind": self.kind,
+            "severity": self.severity, "pid": self.pid,
+        }
+        if self.executor is not None:
+            out["executor"] = self.executor
+        if self.trace is not None:
+            out["trace"] = self.trace
+        if self.attrs:
+            out["attrs"] = self.attrs
+        return out
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(
+            d.get("kind", "?"), ts=d.get("ts"), seq=d.get("seq", 0),
+            executor=d.get("executor"),
+            severity=d.get("severity", "info"), trace=d.get("trace"),
+            attrs=d.get("attrs"), pid=d.get("pid"),
+        )
+
+    def __repr__(self):
+        return "Event({0} {1} seq={2} executor={3})".format(
+            self.severity, self.kind, self.seq, self.executor
+        )
+
+
+class EventJournal(object):
+    """Bounded, optionally-persisted per-process event store.
+
+    Args:
+      max_events: per-severity-class ring bound (info events and
+        warn/page events are stored in SEPARATE rings so routine
+        traffic cannot evict the fault record).
+      path: JSONL persistence base path (None = memory only).  The
+        live file is ``path``; on exceeding ``max_bytes`` it rotates to
+        ``path.1`` (older generations shift up, the oldest past
+        ``max_files`` is deleted).
+      executor: this process's executor id, stamped on every event
+        (settable later via :meth:`set_identity` — compute processes
+        learn their id after the journal exists).
+      clock: wall-clock source (injectable for the clock-skew tests).
+    """
+
+    def __init__(self, max_events=None, path=None, max_bytes=None,
+                 max_files=None, executor=None, registry=None,
+                 clock=None, enabled=None):
+        n = MAX_EVENTS if max_events is None else int(max_events)
+        self._info = collections.deque(maxlen=n)
+        self._fault = collections.deque(maxlen=n)
+        self.path = os.fspath(path) if path else None
+        self.max_bytes = MAX_BYTES if max_bytes is None else int(max_bytes)
+        self.max_files = MAX_FILES if max_files is None else int(max_files)
+        self.executor = executor
+        self._clock = clock or time.time
+        self._enabled = (
+            _registry._env_enabled() if enabled is None else bool(enabled)
+        )
+        self._registry = registry
+        self._m_events = None
+        self._m_dropped = None
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._listeners = []
+        self._ship_cursor = 0
+        #: events evicted from either ring (truncation made visible,
+        #: same contract as Tracer.dropped_spans)
+        self.dropped_events = 0
+
+    # -- identity / lifecycle -------------------------------------------
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def set_enabled(self, flag):
+        self._enabled = bool(flag)
+
+    def set_identity(self, executor):
+        """Stamp subsequent events with this executor id (compute
+        processes call this once their NodeContext is bound)."""
+        self.executor = executor
+
+    def add_listener(self, fn):
+        """Register ``fn(event)``, called synchronously after every
+        append.  A raising listener is logged and never propagates —
+        the journal must record faults, not cause them."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    # -- recording ------------------------------------------------------
+
+    def emit(self, kind, severity="info", trace=None, executor=None,
+             attrs=None, ts=None, **extra):
+        """Append one event; returns it (None when disabled).  ``attrs``
+        and keyword extras merge into the event's attrs dict."""
+        if not self._enabled:
+            return None
+        merged = dict(attrs) if attrs else {}
+        if extra:
+            merged.update(extra)
+        ev = Event(
+            kind, ts=self._clock() if ts is None else ts,
+            seq=next(self._seq),
+            executor=self.executor if executor is None else executor,
+            severity=severity, trace=trace, attrs=merged or None,
+        )
+        ring = self._info if ev.severity == "info" else self._fault
+        with self._lock:
+            if len(ring) == ring.maxlen:
+                self.dropped_events += 1
+                if self._m_dropped is None:
+                    self._m_dropped = self._reg().counter(
+                        "journal.dropped_events"
+                    )
+                self._m_dropped.inc()
+            ring.append(ev)
+            listeners = list(self._listeners)
+        if self._m_events is None:
+            self._m_events = self._reg().counter("journal.events")
+        self._m_events.inc()
+        if self.path is not None:
+            try:
+                self._persist(ev)
+            except OSError:
+                logger.warning(
+                    "journal persistence to %s failed", self.path,
+                    exc_info=True,
+                )
+        for fn in listeners:
+            try:
+                fn(ev)
+            except Exception:  # noqa: BLE001 - see add_listener
+                logger.warning("journal listener failed", exc_info=True)
+        return ev
+
+    def _reg(self):
+        return self._registry or _registry.get_registry()
+
+    # -- persistence ----------------------------------------------------
+
+    def _persist(self, ev):
+        line = json.dumps(ev.to_dict()) + "\n"
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        if size and size + len(line) > self.max_bytes:
+            self._rotate()
+        with open(self.path, "a") as f:
+            f.write(line)
+
+    def _rotate(self):
+        """Shift ``path.N`` up one generation; the oldest past
+        ``max_files`` rotations is deleted."""
+        oldest = "{0}.{1}".format(self.path, self.max_files)
+        if os.path.exists(oldest):
+            try:
+                os.remove(oldest)
+            except OSError:
+                pass
+        for i in range(self.max_files - 1, 0, -1):
+            src = "{0}.{1}".format(self.path, i)
+            if os.path.exists(src):
+                try:
+                    os.replace(src, "{0}.{1}".format(self.path, i + 1))
+                except OSError:
+                    pass
+        try:
+            os.replace(self.path, "{0}.1".format(self.path))
+        except OSError:
+            pass
+
+    # -- queries --------------------------------------------------------
+
+    def events(self, kind=None, severity=None, trace=None, limit=None):
+        """Snapshot of retained events (both rings), seq-ordered,
+        optionally filtered; ``limit`` keeps the newest N."""
+        with self._lock:
+            out = list(self._info) + list(self._fault)
+        out.sort(key=lambda e: e.seq)
+        if kind is not None:
+            out = [e for e in out if e.kind == kind]
+        if severity is not None:
+            out = [e for e in out if e.severity == severity]
+        if trace is not None:
+            out = [e for e in out if e.trace == trace]
+        if limit is not None:
+            out = out[-int(limit):]
+        return out
+
+    def tail(self, n):
+        return self.events(limit=n)
+
+    def count(self, kind, severity=None):
+        return len(self.events(kind=kind, severity=severity))
+
+    def events_since(self, seq, limit=None):
+        """Events with ``seq`` strictly greater than the given cursor
+        (the shipping primitive — seqs are process-monotonic)."""
+        out = [e for e in self.events() if e.seq > int(seq)]
+        if limit is not None:
+            out = out[: int(limit)]
+        return out
+
+    def drain_unshipped(self, limit=128):
+        """Events not yet returned by a previous drain (single-consumer
+        cursor — the node's heartbeat events_fn).  The cursor advances
+        only over what is RETURNED, so a bounded drain never skips."""
+        with self._lock:
+            cursor = self._ship_cursor
+        out = self.events_since(cursor, limit=limit)
+        if out:
+            with self._lock:
+                self._ship_cursor = max(self._ship_cursor, out[-1].seq)
+        return out
+
+    def clear(self):
+        with self._lock:
+            self._info.clear()
+            self._fault.clear()
+            self._ship_cursor = 0
+
+    def save(self, path):
+        """Write every retained event as JSONL (one manual snapshot —
+        distinct from the rotating live persistence); returns ``path``."""
+        with open(path, "w") as f:
+            for ev in self.events():
+                f.write(json.dumps(ev.to_dict()) + "\n")
+        return path
+
+
+def load_journal(path):
+    """Read a JSONL journal back as ``[Event]`` — includes rotated
+    generations (``path.N``, oldest first) when present.  Unparseable
+    lines are skipped with a warning (a torn final line from a killed
+    process must not sink the post-mortem)."""
+    path = os.fspath(path)
+    files = []
+    for i in range(MAX_FILES + 8, 0, -1):
+        p = "{0}.{1}".format(path, i)
+        if os.path.exists(p):
+            files.append(p)
+    if os.path.exists(path):
+        files.append(path)
+    out = []
+    for p in files:
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(Event.from_dict(json.loads(line)))
+                except (ValueError, TypeError):
+                    logger.warning("skipping unparseable journal line "
+                                   "in %s", p)
+    return out
+
+
+_GLOBAL = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def get_journal():
+    """The process-wide default journal (same enable story as the
+    default registry/tracer).  Persists to
+    ``$TFOS_JOURNAL_DIR/journal-<pid>.jsonl`` when that env var names a
+    directory; memory-only otherwise."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        with _GLOBAL_LOCK:
+            if _GLOBAL is None:
+                path = None
+                d = os.environ.get(JOURNAL_DIR_ENV)
+                if d:
+                    try:
+                        os.makedirs(d, exist_ok=True)
+                        path = os.path.join(
+                            d, "journal-{0}.jsonl".format(os.getpid())
+                        )
+                    except OSError:
+                        logger.warning(
+                            "cannot create journal dir %r; journal "
+                            "stays memory-only", d, exc_info=True,
+                        )
+                _GLOBAL = EventJournal(path=path)
+    return _GLOBAL

@@ -100,7 +100,7 @@ class _SpanCtx(object):
 class Tracer(object):
     """Bounded in-process span store (see module docstring)."""
 
-    def __init__(self, enabled=None, max_spans=None):
+    def __init__(self, enabled=None, max_spans=None, journal=None):
         self._enabled = (
             _registry._env_enabled() if enabled is None else bool(enabled)
         )
@@ -117,6 +117,17 @@ class Tracer(object):
         #: perf_counter at construction — span timestamps are relative
         #: to this epoch (Chrome-trace ``ts`` microseconds)
         self.epoch = time.perf_counter()
+        #: wall clock at the same instant: ``epoch_wall + span["t0"]``
+        #: maps a span onto the journal/clock-sync wall timeline — what
+        #: the forensics analyzer aligns cross-executor traces with
+        self.epoch_wall = time.time()
+        #: journal every mark() bridges into (ISSUE 11): None = the
+        #: process-wide default, resolved lazily; pass an explicit
+        #: EventJournal to isolate (tests)
+        self._journal = journal
+        #: Chrome-trace process label (merge_traces/export metadata);
+        #: defaults to "pid<pid>"
+        self.process_name = None
 
     # -- enable/disable -------------------------------------------------
 
@@ -152,17 +163,43 @@ class Tracer(object):
             name, trace, next(self._ids), None, t0, dur, attrs or None
         )
 
-    def mark(self, name, trace=None, **attrs):
+    def mark(self, name, trace=None, severity="info", attrs=None,
+             **extra):
         """Record an instantaneous event (zero-duration span) — shed /
-        watchdog / restart markers the chaos tests assert on."""
+        watchdog / restart markers the chaos tests assert on.
+
+        ISSUE 11: marks carry an explicit ``severity``
+        (info/warn/page) and a structured attrs dict (``attrs`` merges
+        with keyword extras), and every mark auto-bridges into the
+        tracer's :class:`~tensorflowonspark_tpu.telemetry.journal.
+        EventJournal` — the fault sites instrumented since PR 7 become
+        typed journal events with no new call-site code.  The span
+        record and Chrome export keep their old shape for existing
+        consumers (severity rides along as one more field/arg)."""
         if not self._enabled:
             return
+        merged = dict(attrs) if attrs else {}
+        if extra:
+            merged.update(extra)
         self._record(
             name, trace, next(self._ids), None, time.perf_counter(),
-            0.0, attrs or None,
+            0.0, merged or None, severity=severity,
         )
+        j = self._journal
+        if j is None:
+            from tensorflowonspark_tpu.telemetry import journal as _journal
 
-    def _record(self, name, trace, span_id, parent, t0, dur, attrs):
+            j = _journal.get_journal()
+        try:
+            j.emit(
+                name, severity=severity, trace=trace,
+                attrs=merged or None,
+            )
+        except Exception:  # noqa: BLE001 - the mark already landed;
+            pass  # journalling must never break the instrumented path
+
+    def _record(self, name, trace, span_id, parent, t0, dur, attrs,
+                severity=None):
         if len(self._spans) == self._spans.maxlen:
             # the deque is about to silently evict its oldest span —
             # count it into the registry so truncation shows up in
@@ -185,6 +222,8 @@ class Tracer(object):
             rec["parent"] = parent
         if attrs:
             rec["attrs"] = attrs
+        if severity is not None:
+            rec["severity"] = severity
         self._spans.append(rec)
 
     # -- introspection / export -----------------------------------------
@@ -215,15 +254,34 @@ class Tracer(object):
     def export_chrome(self):
         """Chrome-trace / Perfetto JSON object.  Spans map to complete
         ('X') events; the trace id rides ``args.trace`` and the span
-        tree rides ``args.parent``."""
+        tree rides ``args.parent``.  Also carries ``process_name`` /
+        ``thread_name`` metadata ('M') events — appended AFTER the
+        spans, so old consumers indexing ``traceEvents[0]`` still see
+        the first span — keeping a merged multi-executor trace
+        (:func:`merge_traces`) row-named."""
         pid = os.getpid()
+        pname = self.process_name or "pid{0}".format(pid)
         events = []
+        meta = [{
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": pname},
+        }]
+        tids = []
         for s in list(self._spans):
+            if s["tid"] not in tids:
+                tids.append(s["tid"])
+                meta.append({
+                    "name": "thread_name", "ph": "M", "pid": pid,
+                    "tid": s["tid"],
+                    "args": {"name": "thread-{0}".format(s["tid"])},
+                })
             args = dict(s.get("attrs") or {})
             if s.get("trace") is not None:
                 args["trace"] = s["trace"]
             if s.get("parent") is not None:
                 args["parent"] = s["parent"]
+            if s.get("severity") is not None:
+                args["severity"] = s["severity"]
             events.append({
                 "name": s["name"],
                 "ph": "X",
@@ -233,13 +291,63 @@ class Tracer(object):
                 "tid": s["tid"],
                 "args": args,
             })
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {"traceEvents": events + meta, "displayTimeUnit": "ms"}
 
     def save(self, path):
         """Write the Chrome-trace JSON; returns ``path``."""
         with open(path, "w") as f:
             json.dump(self.export_chrome(), f)
         return path
+
+
+def merge_traces(parts):
+    """Merge per-executor Chrome traces into ONE Perfetto-loadable
+    file, applying the estimated clock offsets (ISSUE 11 satellite).
+
+    ``parts`` is a list of ``(trace, offset_sec, label)`` tuples (or
+    dicts with ``trace``/``offset``/``label`` keys): ``trace`` is a
+    Chrome-trace object (``{"traceEvents": [...]}``, as
+    :meth:`Tracer.export_chrome` produces), ``offset_sec`` is the
+    seconds to ADD to that executor's timestamps to land them on the
+    reference (driver) clock (``ClockSync.offset`` — see
+    cluster/reservation.py), and ``label`` names the merged trace's
+    process row (overriding any ``process_name`` metadata).
+
+    Colliding pids across parts are remapped (part index becomes the
+    pid) so two executors that happen to share an OS pid never
+    interleave rows.  Non-metadata events come back time-sorted —
+    causally ordered across executors once the offsets are right."""
+    events = []
+    meta = []
+    for i, part in enumerate(parts):
+        if isinstance(part, dict):
+            trace = part.get("trace") or {}
+            offset = float(part.get("offset", 0.0) or 0.0)
+            label = part.get("label")
+        else:
+            trace, offset = part[0], float(part[1] or 0.0)
+            label = part[2] if len(part) > 2 else None
+        shift_us = offset * 1e6
+        named = False
+        for ev in (trace or {}).get("traceEvents", []):
+            ev = dict(ev, pid=i)
+            if ev.get("ph") == "M":
+                if ev.get("name") == "process_name":
+                    if label is not None:
+                        ev["args"] = {"name": label}
+                    named = True
+                meta.append(ev)
+                continue
+            if "ts" in ev:
+                ev["ts"] = round(ev["ts"] + shift_us, 3)
+            events.append(ev)
+        if not named and label is not None:
+            meta.append({
+                "name": "process_name", "ph": "M", "pid": i, "tid": 0,
+                "args": {"name": label},
+            })
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 _GLOBAL = None

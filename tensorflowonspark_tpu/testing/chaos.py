@@ -44,6 +44,15 @@ that fails must fail the same way every run):
   while a dispatch wedges — watchdog recovery and the swap must both
   land, dropping nothing).
 
+Every injected fault also leaves a FORENSIC record (ISSUE 11): the
+fault sites it drives all mark the tracer, marks bridge into the
+typed event journal, and the flight recorder dumps on the fault kinds
+— so ``python -m tensorflowonspark_tpu.forensics explain`` over a
+chaos run's dumps must name the injected fault in the chaos-plan
+vocabulary (``wedge_dispatch``, ``kill_leader``, ``kill``; see
+``forensics.FAULT_MAP``) and the executor it targeted
+(tests/test_blackbox.py pins the wedge + kill-leader e2e).
+
 Nothing here runs unless a test opts in: ``heartbeat_chaos_fn`` returns
 ``None`` when ``TFOS_CHAOS_PLAN`` is unset, so production paths carry a
 single dict lookup of overhead.

@@ -128,6 +128,10 @@ def _full_record():
             "health_overhead_pct": 1.6,
             "alerts_fired": 1,
             "health_scrapes": 34,
+            "forensics_overhead_pct": 1.8,
+            "serving_forensics_overhead_pct": 1.5,
+            "forensics_dumps": 1,
+            "journal_events": 42,
         },
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
                          "async_compressed_steps_per_sec": 61.7,
@@ -176,6 +180,8 @@ def test_summary_is_compact_standalone_json(tmp_path):
     # health plane (ISSUE 10): scrape+SLO+straggler+exposition riding
     assert parsed["health_overhead_pct"] == 1.6
     assert parsed["alerts_fired"] == 1
+    # forensics plane (ISSUE 11): journal + flight recorder live
+    assert parsed["forensics_overhead_pct"] == 1.8
     assert parsed["wall_sec"] == 741.2
 
 
@@ -193,7 +199,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",
         "serving_u8_vs_f32",
         "decode_overlap_gain", "telemetry_overhead_pct",
-        "health_overhead_pct", "alerts_fired", "wall_sec",
+        "health_overhead_pct", "alerts_fired",
+        "forensics_overhead_pct", "wall_sec",
         "full_record",
     ])
 
