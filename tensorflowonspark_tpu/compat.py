@@ -58,6 +58,27 @@ def axis_size(axis_name):
     return frame if isinstance(frame, int) else frame.size
 
 
+def pallas_interpret():
+    """True off-TPU: the repo's pallas kernels (flash/gmm/paged
+    attention) run under ``interpret=True`` on CPU so tier-1 exercises
+    the real kernel path without TPU hardware."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def pallas_compiler_params(dimension_semantics):
+    """Mosaic compiler params across jax versions (the
+    ``TPUCompilerParams`` → ``CompilerParams`` rename); every pallas
+    call site routes its ``dimension_semantics`` through here."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    params_cls = getattr(pltpu, "CompilerParams", None) or (
+        pltpu.TPUCompilerParams
+    )
+    return params_cls(dimension_semantics=tuple(dimension_semantics))
+
+
 def supports_cpu_multiprocess():
     """True when this jax build can form multi-process groups on the
     CPU backend (Gloo cross-process collectives).  Some builds compile

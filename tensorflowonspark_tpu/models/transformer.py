@@ -74,6 +74,31 @@ class TransformerConfig:
     #: the dequant fuses into the attention einsum's operand read, same
     #: trick as quantize.py's weights)
     cache_dtype: str = "bfloat16"
+    #: decode KV layout: "contiguous" (per-slot banks ``[B, L, Hkv,
+    #: D]``) or "paged" — KV lives in ONE physical page pool per layer
+    #: ``[kv_pages, kv_page_tokens, Hkv, D]`` addressed by per-slot
+    #: block tables, attention runs the ops/paged_attention.py
+    #: block-gather kernel, and cached admits install page INDICES
+    #: instead of copying banks (the SlotDecoder sets the pool
+    #: geometry via dataclasses.replace; see docs/serving.md "Paged
+    #: KV & int4").  Decode-path only — training/prefill-from-scratch
+    #: semantics are identical.
+    kv_layout: str = "contiguous"
+    #: paged-layout pool geometry (set by the SlotDecoder, not by hand)
+    kv_pages: int = 0
+    kv_page_tokens: int = 16
+    #: block-table width: logical blocks per slot (ceil(bank/page))
+    kv_slot_blocks: int = 0
+    #: live bank span in tokens — multi-token paged attention slices
+    #: its gathered banks to this width so einsum/mask shapes match the
+    #: contiguous layout exactly (0 = the full table span)
+    kv_span: int = 0
+    #: single-token paged decode implementation: "kernel" (the pallas
+    #: block-gather kernel — the TPU hot path; interpret-mode on CPU)
+    #: or "gather" (XLA gather + dense attention — interpret-free, the
+    #: right CPU serving choice; numerics match the multi-token path
+    #: bit for bit).  Multi-token spans always use the gather path.
+    paged_decode_impl: str = "kernel"
     # MoE: num_experts > 0 swaps the dense MLP for an expert-parallel
     # MoE FFN (models/moe.py) in every block
     num_experts: int = 0
@@ -119,7 +144,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, decode=False, pad_start=None,
-                 per_slot=False):
+                 per_slot=False, block_tables=None):
         cfg = self.cfg
         h, d = cfg.num_heads, cfg.head_dim
         hkv = cfg.num_kv_heads or h
@@ -146,6 +171,10 @@ class Attention(nn.Module):
             v = dense("v", (hkv, d))(x)
         q = rope(q, positions)
         k = rope(k, positions)
+        if decode and cfg.kv_layout == "paged":
+            return self._paged_decode(
+                x, q, k, v, positions, block_tables, hkv, d
+            )
         if decode:
             # KV-cache autoregressive path: keys/values append at the
             # write pointer (cache stores POST-rope keys — RoPE is
@@ -310,6 +339,103 @@ class Attention(nn.Module):
             name="out",
         )(out)
 
+    def _paged_decode(self, x, q, k, v, positions, block_tables, hkv, d):
+        """Paged-KV decode (``kv_layout="paged"``): the per-layer cache
+        is ONE physical page pool ``[kv_pages, kv_page_tokens, Hkv,
+        Dx]`` shared by every slot; ``block_tables [B, kv_slot_blocks]``
+        maps each slot's logical blocks to physical pages.  New K/V
+        scatter into the pool at ``pool[table[b, pos // T], pos % T]``
+        (slots own their writable pages exclusively — the allocator
+        guarantees it — so the batch scatter never collides on live
+        pages; idle lanes' tables point at the reserved trash page).
+        Attention reads the pool through the block table: the
+        ops/paged_attention.py kernel for single-token steps (the hot
+        loop), the gather fallback for multi-token spans (canonical
+        suffix prefill, speculative verify).  Positions are CANONICAL
+        (token ``i`` at cache position ``i``) — the paged engine
+        admits every request through the canonical path, so there is
+        no pad region to mask."""
+        cfg = self.cfg
+        p, t = cfg.kv_pages, cfg.kv_page_tokens
+        if p < 1 or cfg.kv_slot_blocks < 1:
+            raise ValueError(
+                "kv_layout='paged' needs kv_pages/kv_slot_blocks set "
+                "(the SlotDecoder computes them; got pages={0}, "
+                "slot_blocks={1})".format(p, cfg.kv_slot_blocks)
+            )
+        b, s = x.shape[0], x.shape[1]
+        if block_tables is None:
+            # cache-shape init path (init_cache's eval_shape): address
+            # everything through the reserved trash page
+            block_tables = jnp.zeros((b, cfg.kv_slot_blocks), jnp.int32)
+        int8_cache = cfg.cache_dtype == "int8"
+        bank_dtype = jnp.int8 if int8_cache else cfg.jdtype
+        ck = self.variable(
+            "cache", "cached_key", jnp.zeros, (p, t, hkv, d), bank_dtype,
+        )
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros, (p, t, hkv, d), bank_dtype,
+        )
+        pos = positions  # [B, S] absolute canonical positions
+        page = jnp.take_along_axis(block_tables, pos // t, axis=1)
+        flat = (page * t + pos % t).reshape(-1)
+
+        def _write(bank, val):
+            pf = bank.reshape((p * t,) + bank.shape[2:])
+            pf = pf.at[flat].set(
+                val.reshape((b * s,) + val.shape[2:]).astype(bank.dtype)
+            )
+            return pf.reshape(bank.shape)
+
+        if int8_cache:
+            cks = self.variable(
+                "cache", "cached_key_scale", jnp.zeros,
+                (p, t, hkv, 1), jnp.float32,
+            )
+            cvs = self.variable(
+                "cache", "cached_value_scale", jnp.zeros,
+                (p, t, hkv, 1), jnp.float32,
+            )
+
+            from tensorflowonspark_tpu import quantize as qz
+
+            kq, ks = qz.quantize_leaf(k, reduce_axes=(3,))
+            vq, vs = qz.quantize_leaf(v, reduce_axes=(3,))
+            ck.value = _write(ck.value, kq)
+            cv.value = _write(cv.value, vq)
+            cks.value = _write(cks.value, ks)
+            cvs.value = _write(cvs.value, vs)
+        else:
+            ck.value = _write(ck.value, k)
+            cv.value = _write(cv.value, v)
+        from tensorflowonspark_tpu.ops.paged_attention import (
+            paged_attention,
+            paged_gather_attention,
+        )
+
+        ksp = cks.value if int8_cache else None
+        vsp = cvs.value if int8_cache else None
+        if s == 1 and cfg.paged_decode_impl == "kernel":
+            out = paged_attention(
+                q[:, 0], ck.value, cv.value, block_tables,
+                pos[:, 0] + 1, window=cfg.attention_window,
+                k_scale_pool=ksp, v_scale_pool=vsp,
+            )[:, None]
+        else:
+            out = paged_gather_attention(
+                q, ck.value, cv.value, block_tables, pos,
+                span=cfg.kv_span or None,
+                window=cfg.attention_window,
+                k_scale_pool=ksp, v_scale_pool=vsp,
+            )
+        return nn.DenseGeneral(
+            cfg.embed_dim,
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.jdtype,
+            name="out",
+        )(out)
+
 
 class MLP(nn.Module):
     cfg: TransformerConfig
@@ -329,11 +455,12 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, decode=False, pad_start=None,
-                 per_slot=False):
+                 per_slot=False, block_tables=None):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(name="ln1")(x), positions, decode=decode,
             pad_start=pad_start, per_slot=per_slot,
+            block_tables=block_tables,
         )
         h = RMSNorm(name="ln2")(x)
         if cfg.num_experts > 0:
@@ -374,7 +501,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, decode=False, pad_start=None,
-                 slot_positions=None):
+                 slot_positions=None, block_tables=None):
         cfg = self.cfg
         if pad_start is not None and not decode:
             raise ValueError(
@@ -385,6 +512,11 @@ class Transformer(nn.Module):
             raise ValueError(
                 "slot_positions (continuous-batching slot decode) is a "
                 "decode-path feature"
+            )
+        if block_tables is not None and not decode:
+            raise ValueError(
+                "block_tables (paged-KV slot decode) is a decode-path "
+                "feature"
             )
         emb = self.param(
             "embedding",
@@ -439,6 +571,7 @@ class Transformer(nn.Module):
                 x = Block(cfg, name="block_%d" % i)(
                     x, positions, decode, pad_start=pad_start,
                     per_slot=slot_positions is not None,
+                    block_tables=block_tables,
                 )
         x = RMSNorm(name="ln_f")(x)
         # tied output head would shard awkwardly under TP; a separate
@@ -501,6 +634,13 @@ def init_cache(model, batch_size, cache_len=None):
         lambda k, s: model.init(k, s, decode=True),
         jax.random.PRNGKey(0), stub,
     )
+    if model.cfg.kv_layout == "paged":
+        # paged pools are [kv_pages, kv_page_tokens, H, Dx] — the
+        # geometry comes from the config (the SlotDecoder sized it),
+        # not from cache_len, and there is no batch dim to resize
+        return jax.tree.map(
+            lambda x: jnp.zeros(x.shape, x.dtype), shapes["cache"]
+        )
 
     def _zero(x):
         if x.ndim == 4:  # [B, max_seq, H, D] key/value banks
@@ -985,11 +1125,25 @@ class SlotDecoder:
                  cache_len=None, chunk_size=16, pad_multiple=64,
                  temperature=0.0, top_k=0, top_p=0.0, eos_id=None,
                  seed=0, prefix_cache=None, draft_model=None,
-                 draft_params=None, draft_len=4):
+                 draft_params=None, draft_len=4,
+                 kv_layout="contiguous", kv_pages=None, page_tokens=None,
+                 paged_impl="kernel"):
         import numpy as np
 
         from tensorflowonspark_tpu import quantize as qz
 
+        self.kv_layout = str(kv_layout)
+        if self.kv_layout not in ("contiguous", "paged"):
+            raise ValueError(
+                "kv_layout must be 'contiguous' or 'paged', got "
+                "{0!r}".format(kv_layout)
+            )
+        if model.cfg.kv_layout == "paged" and self.kv_layout != "paged":
+            raise ValueError(
+                "model is configured kv_layout='paged' but the decoder "
+                "was asked for 'contiguous'; pass kv_layout='paged'"
+            )
+        self._paged = self.kv_layout == "paged"
         self.model = model
         self.num_slots = int(num_slots)
         self.max_new_tokens = int(max_new_tokens)
@@ -1037,11 +1191,25 @@ class SlotDecoder:
         self._bank_len = self.cache_len + (
             self.draft_len + 1 if self._spec else 0
         )
+        if self._paged:
+            if paged_impl not in ("kernel", "gather"):
+                raise ValueError(
+                    "paged_impl must be 'kernel' or 'gather', got "
+                    "{0!r}".format(paged_impl)
+                )
+            self.paged_impl = str(paged_impl)
+            self._setup_paged(model, kv_pages, page_tokens, np)
+        else:
+            self.page_pool = None
+            self.tables = None
         self._np = np
         self._qz = qz
         self._rng = jax.random.PRNGKey(int(seed))
         self._n_keys = 0  # admissions + chunks, folds the rng stream
         self._quantized = qz.is_quantized(params)
+        #: weight scheme ("int8" | "int4" | None) — hot-swap ingest
+        #: re-quantizes with the SAME scheme the live decoder serves
+        self._wq = qz.quantization_of(params)
         self._qparams = jax.tree.map(jnp.asarray, params)
         # prefill is compute-bound: dequantize once, no barrier (the
         # same trade generate() makes); the chunk path re-dequantizes
@@ -1059,7 +1227,9 @@ class SlotDecoder:
         # it as the serving.weight_generation gauge
         self.weight_generation = 0
         self._canary_jit = None
-        self.cache = init_cache(model, self.num_slots,
+        # self.model, not model: the paged layout rebuilt it with the
+        # pool geometry in its config (same params)
+        self.cache = init_cache(self.model, self.num_slots,
                                 cache_len=self._bank_len)
         if self._spec:
             # the draft's own slot-table banks, at the SAME canonical
@@ -1095,7 +1265,16 @@ class SlotDecoder:
         ) if self._spec else jax.jit(
             self._chunk_impl, donate_argnums=(1, 2)
         )
-        if self._use_prefix:
+        if self._paged:
+            # the ONE admit program of the paged plane: cached pages
+            # arrive as table indices (host bookkeeping, no install
+            # dispatch) and the prompt's new pages are committed by the
+            # prefill's own pool writes (no extract dispatch) — a
+            # cached admit is a single fused dispatch
+            self._prefill_paged_jit = jax.jit(
+                self._prefill_paged_impl, donate_argnums=(2, 3, 4)
+            )
+        elif self._use_prefix:
             self._prefill_canonical_jit = jax.jit(
                 self._prefill_canonical_impl, donate_argnums=(2, 3, 4)
             )
@@ -1106,6 +1285,95 @@ class SlotDecoder:
             self._extract_jit = jax.jit(
                 self._extract_segment_impl, static_argnums=(3,)
             )
+
+    def _setup_paged(self, model, kv_pages, page_tokens, np):
+        """Build the paged-KV plane: pick the page geometry, size and
+        allocate the :class:`~tensorflowonspark_tpu.prefix_cache.
+        PagePool`, wire the radix cache (when attached) as the pool's
+        eviction client, and rebuild the model with the pool geometry
+        in its config (same params — the config only selects the cache
+        layout; see docs/serving.md "Paged KV & int4")."""
+        import dataclasses as _dc
+
+        from tensorflowonspark_tpu.prefix_cache import PagePool
+
+        cfg = model.cfg
+        pc = self.prefix_cache
+        t = int(page_tokens) if page_tokens else (
+            pc.block_tokens if pc is not None else 16
+        )
+        if pc is not None and pc.block_tokens != t:
+            raise ValueError(
+                "paged layout needs page_tokens == the prefix cache's "
+                "block_tokens; got {0} vs {1}".format(t, pc.block_tokens)
+            )
+        self._page_tokens = t
+        span = -(-self._bank_len // t)  # blocks per slot table
+        self._blocks_per_slot = span
+        hkv = cfg.num_kv_heads or cfg.num_heads
+        int8_cache = cfg.cache_dtype == "int8"
+        itemsize = 1 if int8_cache else jnp.dtype(cfg.dtype).itemsize
+        per_layer = 2 * t * hkv * cfg.head_dim * itemsize
+        if int8_cache:
+            per_layer += 2 * t * hkv * 4  # f32 scale pages
+        #: device bytes one logical page costs across every layer's
+        #: pools — what the radix cache's byte budget accounts per block
+        self._page_nbytes = max(1, cfg.num_layers * per_layer)
+        if kv_pages:
+            num_pages = int(kv_pages)
+        else:
+            # every slot can always hold its full table span; shared
+            # (radix-committed) pages ride in the extra headroom, capped
+            # by the cache's byte budget so prefix_mem_mb keeps meaning
+            # POOL sizing here (docs/serving.md "Paged KV & int4") —
+            # bounded so a generous default budget doesn't preallocate
+            # hundreds of MB the workload never touches
+            extra = 0
+            if pc is not None:
+                budget_pages = pc.mem_budget_bytes // self._page_nbytes
+                extra = int(min(
+                    budget_pages, max(2 * self.num_slots * span, 64)
+                ))
+            num_pages = self.num_slots * span + extra + 1
+        min_pages = self.num_slots * span + 1
+        if num_pages < min_pages:
+            raise ValueError(
+                "kv_pages={0} cannot hold {1} slots x {2} blocks (+1 "
+                "reserved trash page); need >= {3}".format(
+                    num_pages, self.num_slots, span, min_pages
+                )
+            )
+        self.page_pool = PagePool(num_pages, reserved=1)
+        if pc is not None:
+            # ONE pool per radix cache: page-index payloads are only
+            # meaningful against the pool that allocated them
+            owner = getattr(pc, "_paged_pool", None)
+            if owner is not None and owner is not self.page_pool:
+                raise ValueError(
+                    "this PrefixCache is already bound to another "
+                    "decoder's page pool; paged decoders need their "
+                    "own radix cache (serving_builder builds one per "
+                    "slot geometry)"
+                )
+            if len(pc):
+                raise ValueError(
+                    "paged layout needs an EMPTY PrefixCache at attach "
+                    "(its payloads become page indices); got {0} "
+                    "node(s)".format(len(pc))
+                )
+            pc._paged_pool = self.page_pool
+            pool = self.page_pool
+            pc._release_fn = lambda page: pool.release([page])
+        # per-slot block tables (host mirror; shipped as one small
+        # int32 array per dispatch) + the pages each slot holds.  All
+        # rows start at the reserved trash page.
+        self.tables = np.zeros((self.num_slots, span), np.int32)
+        self._slot_pages = [[] for _ in range(self.num_slots)]
+        self.model = Transformer(_dc.replace(
+            cfg, kv_layout="paged", kv_pages=num_pages,
+            kv_page_tokens=t, kv_slot_blocks=span,
+            kv_span=self._bank_len, paged_decode_impl=self.paged_impl,
+        ))
 
     def _idle_state(self):
         b = self.num_slots
@@ -1233,6 +1501,51 @@ class SlotDecoder:
         }
         return cache, dcache, state, first
 
+    def _prefill_paged_impl(self, params, dparams, cache, dcache, state,
+                            slot, suffix, full, n, kpref, tables, key):
+        """Paged-KV canonical prefill — the ONE dispatch of a paged
+        admit.  The cached prefix needs no install (the slot's block
+        table already references the shared physical pages — host
+        bookkeeping); the uncached ``suffix`` prefills at canonical
+        positions WRITING STRAIGHT INTO THE POOL through the slot's
+        table row, which also commits the prompt's new full blocks in
+        place (no extract dispatch — the pages ARE the cache payload).
+        ``slot``/``n``/``kpref`` are traced: one compiled program per
+        suffix bucket, shared by hits of every depth."""
+        trow = jax.lax.dynamic_slice_in_dim(tables, slot, 1, axis=0)
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, suffix, decode=True,
+            mutable=["cache"], slot_positions=kpref[None],
+            block_tables=trow,
+        )
+        cache = mut["cache"]
+        if self._spec:
+            # the draft keeps CONTIGUOUS per-slot banks (its cache is
+            # slot-private — nothing to share) and re-prefills the
+            # whole prompt, exactly like the contiguous canonical path
+            dlane = self._lane_of(dcache, slot)
+            _, dmut = self.draft_model.apply(
+                {"params": dparams, "cache": dlane}, full,
+                decode=True, mutable=["cache"],
+                pad_start=jnp.zeros((1,), jnp.int32),
+                slot_positions=jnp.zeros((1,), jnp.int32),
+            )
+            dcache = self._merge_lane(dcache, dmut["cache"], slot)
+        row = jax.lax.dynamic_slice_in_dim(
+            logits, n - kpref - 1, 1, axis=1
+        )[:, 0]
+        first = self._sample(row, key)[0]
+        state = {
+            "positions": state["positions"].at[slot].set(n),
+            "pad_start": state["pad_start"].at[slot].set(0),
+            "last_tok": state["last_tok"].at[slot].set(first),
+            "done": state["done"].at[slot].set(
+                first == self.eos_id if self.eos_id is not None
+                else False
+            ),
+        }
+        return cache, dcache, state, first
+
     def _install_segment_impl(self, cache, slot, segment):
         """Write a cached-prefix segment (per-bank ``[L_seg, H, Dx]``
         leaves, flattened bank order) into lane ``slot`` at positions
@@ -1269,10 +1582,13 @@ class SlotDecoder:
                 ))
         return tuple(out)
 
-    def _chunk_impl(self, params, cache, state, active, keys):
+    def _chunk_impl(self, params, cache, state, active, tables, keys):
         """``chunk_size`` single-token decode steps over all slots with
         per-slot positions; done rows keep emitting ``eos_id`` (the
-        static scan's contract), idle rows hold their pointer."""
+        static scan's contract), idle rows hold their pointer.  On the
+        paged layout ``tables`` carries the per-slot block tables (the
+        pool pages are pre-allocated for the whole span, so the scan
+        never allocates — one fused dispatch per chunk either way)."""
         def step(carry, key):
             cache, pos, tok, done = carry
             p = (
@@ -1284,7 +1600,7 @@ class SlotDecoder:
             logits, mut = self.model.apply(
                 {"params": p, "cache": cache}, tok[:, None], decode=True,
                 mutable=["cache"], pad_start=state["pad_start"],
-                slot_positions=pos,
+                slot_positions=pos, block_tables=tables,
             )
             nxt = self._sample(logits[:, 0], key)
             if self.eos_id is not None:
@@ -1308,7 +1624,7 @@ class SlotDecoder:
         return cache, state, jnp.swapaxes(toks, 0, 1)
 
     def _chunk_spec_impl(self, params, dparams, cache, dcache, state,
-                         active, keys):
+                         active, tables, keys):
         """``chunk_size`` SPECULATIVE rounds over all slots: per round
         the draft model proposes ``draft_len`` tokens per slot (its own
         per-slot cache, one extra step to bank the final proposal's
@@ -1361,7 +1677,7 @@ class SlotDecoder:
             logits, mut = self.model.apply(
                 {"params": p, "cache": cache}, block, decode=True,
                 mutable=["cache"], pad_start=state["pad_start"],
-                slot_positions=pos,
+                slot_positions=pos, block_tables=tables,
             )
             targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             ok = drafts == targets[:, :kd]
@@ -1484,10 +1800,13 @@ class SlotDecoder:
             )
         if self.active[slot]:
             raise ValueError("slot {0} is still active".format(slot))
-        if self._use_prefix:
+        if self._paged:
+            first = self._admit_paged(slot, prompt, n)
+        elif self._use_prefix:
             first = self._admit_canonical(slot, prompt, n)
         else:
             self.last_admit_cached_tokens = 0
+            self.last_admit_dispatches = 1
             b = self.bucket_len(n)
             padded = np.zeros((1, b), np.int32)
             padded[0, b - n:] = prompt
@@ -1532,6 +1851,88 @@ class SlotDecoder:
             )
         return tuple(out)
 
+    def _alloc_pages(self, need):
+        """``need`` free pages from the pool, evicting the radix
+        cache's cold leaf blocks under pool pressure (each eviction
+        releases that block's pool reference; a page only actually
+        frees once no active slot's table references it)."""
+        pool, pc = self.page_pool, self.prefix_cache
+        while pool.available() < need:
+            if pc is None or not pc.evict_blocks(1):
+                raise RuntimeError(
+                    "page pool exhausted: need {0} pages, {1} free and "
+                    "nothing left to evict (pool {2})".format(
+                        need, pool.available(), pool.stats()
+                    )
+                )
+        return pool.alloc(need)
+
+    def _admit_paged(self, slot, prompt, n):
+        """The paged admit path (see :meth:`admit`): the cached prefix
+        installs as PAGE INDICES into the slot's block table — pure
+        host bookkeeping, ZERO physical KV copies (the contiguous
+        layout's per-admit segment copy is the cost this layout
+        exists to delete) — and the suffix prefill writes straight
+        into the slot's freshly-allocated private pages, which also
+        commits the prompt's new full blocks in place.  One device
+        dispatch per admit, cached or cold."""
+        np = self._np
+        pc, pool = self.prefix_cache, self.page_pool
+        blk = self._page_tokens
+        if pc is not None:
+            # at least one real token must prefill (first-token logits)
+            lease = pc.acquire(prompt, limit_tokens=n - 1)
+            kpref = lease.n_tokens
+            cached_pages = [int(p) for p in lease.payloads()]
+        else:
+            lease, kpref, cached_pages = None, 0, []
+        self.last_admit_cached_tokens = int(kpref)
+        self.last_admit_dispatches = 1
+        # the slot holds its own reference to every shared page (the
+        # radix may evict the block while this slot still decodes on
+        # it — the pool refcount keeps the physical page alive)
+        pool.retain(cached_pages)
+        if lease is not None:
+            pc.release(lease)
+        private = self._alloc_pages(self._blocks_per_slot
+                                    - len(cached_pages))
+        row = cached_pages + private
+        self.tables[slot] = np.asarray(row, np.int32)
+        self._slot_pages[slot] = row
+        sb = self._suffix_bucket(n - kpref, kpref)
+        suffix = np.zeros((1, sb), np.int32)
+        suffix[0, :n - kpref] = prompt[kpref:]
+        if self._spec:
+            fb = self.bucket_len(n)
+            full = np.zeros((1, fb), np.int32)
+            full[0, :n] = prompt
+            full = jnp.asarray(full)
+        else:
+            full = None
+        (self.cache, self.draft_cache, self.state,
+         first) = self._prefill_paged_jit(
+            self._params, self._dparams, self.cache, self.draft_cache,
+            self.state, jnp.int32(slot), jnp.asarray(suffix), full,
+            jnp.int32(n), jnp.int32(kpref), jnp.asarray(self.tables),
+            self._next_key(),
+        )
+        # commit the prompt's NEW full blocks: their pages already hold
+        # the KV (the prefill wrote through the table) — recording the
+        # indices in the radix IS the commit, zero copies, zero
+        # dispatches.  The radix takes its own pool reference per
+        # block it accepts (budget drops keep the page slot-private).
+        if pc is not None:
+            total_blocks = n // blk
+            first_new = len(cached_pages)
+            if total_blocks > first_new:
+                committed = []
+                pc.insert(
+                    prompt, row[first_new:total_blocks], first_new,
+                    self._page_nbytes, on_insert=committed.append,
+                )
+                pool.retain(committed)
+        return first
+
     def _admit_canonical(self, slot, prompt, n):
         """The cached-prefix admit path (see :meth:`admit`)."""
         np = self._np
@@ -1544,11 +1945,13 @@ class SlotDecoder:
         #: from cache (the serving engine marks prefill spans
         #: prefix_hit with it — docs/observability.md)
         self.last_admit_cached_tokens = int(kpref)
+        self.last_admit_dispatches = 1
         if kpref:
             segment = self._assemble_segment(lease.payloads(), blk)
             self.cache = self._install_jit(
                 self.cache, jnp.int32(slot), segment
             )
+            self.last_admit_dispatches += 1
         # install dispatches hold the block buffers; safe to unpin now
         pc.release(lease)
         sb = self._suffix_bucket(n - kpref, kpref)
@@ -1580,6 +1983,7 @@ class SlotDecoder:
                 self.cache, jnp.int32(slot), jnp.int32(first_new * blk),
                 n_new * blk,
             )
+            self.last_admit_dispatches += 1
             payloads = [_BlockRef(seg, i) for i in range(n_new)]
             nbytes = sum(int(leaf.nbytes) for leaf in seg) // n_new
             pc.insert(prompt, payloads, first_new, nbytes)
@@ -1590,8 +1994,16 @@ class SlotDecoder:
         only.  The lane's stale KV and state entries need no
         scrubbing: a future request's causal mask only ever reaches
         positions its own prefill/decode has re-written, and admit
-        rewrites the state entries."""
+        rewrites the state entries.  On the paged layout the slot's
+        pool references release here (shared pages the radix still
+        holds stay resident; the slot's private pages free) and its
+        table row parks on the trash page so the lane's dead decode
+        writes can never land in a live page."""
         self.active[slot] = False
+        if self._paged and self._slot_pages[slot]:
+            self.page_pool.release(self._slot_pages[slot])
+            self._slot_pages[slot] = []
+            self.tables[slot, :] = 0
 
     def cancel(self, slot):
         """CANCEL an in-flight lane between chunks (deadline expiry,
@@ -1608,7 +2020,15 @@ class SlotDecoder:
         """Return every slot to idle (between serving jobs).  The
         cache banks stay as-is — stale KV is unreachable, see
         :meth:`evict` — so a reused engine keeps its compiled
-        programs AND its device cache allocation."""
+        programs AND its device cache allocation (paged: the pool
+        array AND the radix's committed pages survive; only the
+        slots' own page references release)."""
+        if self._paged:
+            for slot in range(self.num_slots):
+                if self._slot_pages[slot]:
+                    self.page_pool.release(self._slot_pages[slot])
+                    self._slot_pages[slot] = []
+            self.tables[:, :] = 0
         self.state = self._idle_state()
         self.active[:] = False
 
@@ -1658,8 +2078,14 @@ class SlotDecoder:
         hits the SAME compiled programs (census-tested)."""
         qz = self._qz
         if self._quantized:
-            qparams = qz.quantize_tree(jax.tree.map(jnp.asarray,
-                                                    raw_params))
+            # re-quantize with the SAME scheme the live decoder serves
+            # (int4 deployments must stay int4 — avals would otherwise
+            # change and force a retrace)
+            qfn = (
+                qz.quantize_tree_int4 if self._wq == "int4"
+                else qz.quantize_tree
+            )
+            qparams = qfn(jax.tree.map(jnp.asarray, raw_params))
             params = qz.dequantize_tree(
                 qparams, self.model.cfg.jdtype, barrier=False
             )
@@ -1752,16 +2178,17 @@ class SlotDecoder:
         watchdog bound only the synchronizing half."""
         keys = self._next_key(self.chunk_size)
         params = self._qparams if self._quantized else self._params
+        tables = jnp.asarray(self.tables) if self._paged else None
         if self._spec:
             (self.cache, self.draft_cache, self.state, buf, off, acc,
              prop) = self._chunk_jit(
                 params, self._dparams, self.cache, self.draft_cache,
-                self.state, jnp.asarray(self.active), keys,
+                self.state, jnp.asarray(self.active), tables, keys,
             )
             return buf, off, acc, prop
         self.cache, self.state, toks = self._chunk_jit(
             params, self.cache, self.state, jnp.asarray(self.active),
-            keys,
+            tables, keys,
         )
         return toks
 
@@ -1805,6 +2232,8 @@ class SlotDecoder:
         }
         if self._use_prefix:
             out.update(self.prefix_cache.stats())
+        if self._paged:
+            out.update(self.page_pool.stats())
         return out
 
     def compile_counts(self):
@@ -1819,7 +2248,13 @@ class SlotDecoder:
             "prefill": int(self._prefill_jit._cache_size()),
             "chunk": int(self._chunk_jit._cache_size()),
         }
-        if self._use_prefix:
+        if self._paged:
+            # the paged plane's whole admit surface is ONE program
+            # family (per suffix bucket) — no install, no extract
+            out["prefill_paged"] = int(
+                self._prefill_paged_jit._cache_size()
+            )
+        elif self._use_prefix:
             out["prefill_canonical"] = int(
                 self._prefill_canonical_jit._cache_size()
             )
@@ -1871,17 +2306,32 @@ def serving_builder(params, config):
         )
         draft_model = Transformer(dcfg)
         draft_params = jax.tree.map(jnp.asarray, draft_params)
-    if config.get("quantize") == "int8":
-        # weight-only int8 (quantize.py): halves the weight HBM read —
-        # generate() dequantizes per decode step; the logits path
-        # dequantizes once up front (batch logits are compute-bound)
+    # weight quantization (quantize.py): "int8" halves the weight HBM
+    # read, "int4" halves it AGAIN with group-wise scales (packed two
+    # codes per byte; docs/serving.md "Paged KV & int4") — generate()
+    # dequantizes per decode step under a barrier; the logits path
+    # dequantizes once up front (batch logits are compute-bound).
+    # ``weights`` is the canonical knob; ``quantize`` stays as the
+    # pre-ISSUE-12 alias.
+    weights = config.get("weights") or config.get("quantize")
+    if weights in ("int8", "int4"):
         from tensorflowonspark_tpu import quantize as qz
 
-        params = qz.quantize_tree(params)
+        params = (
+            qz.quantize_tree(params) if weights == "int8"
+            else qz.quantize_tree_int4(
+                params, group_size=int(config.get("int4_group", 64))
+            )
+        )
         if config.get("mode") != "generate":
             params = qz.dequantize_tree(
                 params, cfg.jdtype, barrier=False
             )
+    elif weights not in (None, "float", "none"):
+        raise ValueError(
+            "weights/quantize must be 'int8', 'int4', 'float' or "
+            "unset; got {0!r}".format(weights)
+        )
     if config.get("mode") == "generate":
         # generation serving: prompt batch in -> sampled continuations
         # out (KV-cache decode; see generate()).  config keys:
@@ -1994,24 +2444,44 @@ def serving_builder(params, config):
         # cache survives across jobs); speculative=true with a
         # draft_config runs per-slot draft-model speculative decode
         # chunks (greedy-only).
+        # kv_layout="paged" (docs/serving.md "Paged KV & int4"): the
+        # slot decoders keep KV in a shared physical page pool behind
+        # per-slot block tables — cached admits install page indices
+        # (zero-copy) and decode runs the ops/paged_attention.py
+        # block-gather kernel.  kv_pages overrides the pool size;
+        # kv_page_tokens the page width (defaults to prefix_block so
+        # radix blocks and physical pages are the same granularity).
+        kv_layout = str(config.get("kv_layout", "contiguous"))
         chunk_size = int(config.get("chunk_size", 16))
         max_prompt = config.get("max_prompt_len")
         slot_decoders = {}
         prefix_holder = []
+        paged_caches = {}
 
-        def _prefix_cache():
+        def _make_prefix_cache():
+            from tensorflowonspark_tpu.prefix_cache import PrefixCache
+
+            return PrefixCache(
+                block_tokens=int(config.get("prefix_block", 16)),
+                mem_budget_bytes=int(
+                    float(config.get("prefix_mem_mb", 256.0))
+                    * (1 << 20)
+                ),
+            )
+
+        def _prefix_cache(key=None):
             if not config.get("prefix_cache", False):
                 return None
+            if kv_layout == "paged":
+                # page-index payloads are only meaningful against the
+                # pool that allocated them: one radix cache per slot
+                # geometry (still warm across jobs — the decoder memo
+                # below reuses it)
+                if key not in paged_caches:
+                    paged_caches[key] = _make_prefix_cache()
+                return paged_caches[key]
             if not prefix_holder:
-                from tensorflowonspark_tpu.prefix_cache import PrefixCache
-
-                prefix_holder.append(PrefixCache(
-                    block_tokens=int(config.get("prefix_block", 16)),
-                    mem_budget_bytes=int(
-                        float(config.get("prefix_mem_mb", 256.0))
-                        * (1 << 20)
-                    ),
-                ))
+                prefix_holder.append(_make_prefix_cache())
             return prefix_holder[0]
 
         def make_slot_decoder(num_slots, chunk=None):
@@ -2038,9 +2508,15 @@ def serving_builder(params, config):
                 pad_multiple=predict.pad_multiple,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, seed=int(config.get("seed", 0)),
-                prefix_cache=_prefix_cache(),
+                prefix_cache=_prefix_cache(key),
                 draft_model=draft_model, draft_params=draft_params,
                 draft_len=draft_len,
+                kv_layout=kv_layout,
+                kv_pages=config.get("kv_pages"),
+                page_tokens=config.get(
+                    "kv_page_tokens", config.get("prefix_block")
+                ),
+                paged_impl=str(config.get("paged_impl", "kernel")),
             )
             slot_decoders[key] = dec
             return dec

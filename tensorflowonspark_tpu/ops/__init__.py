@@ -9,7 +9,10 @@ new TPU-first capability:
 - :mod:`.ring_attention` — sequence-parallel ring attention (ppermute);
 - :mod:`.ulysses` — all-to-all head/sequence re-sharding attention;
 - :mod:`.moe` — top-k expert routing (capacity and dropless);
-- :mod:`.gmm` — grouped-matmul pallas kernels (dropless MoE engine).
+- :mod:`.gmm` — grouped-matmul pallas kernels (dropless MoE engine);
+- :mod:`.paged_attention` — block-gather decode attention over the
+  paged KV pool (per-slot block tables via scalar-prefetch index
+  maps; the continuous engine's ``kv_layout="paged"`` hot loop).
 """
 
 from tensorflowonspark_tpu.ops.attention import attention, dot_attention  # noqa: F401

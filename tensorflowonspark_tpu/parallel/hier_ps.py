@@ -570,6 +570,21 @@ class HierTrainer(object):
         them — its state stays bit-identical, which is what makes
         failover a pure bookkeeping step.
       fault_fn: chaos hook forwarded to the :class:`DcnLink`.
+      overlap: split the fused step into TWO dispatches — backward
+        (grad + the ICI psum XLA appends to it) and psum-consume +
+        apply — handed to the runtime back to back WITHOUT a sync, so
+        the collective tail of step N's backward overlaps the host's
+        dispatch of step N+1 and the DCN readback thread (the
+        CUDA-Aware-MPI overlap result, applied to ICI).  The gradient
+        accumulators double-buffer: each backward writes fresh
+        buffers while the previous step's apply consumes (and, via
+        donation, recycles) the prior pair — the backward never
+        stalls on an in-flight apply's memory.  Numerics are
+        IDENTICAL to the fused step (same op sequence, parity-tested
+        in tests/test_hier_ps.py); telemetry spans
+        ``hier.overlap_grad`` / ``hier.overlap_apply`` record the
+        dispatch pipeline, and the overlap is span-asserted (apply N
+        stays open past grad N+1's dispatch).
 
     ``step(batch)`` returns the (device-resident) params after the
     fused ICI step; no host readback happens anywhere on that path.
@@ -581,7 +596,8 @@ class HierTrainer(object):
                  reply_codec=None, error_feedback=True, pod_id="pod0",
                  members=None, member_id=0, leader_fn=None,
                  data_axes=(AXIS_PS, AXIS_DATA, AXIS_FSDP),
-                 fault_fn=None, timeout=60, dcn_scale=1.0):
+                 fault_fn=None, timeout=60, dcn_scale=1.0,
+                 overlap=False):
         from tensorflowonspark_tpu import telemetry
 
         if push_every < 1:
@@ -624,6 +640,11 @@ class HierTrainer(object):
         self._sub_fn = None
         self._copy_fn = None
         self._corr_fn = None
+        self.overlap = bool(overlap)
+        self._grad_fn = None
+        self._apply_fn = None
+        self._apply_open = None  # (t0, step_idx) of the in-flight apply
+        self._step_idx = 0
         reg = telemetry.get_registry()
         self._m_steps = reg.counter("hier.ici_steps")
         self._m_failover = reg.counter("hier.leader_failovers")
@@ -698,6 +719,58 @@ class HierTrainer(object):
         # invalidate
         return jax.jit(fused, donate_argnums=(0, 1))
 
+    def _build_split_step(self):
+        """The overlapped pair (``overlap=True``): backward (whose
+        tail is the ICI psum GSPMD appends for the replicated params)
+        and psum-consume + apply, dispatched back to back with no
+        intervening sync.  The grads tree is the double-buffered
+        accumulator: each backward call produces a FRESH buffer pair
+        while the previous pair is being consumed — and donated, so
+        the runtime recycles it — by the in-flight apply."""
+        import jax
+
+        loss_fn, opt = self.loss_fn, self._opt
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+        def apply(params, opt_state, grads):
+            return opt.update(params, grads, opt_state)
+
+        apply_fn = jax.jit(apply, donate_argnums=(0, 1, 2))
+        return grad_fn, apply_fn
+
+    def _overlap_step(self, params, opt_state, batch):
+        """One overlapped step: dispatch backward, close the PREVIOUS
+        step's apply span (it was held open across this dispatch — the
+        recorded overlap), dispatch apply, leave its span open."""
+        t_grad = time.perf_counter()
+        with self._tracer.span(
+            "hier.overlap_grad", trace="hier", step=self._step_idx,
+        ):
+            loss, grads = self._grad_fn(params, batch)
+        if self._apply_open is not None:
+            t0, idx = self._apply_open
+            # the previous apply's pipeline interval ends only now —
+            # AFTER this step's backward was dispatched: that ordering
+            # is the overlap, and the span records it
+            self._tracer.add(
+                "hier.overlap_apply", t0, time.perf_counter() - t0,
+                trace="hier", step=idx,
+            )
+        self._apply_open = (time.perf_counter(), self._step_idx)
+        del t_grad
+        new_params, new_opt = self._apply_fn(params, opt_state, grads)
+        self._step_idx += 1
+        return new_params, new_opt, loss
+
+    def _close_overlap_span(self):
+        if self._apply_open is not None:
+            t0, idx = self._apply_open
+            self._apply_open = None
+            self._tracer.add(
+                "hier.overlap_apply", t0, time.perf_counter() - t0,
+                trace="hier", step=idx,
+            )
+
     def _build_helpers(self):
         import jax
         import jax.numpy as jnp
@@ -746,6 +819,8 @@ class HierTrainer(object):
         self._state = (device_params, opt_state)
         if self._step_fn is None:
             self._step_fn = self._build_step()
+            if self.overlap:
+                self._grad_fn, self._apply_fn = self._build_split_step()
             self._build_helpers()
         # the synced base starts at the (globally-agreed) init params;
         # its buffers are its own — the live tree is donated every step
@@ -785,9 +860,14 @@ class HierTrainer(object):
         if batch is not None:
             batch = sh.shard_batch(batch, self.mesh, self.data_axes)
         params, opt_state = self._state
-        params, opt_state, self._loss = self._step_fn(
-            params, opt_state, batch
-        )
+        if self.overlap:
+            params, opt_state, self._loss = self._overlap_step(
+                params, opt_state, batch
+            )
+        else:
+            params, opt_state, self._loss = self._step_fn(
+                params, opt_state, batch
+            )
         self._state = (params, opt_state)
         self._window_steps += 1
         self._m_steps.inc()
@@ -928,6 +1008,7 @@ class HierTrainer(object):
         window to land, and install the final cross-pod correction;
         returns the device params.  Raises a non-retriable link error;
         a retriable one re-elects first."""
+        self._close_overlap_span()
         if self._link is not None:
             self._check_link()
             if self._window_steps and self._state is not None:

@@ -43,8 +43,19 @@ Design notes:
   back — see docs/serving.md "Prefix cache & speculative decoding").
 
 The payloads are opaque to this module (the SlotDecoder passes device
-pytrees); all bookkeeping here is host-side, so the policy is unit
-testable with plain numpy payloads (tests/test_prefix_cache.py).
+pytrees on the contiguous layout, physical PAGE INDICES on the paged
+layout — see :class:`PagePool`); all bookkeeping here is host-side, so
+the policy is unit testable with plain numpy payloads
+(tests/test_prefix_cache.py).
+
+**Paged layout (ISSUE 12).**  With ``kv_layout="paged"`` the slot
+table's KV lives in one shared physical block pool per layer and this
+module becomes the pool's ALLOCATOR: :class:`PagePool` hands out
+refcounted page indices, the radix tree's payloads are those indices
+(``release_fn``/``on_insert`` keep the pool's refcounts in lockstep
+with node lifetime), and eviction frees physical pages instead of
+dropping device-array views — no lease-copy dance, and one physical
+page serves every slot whose table references it.
 """
 
 import itertools
@@ -53,6 +64,93 @@ import logging
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+
+class PoolExhausted(RuntimeError):
+    """The page pool has no free pages left (and the caller's radix
+    eviction loop could not free any — everything still referenced)."""
+
+
+class PagePool(object):
+    """Host-side refcounted allocator over a fixed set of physical KV
+    pages (the device pools are preallocated ``[num_pages, page_tokens,
+    heads, dim]`` arrays; this class only tracks INDICES into them).
+
+    - :meth:`alloc` hands out ``n`` free pages at refcount 1 (the
+      allocating slot's reference).
+    - :meth:`retain` adds a reference (a second slot installing the
+      same page into its block table, or the radix cache committing
+      it) — this is exactly the "one physical block serves many slots"
+      sharing the contiguous layout had to COPY for.
+    - :meth:`release` drops a reference; a page returns to the free
+      list only at refcount 0.
+
+    Page 0 (more generally ``reserved`` leading pages) is never handed
+    out: idle slots' block tables point at it, so their dead-lane
+    decode writes land in a trash page instead of a live one.
+    """
+
+    def __init__(self, num_pages, reserved=1):
+        if int(num_pages) <= int(reserved):
+            raise ValueError(
+                "num_pages ({0}) must exceed the {1} reserved "
+                "page(s)".format(num_pages, reserved)
+            )
+        self.num_pages = int(num_pages)
+        self.reserved = int(reserved)
+        self._refs = np.zeros((self.num_pages,), np.int64)
+        # LIFO free list: recently-freed pages are re-handed first
+        # (their device lines are the warmest)
+        self._free = list(range(self.num_pages - 1, self.reserved - 1, -1))
+
+    def available(self):
+        return len(self._free)
+
+    def alloc(self, n):
+        """``n`` free page indices at refcount 1."""
+        n = int(n)
+        if n > len(self._free):
+            raise PoolExhausted(
+                "page pool exhausted: need {0} pages, {1} free of "
+                "{2}".format(n, len(self._free), self.num_pages)
+            )
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def retain(self, pages):
+        for p in pages:
+            if self._refs[p] <= 0:
+                raise ValueError(
+                    "retain() on free page {0}".format(int(p))
+                )
+            self._refs[p] += 1
+
+    def release(self, pages):
+        for p in pages:
+            if self._refs[p] <= 0:
+                raise ValueError(
+                    "release() on free page {0}".format(int(p))
+                )
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                self._free.append(int(p))
+
+    def refcount(self, page):
+        return int(self._refs[page])
+
+    def stats(self):
+        used = self.num_pages - self.reserved - len(self._free)
+        return {
+            "pool_pages": self.num_pages,
+            "pool_pages_free": len(self._free),
+            "pool_pages_used": used,
+            # pages referenced by >= 2 holders: the zero-copy sharing
+            # the paged layout exists for (refcount-asserted in
+            # tests/test_paged_decode.py)
+            "pool_pages_shared": int((self._refs >= 2).sum()),
+        }
 
 
 class _Node(object):
@@ -109,16 +207,22 @@ class PrefixCache(object):
         than blowing the budget.
       clock: injectable LRU counter (tests); default is a process-wide
         monotonic tick.
+      release_fn: optional hook called with a node's payload when the
+        node is evicted — the paged layout passes the
+        :class:`PagePool`'s release here so an evicted radix block
+        frees its physical page (instead of dropping a device-array
+        view, the contiguous layout's semantics).
     """
 
     def __init__(self, block_tokens=16, mem_budget_bytes=256 << 20,
-                 clock=None):
+                 clock=None, release_fn=None):
         if int(block_tokens) < 1:
             raise ValueError(
                 "block_tokens must be >= 1, got {0}".format(block_tokens)
             )
         self.block_tokens = int(block_tokens)
         self.mem_budget_bytes = int(mem_budget_bytes)
+        self._release_fn = release_fn
         self._clock = clock if clock is not None else itertools.count(1).__next__
         self._root = _Node(None, None, None, 0)
         self.bytes_used = 0
@@ -199,13 +303,17 @@ class PrefixCache(object):
 
     # -- insert / evict -------------------------------------------------
 
-    def insert(self, tokens, payloads, first_block, nbytes_per_block):
+    def insert(self, tokens, payloads, first_block, nbytes_per_block,
+               on_insert=None):
         """Attach ``payloads`` as blocks ``first_block..`` of the
         ``tokens`` prefix path.  The first ``first_block`` blocks must
         already be cached (they are: ``first_block`` is the lookup's
         match length).  Returns how many blocks were newly inserted —
         existing nodes are left in place (first writer wins; the
-        payloads are token-identical by construction)."""
+        payloads are token-identical by construction).  ``on_insert``
+        is called with each payload the tree actually takes ownership
+        of (the paged layout retains the page's pool reference there —
+        skipped/dropped payloads stay the caller's)."""
         tokens = np.asarray(tokens, np.int32).ravel()
         b = self.block_tokens
         cur = self._root
@@ -227,6 +335,8 @@ class PrefixCache(object):
                 self.n_nodes += 1
                 inserted += 1
                 self._m_bytes.set(self.bytes_used)
+                if on_insert is not None:
+                    on_insert(payload)
             cur = child
         return inserted
 
@@ -259,6 +369,9 @@ class PrefixCache(object):
         victim = min(leaves, key=lambda n: n.last_used)
         del victim.parent.children[victim.key]
         victim.parent = None
+        if self._release_fn is not None:
+            # paged layout: give the physical page back to the pool
+            self._release_fn(victim.payload)
         victim.payload = None  # drops the device buffers
         self.bytes_used -= victim.nbytes
         self.n_nodes -= 1
@@ -266,6 +379,18 @@ class PrefixCache(object):
         self._m_evictions.inc()
         self._m_bytes.set(self.bytes_used)
         return True
+
+    def evict_blocks(self, n=1):
+        """Evict up to ``n`` cold leaf blocks (LRU first); returns how
+        many were evicted.  The paged layout's allocation path calls
+        this under POOL pressure (free pages, not bytes — the
+        byte-budget twin is :meth:`evict_cold`)."""
+        done = 0
+        for _ in range(int(n)):
+            if not self._evict_one():
+                break
+            done += 1
+        return done
 
     def evict_cold(self, target_bytes):
         """Evict cold leaf blocks (LRU first) until ``bytes_used <=

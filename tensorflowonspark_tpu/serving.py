@@ -308,7 +308,10 @@ def predict_rows(
         the prefix cache, and ``spec_accepted`` / ``spec_proposed`` /
         ``spec_accept_rate`` when a draft model drives speculative
         chunks (docs/serving.md "Prefix cache & speculative
-        decoding").
+        decoding").  Exports with ``kv_layout: "paged"`` additionally
+        report ``kv_layout`` and the page-pool occupancy gauges
+        (``pool_pages`` / ``pool_pages_used`` / ``pool_pages_shared``
+        — docs/serving.md "Paged KV & int4").
       on_error: ``"raise"`` (fail fast; admission errors name the
         request index and offending column) or ``"record"`` (poison
         isolation: a bad row yields a typed error record at its input

@@ -470,6 +470,11 @@ class ServingEngine(object):
             "weight_generation": int(getattr(
                 self.decoder, "weight_generation", 0
             )),
+            # paged KV plane (docs/serving.md "Paged KV & int4"):
+            # which layout this decoder serves; pool gauges fold in
+            # via _update_reuse_stats when the layout is paged
+            "kv_layout": getattr(self.decoder, "kv_layout",
+                                 "contiguous"),
         })
         self._reuse_base = dict(self._decoder_reuse_stats())
         # telemetry: metrics resolved ONCE (null singletons when
@@ -577,6 +582,12 @@ class ServingEngine(object):
                     "spec_accepted", "spec_proposed"):
             if key in cur:
                 self.stats[key] = int(cur[key]) - int(base.get(key, 0))
+        # paged-pool gauges are point-in-time occupancy, not
+        # counters — surface the current values, no delta
+        for key in ("pool_pages", "pool_pages_used",
+                    "pool_pages_shared", "pool_pages_free"):
+            if key in cur:
+                self.stats[key] = int(cur[key])
         prop = self.stats.get("spec_proposed", 0)
         self.stats["spec_accept_rate"] = (
             self.stats.get("spec_accepted", 0) / float(prop)

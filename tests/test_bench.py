@@ -108,6 +108,22 @@ def _full_record():
             "rounds": 13, "tokens_per_verify": 4.92,
             "token_exact": True,
         },
+        "serving_paged": {
+            "slots": 4, "max_new_tokens": 16, "prefix_len": 256,
+            "decode": {
+                "contiguous_tokens_per_sec": 1211.4,
+                "paged_kernel_tokens_per_sec": 15.8,
+                "paged_gather_tokens_per_sec": 941.5,
+                "paged_vs_contiguous": 0.777, "token_exact": True,
+            },
+            "admit": {"contiguous_ms": 18.45, "paged_ms": 3.98,
+                      "n_admits": 12, "shared_prefix_tokens": 256},
+            "paged_admit_gain": 4.637,
+            "int4": {"tokens_per_sec": 958.6,
+                     "int8_tokens_per_sec": 1003.4,
+                     "int4_vs_int8": 0.955, "impl": "gather"},
+            "pool": {"pool_pages": 253, "pool_pages_used": 17},
+        },
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
                         "resnet50": {"rows_per_sec": 51.5,
                                      "wire_mb_per_batch": 38.535},
@@ -170,6 +186,9 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["swap_dropped"] == 0  # the zero-downtime contract
     assert parsed["serving_prefix_gain"] == 1.653  # 80%-shared vs cold
     assert parsed["spec_accept_rate"] == 0.918
+    # paged KV plane (ISSUE 12): zero-copy cached admits + int4 decode
+    assert parsed["paged_admit_gain"] == 4.637
+    assert parsed["int4_tok_s"] == 958.6
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
     assert parsed["hier_ps_vs_sync"] == 0.92  # two-tier plane (ISSUE 9)
@@ -195,6 +214,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "serving_continuous_rows_s", "serving_overload_goodput",
         "swap_latency_ms", "swap_dropped",
         "serving_prefix_gain", "spec_accept_rate",
+        "paged_admit_gain", "int4_tok_s",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",
         "serving_u8_vs_f32",

@@ -516,3 +516,84 @@ def test_train_on_feed_steps_and_stops(shards):
     # partial window
     assert ledger["pushed"] == [0, 1, 2]
     tr.stop()
+
+
+# --- overlapped split step (ISSUE 12 satellite) -----------------------
+
+
+def test_overlap_step_parity_vs_serial():
+    # overlap=True splits the fused step into backward (+ICI psum
+    # tail) and apply, dispatched without an intervening sync — the op
+    # sequence is identical, so params must match the serial trainer's
+    # step for step
+    def run(overlap):
+        tr = hier_ps.HierTrainer(
+            quad_loss, None,
+            optimizer=("adam", {"learning_rate": 0.05}),
+            overlap=overlap,
+        )
+        tr.init({"w": np.zeros(4, np.float32)})
+        for _ in range(200):
+            tr.step(None)
+        tr.drain()
+        return np.asarray(tr.params["w"])
+
+    serial = run(False)
+    overlapped = run(True)
+    np.testing.assert_allclose(overlapped, serial, atol=1e-6)
+    np.testing.assert_allclose(overlapped, TARGET, atol=1e-2)
+
+
+def test_overlap_spans_record_pipeline_overlap():
+    # the telemetry contract: apply span N stays OPEN until grad N+1
+    # has been dispatched — the recorded intervals overlap, which is
+    # the span-asserted statement of the dispatch pipeline
+    tracer = telemetry.get_tracer()
+    tracer.clear()
+    tr = hier_ps.HierTrainer(
+        quad_loss, None, optimizer=("sgd", {"learning_rate": 0.05}),
+        overlap=True,
+    )
+    tr.init({"w": np.zeros(4, np.float32)})
+    n_steps = 8
+    for _ in range(n_steps):
+        tr.step(None)
+    tr.drain()
+    grads = sorted(
+        tracer.spans("hier.overlap_grad"),
+        key=lambda s: s["attrs"]["step"],
+    )
+    applies = sorted(
+        tracer.spans("hier.overlap_apply"),
+        key=lambda s: s["attrs"]["step"],
+    )
+    assert len(grads) == n_steps
+    assert len(applies) == n_steps  # drain closed the last one
+    for i in range(n_steps - 1):
+        a = applies[i]
+        g_next = grads[i + 1]
+        # apply i opened before grad i+1 started...
+        assert a["t0"] <= g_next["t0"]
+        # ...and closed only after grad i+1 was dispatched: overlap
+        assert a["t0"] + a["dur"] >= g_next["t0"] + g_next["dur"]
+    # the overlapped path still never reads gradients back
+    assert tracer.count("grad_readback") == 0
+
+
+def test_overlap_composes_with_dcn_tier(shards):
+    # the split step under a real DCN link: windows still ship, the
+    # ledger still dedups, convergence holds
+    servers, addrs = shards
+    tr = hier_ps.HierTrainer(
+        quad_loss, addrs, optimizer=("sgd", {"learning_rate": 0.05}),
+        push_every=4, overlap=True,
+    )
+    tr.init({"w": np.zeros(4, np.float32)})
+    for _ in range(80):
+        tr.step(None)
+    tr.drain()
+    np.testing.assert_allclose(np.asarray(tr.params["w"]), TARGET,
+                               atol=1e-2)
+    led = tr.dcn_epochs()[-1]
+    assert led["acked"], led
+    tr.stop()
