@@ -1,0 +1,42 @@
+"""Fleet serving plane: N engine replicas behind one router.
+
+The serving stack below this package is ONE very good engine
+(:class:`~tensorflowonspark_tpu.serving_engine.ServingEngine`:
+admission control, deadlines, watchdog recovery, prefix cache, live
+hot-swap).  This package is the plane ONE LEVEL ABOVE it — in the
+spirit of TF-Replicator's replica-set abstraction, scale comes from
+the orchestration layer, not new per-engine code paths:
+
+- :mod:`~tensorflowonspark_tpu.fleet.replica` — :class:`ReplicaSet`
+  owns N engine replicas (in-process ``ServingEngine`` workers on CPU
+  for tests; the same duck-typed seam fits executor-resident engines
+  attached over the reservation wire) with per-replica lifecycle
+  (spawn, drain, evict, re-admit) and a cheap ``load()`` snapshot;
+- :mod:`~tensorflowonspark_tpu.fleet.router` — :class:`FleetRouter`:
+  a bounded fleet admission queue, pluggable dispatch policies
+  (least-loaded, prefix-affinity over block-granular prompt
+  fingerprints, weighted round-robin), fleet-level shed/degrade that
+  spills to a sibling replica before any single engine sheds, and
+  committed-token-safe re-dispatch on replica death;
+- :mod:`~tensorflowonspark_tpu.fleet.deploy` — :class:`RollingDeploy`:
+  zero-downtime rolling weight deploys, one replica at a time behind
+  router drain, gated on post-swap health, with a fleet-wide halt
+  when the canary replica burns.
+
+See docs/serving.md "Fleet routing & rolling deploys".
+"""
+
+from tensorflowonspark_tpu.fleet.deploy import (  # noqa: F401
+    DeployHalted,
+    RollingDeploy,
+)
+from tensorflowonspark_tpu.fleet.replica import (  # noqa: F401
+    Replica,
+    ReplicaKilled,
+    ReplicaSet,
+)
+from tensorflowonspark_tpu.fleet.router import (  # noqa: F401
+    DISPATCH_POLICIES,
+    FleetRouter,
+    predict_rows_fleet,
+)

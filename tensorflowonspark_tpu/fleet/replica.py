@@ -1,0 +1,391 @@
+"""Replica set: N serving engines with per-replica lifecycle.
+
+A :class:`Replica` is one continuous-batching engine
+(:class:`~tensorflowonspark_tpu.serving_engine.ServingEngine`) plus
+the plumbing that makes it routable: a bounded feed queue, a worker
+thread driving the engine's scheduling loop, submit/emit bookkeeping
+that pairs fleet request ids with the engine's input-order output
+stream, and post-mortem wreckage collection so the router can
+re-dispatch a dead replica's work from its committed tokens.
+
+A :class:`ReplicaSet` owns N of them.  For tests and single-host
+deployments the replicas are in-process ``ServingEngine`` workers
+(each with its OWN :class:`~tensorflowonspark_tpu.models.transformer.
+SlotDecoder` and its own radix prefix cache — ``serving_builder``
+predictors expose ``make_replica()`` exactly for this); for executor
+fleets the same duck-typed seam (``engine_factory``) fits an
+executor-resident engine proxied over the reservation wire — the
+router only ever touches ``dispatch`` / ``load`` / the completion
+queue, never the engine internals.
+
+The replica feed uses the engine's **source heartbeat protocol**
+(:meth:`ServingEngine._pull_one`): between arrivals the feed yields
+``None`` so an idle engine still runs its lifecycle pass (hot-swap
+requests land on drained replicas — what rolling deploys need) and a
+busy engine never blocks decode waiting on the queue.
+"""
+
+import logging
+import queue as queue_mod
+import threading
+
+from tensorflowonspark_tpu import serving_engine
+
+logger = logging.getLogger(__name__)
+
+#: feed-queue sentinel: the replica finishes in-flight work and exits
+_STOP = object()
+
+#: replica lifecycle states (router-managed; see fleet/router.py):
+#: ``live`` receives traffic, ``routed_around`` only probe traffic (a
+#: slow replica working off its backlog), ``draining`` none (a rolling
+#: deploy quiescing it), ``dead`` is terminal for the in-process shape
+#: (an executor fleet would respawn through the supervisor).
+STATES = ("live", "routed_around", "draining", "dead")
+
+
+class ReplicaKilled(RuntimeError):
+    """A chaos ``kill_replica`` fault fired inside this replica's
+    decode dispatch — the in-process stand-in for a replica
+    process/chip death mid-decode (testing/chaos.py)."""
+
+
+class Replica(object):
+    """One routable serving engine (see module docstring).
+
+    Args:
+      replica_id: stable int id (chaos plans and journal events name
+        replicas by it).
+      predict: this replica's OWN generation predictor (fresh jitted
+        programs + radix cache — see ``serving_builder`` /
+        ``make_replica``).
+      input_mapping: the ENGINE-level mapping (the router builds it:
+        user mapping + its internal budget column).
+      completions: the router's shared completion queue; the worker
+        posts ``("done", rid, fid, row)``, ``("dead", rid, wreck)``
+        and ``("stopped", rid)`` tuples.
+      num_slots / chunk / queue_depth / engine_opts: forwarded to
+        :class:`ServingEngine` (policy is always ``block`` — fleet
+        admission sheds BEFORE any single engine would, so the engine
+        itself never rejects).
+      engine_factory: override building the engine (the executor-
+        resident seam; default builds an in-process ServingEngine).
+      fault_fn: chunk-dispatch fault hook (chaos ``kill_replica`` /
+        ``slow_replica``); defaults to the plan's
+        :func:`~tensorflowonspark_tpu.testing.chaos.replica_fault_fn`.
+      device: optional ``jax.Device`` the worker pins as default
+        (benches spread replicas over virtual CPU devices; real
+        fleets give each replica its own chip by construction).
+      poll_sec: idle feed-poll interval (the heartbeat cadence — also
+        how often an IDLE replica runs its lifecycle pass).
+    """
+
+    def __init__(self, replica_id, predict, input_mapping, completions,
+                 *, num_slots=4, chunk=None, queue_depth=None,
+                 engine_opts=None, engine_factory=None, fault_fn=None,
+                 device=None, poll_sec=0.02):
+        self.replica_id = int(replica_id)
+        self.predict = predict
+        self.state = "live"
+        self.error = None
+        self.device = device
+        self._poll_sec = float(poll_sec)
+        self._completions = completions
+        self._q = queue_mod.Queue()
+        self._submitted = []   # fleet id per engine input index
+        self._emitted = 0
+        self.stats = {}
+        if fault_fn is None:
+            from tensorflowonspark_tpu.testing import chaos
+
+            fault_fn = chaos.replica_fault_fn(self.replica_id)
+        opts = dict(engine_opts or {})
+        if fault_fn is not None:
+            opts["wedge_fn"] = fault_fn
+        if engine_factory is None:
+            engine_factory = serving_engine.ServingEngine
+
+        def build():
+            return engine_factory(
+                predict, input_mapping, None, num_slots, chunk=chunk,
+                queue_depth=queue_depth, policy="block",
+                on_error="record", stats=self.stats, **opts
+            )
+
+        if device is not None:
+            # decoder state (slot caches, weights) must live on the
+            # replica's device: build under the same default-device
+            # context the worker serves under (thread-local, so both
+            # threads enter it explicitly)
+            import jax
+
+            with jax.default_device(device):
+                self.engine = build()
+        else:
+            self.engine = build()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="fleet-replica-%d" % self.replica_id,
+        )
+
+    # -- lifecycle ------------------------------------------------------
+
+    def start(self):
+        if self._thread.ident is None:  # idempotent
+            self._thread.start()
+        return self
+
+    def close(self):
+        """Ask the worker to finish in-flight work and exit (the
+        engine drains its slots, then the feed's STOP ends it)."""
+        self._q.put(_STOP)
+
+    def join(self, timeout=30.0):
+        self._thread.join(timeout=timeout)
+        return not self._thread.is_alive()
+
+    @property
+    def alive(self):
+        return self.state != "dead"
+
+    # -- routing surface ------------------------------------------------
+
+    def dispatch(self, fid, row):
+        """Hand one prepared engine row to this replica's feed."""
+        self._q.put((int(fid), row))
+
+    def load(self):
+        """The router's placement signal: the engine's lock-light
+        :meth:`~tensorflowonspark_tpu.serving_engine.ServingEngine.
+        load` snapshot plus the rows parked in the replica feed that
+        the engine has not pulled yet."""
+        snap = self.engine.load()
+        snap["queued"] += self._q.qsize()
+        snap["replica"] = self.replica_id
+        snap["state"] = self.state
+        return snap
+
+    def capacity(self):
+        """Requests this replica can hold (slots + engine queue bound)
+        — the router never assigns beyond it (spill-before-shed)."""
+        return int(self.engine.num_slots) + int(self.engine.queue_depth)
+
+    # -- the worker -----------------------------------------------------
+
+    def _source(self):
+        """The engine feed: rows as they arrive, ``None`` heartbeats
+        between arrivals (never blocking a busy engine — decode chunks
+        keep their cadence), blocking ``poll_sec`` at a time when the
+        engine is idle so an idle replica still runs its lifecycle
+        pass (pending hot-swaps apply)."""
+        while True:
+            try:
+                # _slot_req read from the engine's own scheduler
+                # thread (the source runs inside serve()) — safe
+                if self.engine._slot_req:
+                    item = self._q.get_nowait()
+                else:
+                    item = self._q.get(timeout=self._poll_sec)
+            except queue_mod.Empty:
+                yield None
+                continue
+            if item is _STOP:
+                return
+            fid, row = item
+            self._submitted.append(fid)
+            yield row
+
+    def _run(self):
+        serve = self.engine.serve(self._source())
+        if self.device is not None:
+            import jax
+
+            with jax.default_device(self.device):
+                self._drive(serve)
+        else:
+            self._drive(serve)
+
+    def _drive(self, serve):
+        try:
+            for out in serve:
+                fid = self._submitted[self._emitted]
+                self._emitted += 1
+                self._completions.put(
+                    ("done", self.replica_id, fid, out)
+                )
+        except BaseException as e:  # noqa: BLE001 - death is a message
+            self.state = "dead"
+            self.error = e
+            logger.warning(
+                "fleet replica %d died: %s", self.replica_id, e
+            )
+            self._completions.put(
+                ("dead", self.replica_id, self._wreckage())
+            )
+            return
+        self._completions.put(("stopped", self.replica_id))
+
+    def _wreckage(self):
+        """Post-mortem accounting a dead replica owes the router
+        (host-side scheduler state survives the death of the decode
+        dispatch, like a driver outliving its device):
+
+        - ``finished``: fleet id -> output row — requests the engine
+          COMPLETED but had not emitted yet (held in its reorder
+          buffer behind an earlier request); their tokens are real,
+          the router delivers them as-is;
+        - ``committed``: fleet id -> committed token list — requests
+          in flight (or engine-queued after a prior requeue) at
+          death; the router re-dispatches each from these tokens
+          (greedy continuations are token-identical — the same
+          invariant the engine's own watchdog recovery pins);
+        - ``queued``: fleet ids never pulled from the feed (plus any
+          the engine consumed but finished nowhere) — re-dispatched
+          from scratch.
+        """
+        eng = self.engine
+        finished = {}
+        committed = {}
+        queued = []
+        accounted = set()
+        for idx, row in eng._finished.items():
+            if idx < len(self._submitted):
+                finished[self._submitted[idx]] = row
+                accounted.add(idx)
+        for req in list(eng._slot_req.values()) + list(eng._pending):
+            idx = req["idx"]
+            if idx < len(self._submitted):
+                committed[self._submitted[idx]] = [
+                    t for t in (req["out"] or []) if isinstance(t, int)
+                ]
+                accounted.add(idx)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue_mod.Empty:
+                break
+            if item is not _STOP:
+                queued.append(item[0])
+        # engine indices consumed but accounted nowhere (lost between
+        # pull and admit) re-dispatch from scratch
+        for idx in range(self._emitted, len(self._submitted)):
+            if idx not in accounted:
+                queued.append(self._submitted[idx])
+        return {
+            "finished": finished, "committed": committed,
+            "queued": queued,
+        }
+
+
+class ReplicaSet(object):
+    """N replicas of one generation predictor (see module docstring).
+
+    Args:
+      predict: a generation predictor (``serving_builder(mode=
+        "generate")``).  Replica 0 serves it directly; replicas 1..N-1
+        are built from ``predict.make_replica()`` (their own jitted
+        programs + radix caches).  Pass ``predict_factory`` instead to
+        control construction (tests with fake decoders).
+      n: replica count.
+      input_mapping: engine-level mapping (see :class:`Replica`).
+      completions: the router's completion queue (built here when the
+        set is used standalone).
+      devices: ``"spread"`` pins replica ``i`` to
+        ``jax.devices()[i % len]`` (benches on the virtual CPU mesh);
+        None leaves placement to jax (real fleets: one chip per
+        replica by construction).
+      num_slots / chunk / queue_depth / engine_opts / poll_sec:
+        per-replica engine knobs, forwarded to :class:`Replica`.
+    """
+
+    def __init__(self, predict, n, input_mapping, *, completions=None,
+                 predict_factory=None, num_slots=4, chunk=None,
+                 queue_depth=None, engine_opts=None, devices=None,
+                 poll_sec=0.02):
+        n = int(n)
+        if n < 1:
+            raise ValueError("need at least one replica, got %d" % n)
+        self.completions = (
+            completions if completions is not None else queue_mod.Queue()
+        )
+        devs = None
+        if devices == "spread":
+            import jax
+
+            devs = jax.devices()
+        predicts = []
+        for i in range(n):
+            if predict_factory is not None:
+                predicts.append(predict_factory())
+            elif i == 0:
+                predicts.append(predict)
+            else:
+                factory = getattr(predict, "make_replica", None)
+                if factory is None:
+                    raise ValueError(
+                        "fleet serving with {0} replicas needs a "
+                        "predictor exposing make_replica() (transformer."
+                        "serving_builder generation predictors do) — "
+                        "each replica must own its decoder; this "
+                        "predictor has none".format(n)
+                    )
+                predicts.append(factory())
+        self.replicas = [
+            Replica(
+                i, predicts[i], input_mapping, self.completions,
+                num_slots=num_slots, chunk=chunk,
+                queue_depth=queue_depth, engine_opts=engine_opts,
+                device=devs[i % len(devs)] if devs else None,
+                poll_sec=poll_sec,
+            )
+            for i in range(n)
+        ]
+
+    def __len__(self):
+        return len(self.replicas)
+
+    def __iter__(self):
+        return iter(self.replicas)
+
+    def __getitem__(self, rid):
+        return self.replicas[rid]
+
+    def start(self):
+        for r in self.replicas:
+            r.start()
+        return self
+
+    def live(self):
+        """Replicas currently accepting routed traffic."""
+        return [r for r in self.replicas if r.state == "live"]
+
+    def load(self):
+        """Per-replica load snapshots, the ``/status`` fleet view."""
+        return [r.load() for r in self.replicas]
+
+    # per-replica lifecycle verbs (the router drives these; they are
+    # also the operator surface)
+    def drain(self, rid):
+        """Stop routing to ``rid`` (rolling deploys quiesce through
+        this); in-flight work finishes normally."""
+        if self.replicas[rid].state != "dead":
+            self.replicas[rid].state = "draining"
+
+    def evict(self, rid):
+        """Route around ``rid`` (a straggler working off its backlog
+        still completes what it holds, and receives probe traffic)."""
+        if self.replicas[rid].state != "dead":
+            self.replicas[rid].state = "routed_around"
+
+    def readmit(self, rid):
+        """Return ``rid`` to full routing."""
+        if self.replicas[rid].state != "dead":
+            self.replicas[rid].state = "live"
+
+    def close(self, join=True, timeout=30.0):
+        for r in self.replicas:
+            if r.alive:
+                r.close()
+        if join:
+            for r in self.replicas:
+                r.join(timeout=timeout)

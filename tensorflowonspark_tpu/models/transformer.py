@@ -2272,6 +2272,10 @@ def serving_builder(params, config):
     are numerically identical (tests/test_attention.py)."""
     import numpy as np
 
+    # fleet serving needs fresh predictors (make_replica below):
+    # capture the caller's params/config BEFORE the draft pop and
+    # weight quantization rebind them
+    _raw_params, _raw_config = params, dict(config)
     cfg_fields = {f.name for f in dataclasses.fields(TransformerConfig)}
     overrides = dict(config, attention_impl="dot", mesh=None)
     cfg = TransformerConfig(
@@ -2524,6 +2528,16 @@ def serving_builder(params, config):
         predict.make_slot_decoder = make_slot_decoder
         predict.max_new_tokens = max_new
         predict.eos_id = eos_id
+        # fleet serving (docs/serving.md "Fleet routing & rolling
+        # deploys"): every replica needs its OWN SlotDecoder (jitted
+        # programs + slot state are single-threaded) and its own radix
+        # cache (prefix affinity routes a shared prefix to the replica
+        # whose cache already holds it) — a fresh predictor per
+        # replica gives exactly that.  ReplicaSet calls this once per
+        # replica beyond the first.
+        predict.make_replica = lambda: serving_builder(
+            _raw_params, dict(_raw_config)
+        )
         if config.get("profile_dir"):
             # on-demand jax.profiler capture: the serving engine starts
             # the trace and counts decode chunks as steps

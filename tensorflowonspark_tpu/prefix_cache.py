@@ -195,6 +195,41 @@ def _block_key(tokens):
     return np.asarray(tokens, np.int32).tobytes()
 
 
+#: Canonical affinity-fingerprint width in tokens: the granularity at
+#: which the fleet router and the radix cache agree on "same prefix".
+#: It matches the default radix ``block_tokens`` (one head block), but
+#: is deliberately a module CONSTANT rather than per-cache geometry —
+#: two replicas configured with different ``block_tokens`` must still
+#: compute the SAME fingerprint for the same prompt, or affinity
+#: routing would split a shared prefix across replicas
+#: (regression-pinned in tests/test_prefix_cache.py).
+FINGERPRINT_TOKENS = 16
+
+
+def fingerprint(tokens, width=FINGERPRINT_TOKENS):
+    """Block-granular prompt fingerprint for prefix-affinity routing
+    (docs/serving.md "Fleet routing & rolling deploys").
+
+    Reuses the radix tree's key math (:func:`_block_key` — int32
+    content bytes, so int32/int64 prompts agree) over the prompt's
+    leading ``width`` tokens, hashed to a stable 64-bit int.  Two
+    prompts share a fingerprint iff they share their first ``width``
+    tokens — exactly the head block of the default radix geometry, so
+    the replica a fingerprint routes to is the replica whose radix
+    cache accumulated that prefix family's blocks.  Prompts shorter
+    than ``width`` fingerprint their full content (consistent routing
+    for short prompts too).  Geometry-independent by construction:
+    the width is NOT the cache's ``block_tokens``.
+    """
+    import hashlib
+
+    tokens = np.asarray(tokens, np.int32).ravel()
+    key = _block_key(tokens[:max(1, int(width))])
+    return int.from_bytes(
+        hashlib.blake2b(key, digest_size=8).digest(), "big"
+    )
+
+
 class PrefixCache(object):
     """Radix/trie index over token prefixes → committed KV blocks.
 
@@ -409,6 +444,18 @@ class PrefixCache(object):
         return self.evict_cold(0)
 
     # -- introspection --------------------------------------------------
+
+    def fingerprint(self, tokens, width=None):
+        """The prompt's affinity fingerprint (see module-level
+        :func:`fingerprint`).  The width defaults to the CANONICAL
+        :data:`FINGERPRINT_TOKENS`, NOT this cache's ``block_tokens``
+        — caches at different block geometries must agree on what
+        "same prefix" means, or the router would scatter a shared
+        prefix across replicas (regression-pinned in
+        tests/test_prefix_cache.py)."""
+        return fingerprint(
+            tokens, FINGERPRINT_TOKENS if width is None else width
+        )
 
     def stats(self):
         return {

@@ -276,6 +276,9 @@ def predict_rows(
     checkpoint_dir=None,
     watcher=None,
     rollback_window=8,
+    replicas=1,
+    replica_policy="least_loaded",
+    fleet_queue_depth=None,
 ):
     """Run ``predict`` over dict-rows; yields output dict-rows.
 
@@ -332,6 +335,20 @@ def predict_rows(
         zero dropped requests, previous weights resident until
         ``rollback_window`` clean requests, automatic rollback on
         canary failure or a post-swap error spike.
+      replicas / replica_policy / fleet_queue_depth: FLEET knobs
+        (continuous only — docs/serving.md "Fleet routing & rolling
+        deploys").  ``replicas > 1`` serves the job through a
+        :class:`~tensorflowonspark_tpu.fleet.router.FleetRouter` over
+        N engine replicas (each with its own slot decoder and radix
+        cache, ``batch_size`` slots apiece): ``replica_policy`` picks
+        the dispatch policy (``least_loaded`` / ``prefix_affinity`` /
+        ``weighted_rr`` / ``random``), ``policy`` becomes the
+        FLEET-level admission policy (pressure spills to a sibling
+        replica before any single engine sheds), and
+        ``fleet_queue_depth`` bounds the fleet admission queue.
+        Outputs stay token-identical to a single-engine run and in
+        input order; a replica death mid-decode re-dispatches its
+        in-flight requests from their committed tokens.
     """
     if schedule not in ("static", "continuous"):
         raise ValueError(
@@ -343,6 +360,33 @@ def predict_rows(
             "on_error must be one of %s, got %r"
             % (serving_engine.ON_ERROR, on_error)
         )
+    if int(replicas or 1) > 1:
+        if schedule != "continuous":
+            raise ValueError(
+                "replicas > 1 needs schedule='continuous' — the fleet "
+                "router dispatches over slot-scheduler engines (see "
+                "docs/serving.md)"
+            )
+        if checkpoint_dir is not None or watcher is not None:
+            raise ValueError(
+                "checkpoint_dir/watcher are single-engine lifecycle "
+                "knobs; fleet weight changes go through rolling "
+                "deploys (FleetRouter.start_rolling_deploy — see "
+                "docs/serving.md 'Fleet routing & rolling deploys')"
+            )
+        from tensorflowonspark_tpu.fleet.router import predict_rows_fleet
+
+        for r in predict_rows_fleet(
+            predict, rows, input_mapping, output_mapping, batch_size,
+            replicas=int(replicas), stats=stats, on_error=on_error,
+            queue_depth=queue_depth, policy=policy,
+            watchdog_timeout=watchdog_timeout,
+            default_deadline=default_deadline,
+            replica_policy=replica_policy,
+            fleet_queue_depth=fleet_queue_depth,
+        ):
+            yield r
+        return
     if schedule == "continuous":
         for r in _predict_rows_continuous(
             predict, rows, input_mapping, output_mapping, batch_size,
@@ -678,6 +722,22 @@ def main(argv=None):
                    help="clean requests a swapped-in generation must "
                         "serve before the previous weights are "
                         "released (automatic rollback inside it)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through a fleet of N engine replicas "
+                        "behind the router (continuous schedule only; "
+                        "batch_size slots per replica — see "
+                        "docs/serving.md 'Fleet routing & rolling "
+                        "deploys')")
+    p.add_argument("--replica_policy", default="least_loaded",
+                   choices=("least_loaded", "prefix_affinity",
+                            "weighted_rr", "random"),
+                   help="fleet dispatch policy: least_loaded (replica "
+                        "load snapshots), prefix_affinity (shared "
+                        "prompt prefixes land on the replica whose "
+                        "radix cache holds them), weighted_rr, random")
+    p.add_argument("--fleet_queue_depth", type=int, default=None,
+                   help="fleet admission-queue bound (default: the "
+                        "summed replica capacity)")
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu.data import interchange
@@ -709,6 +769,12 @@ def main(argv=None):
                 default_deadline=args.deadline,
                 rollback_window=args.rollback_window,
             )
+            if args.replicas > 1:
+                kwargs.update(
+                    replicas=args.replicas,
+                    replica_policy=args.replica_policy,
+                    fleet_queue_depth=args.fleet_queue_depth,
+                )
             if args.checkpoint_dir:
                 from tensorflowonspark_tpu import hot_swap
 

@@ -520,6 +520,7 @@ class ServingEngine(object):
         self._emit_next = 0
         self._n_in = 0
         self._exhausted = False
+        self._idle_source = False
         self._chunk_index = 0
         self._t0 = self._clock()
         # fleet health plane: this engine's compact state rides the
@@ -543,14 +544,47 @@ class ServingEngine(object):
 
         _health.register_status_provider("serving", _serving_status)
 
+    def load(self):
+        """Lock-light load snapshot — the fleet router's placement
+        signal (docs/serving.md "Fleet routing & rolling deploys").
+
+        Plain host ints read straight off the scheduler state: no
+        locks, no device syncs, and NO telemetry-registry traffic
+        (the router polls this at dispatch rate; with telemetry
+        disabled the call allocates nothing beyond the returned dict
+        — asserted in tests/test_fleet.py).  ``/status`` exposes the
+        same fields per engine via :meth:`health_status`.
+        """
+        in_flight = len(self._slot_req)
+        slots = int(getattr(self.decoder, "num_slots", self.num_slots))
+        pc = getattr(self.decoder, "prefix_cache", None)
+        return {
+            "slots": slots,
+            "free_slots": max(0, slots - in_flight),
+            "in_flight": in_flight,
+            "queued": len(self._pending),
+            "queue_depth": self.queue_depth,
+            "prefix_blocks": len(pc) if pc is not None else 0,
+            "weight_generation": self.stats["weight_generation"],
+            "draining": self._draining,
+        }
+
     def health_status(self):
         """Compact serving summary for the health plane's ``/status``
-        route: live load, shed/deadline/watchdog accounting, and the
+        route: live load (the same fields :meth:`load` snapshots for
+        the fleet router), shed/deadline/watchdog accounting, and the
         weight-swap lifecycle state."""
+        pc = getattr(self.decoder, "prefix_cache", None)
         return {
             "slots": getattr(self.decoder, "num_slots", None),
+            "free_slots": max(
+                0, int(getattr(self.decoder, "num_slots",
+                               self.num_slots)) - len(self._slot_req)
+            ),
             "in_flight": len(self._slot_req),
             "queued": len(self._pending),
+            "queue_depth": self.queue_depth,
+            "prefix_blocks": len(pc) if pc is not None else 0,
             "policy": self.policy,
             "draining": self._draining,
             "admitted": self.stats["admitted"],
@@ -689,12 +723,23 @@ class ServingEngine(object):
     def _pull_one(self, it):
         """Pull + validate ONE row from the source; returns a request,
         or None when the source is exhausted.  Invalid rows become
-        records (``on_error="record"``) and pulling continues."""
+        records (``on_error="record"``) and pulling continues.
+
+        A source may yield ``None`` as a **heartbeat** ("no request
+        available right now" — fleet replica feeds do this between
+        arrivals, see fleet/replica.py): the pull returns empty
+        WITHOUT marking the source exhausted, and the scheduler
+        proceeds to its next decode chunk / lifecycle pass instead of
+        blocking.  The source is expected to pace itself (block until
+        a row arrives) whenever the engine is otherwise idle."""
         while not self._exhausted:
             try:
                 row = next(it)
             except StopIteration:
                 self._exhausted = True
+                return None
+            if row is None:
+                self._idle_source = True
                 return None
             idx = self._n_in
             self._n_in += 1
@@ -1321,6 +1366,14 @@ class ServingEngine(object):
                             # every admit this pass failed into records
                             # (on_error="record"); requests are still
                             # being consumed — keep scheduling
+                            continue
+                        if self._idle_source:
+                            # the source is alive but momentarily dry
+                            # (it yielded a None heartbeat — a fleet
+                            # replica feed between arrivals); it paces
+                            # itself by blocking, so looping back to
+                            # the lifecycle pass is not a spin
+                            self._idle_source = False
                             continue
                         # nothing in flight, nothing consumable: only
                         # reachable with zero slots; guard against an

@@ -126,6 +126,38 @@ class ChaosPlan(object):
         )
         return self
 
+    def kill_replica(self, replica_id, at_chunk):
+        """Kill serving replica ``replica_id``'s engine the first time
+        its decode-chunk counter reaches ``at_chunk``: the fault
+        surfaces as :class:`~tensorflowonspark_tpu.fleet.replica.
+        ReplicaKilled` inside the engine's chunk dispatch — exactly
+        what a replica process/chip death mid-decode looks like to the
+        fleet router, which must re-dispatch the replica's in-flight
+        requests from their committed tokens onto a sibling with
+        nothing silently dropped (tests/test_fleet.py).  Each entry
+        fires once, in plan order."""
+        self.faults.append(
+            {"kind": "kill_replica", "replica_id": int(replica_id),
+             "at_chunk": int(at_chunk)}
+        )
+        return self
+
+    def slow_replica(self, replica_id, per_chunk_sec, chunks=0):
+        """Make serving replica ``replica_id`` a STRAGGLER: stall each
+        of its decode-chunk dispatches by ``per_chunk_sec`` (a
+        thermally-throttled or noisy-neighbor chip).  ``chunks=0``
+        stalls every chunk; otherwise only the first ``chunks`` after
+        the fault arms — after the budget the replica runs at full
+        speed again, and the router is expected to ROUTE AROUND it
+        while slow, then RE-ADMIT it after N clean probe rounds
+        (tests/test_fleet.py)."""
+        self.faults.append(
+            {"kind": "slow_replica", "replica_id": int(replica_id),
+             "per_chunk_sec": float(per_chunk_sec),
+             "chunks": int(chunks)}
+        )
+        return self
+
     def drop_heartbeats(self, executor_id, beats):
         """Drop the next ``beats`` HEARTBEAT frames of ``executor_id``
         (simulates a network partition of exactly that length)."""
@@ -380,6 +412,64 @@ def serving_wedge_fn():
                 return
 
     return maybe_wedge
+
+
+def replica_fault_fn(replica_id):
+    """Build the fleet replica's chunk-dispatch fault hook from the
+    plan, or None when no ``kill_replica`` / ``slow_replica`` fault
+    targets it (the common case — one None check of production
+    overhead, like every other plan hook).
+
+    Returns ``fault(chunk_index)``, installed as the replica engine's
+    ``wedge_fn`` (it runs right before every chunk dispatch): a due
+    ``kill_replica`` raises
+    :class:`~tensorflowonspark_tpu.fleet.replica.ReplicaKilled` (each
+    entry fires once, in plan order); a ``slow_replica`` sleeps
+    ``per_chunk_sec`` while its chunk budget lasts."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    rid = int(replica_id)
+    kills = [
+        f for f in plan.faults
+        if f["kind"] == "kill_replica" and f["replica_id"] == rid
+    ]
+    slows = [
+        f for f in plan.faults
+        if f["kind"] == "slow_replica" and f["replica_id"] == rid
+    ]
+    if not kills and not slows:
+        return None
+    import time as _time
+
+    spent = set()
+    slowed = {"chunks": 0}
+
+    def fault(chunk_index):
+        for i, f in enumerate(kills):
+            if i not in spent and chunk_index >= f["at_chunk"]:
+                spent.add(i)
+                from tensorflowonspark_tpu.fleet.replica import (
+                    ReplicaKilled,
+                )
+
+                logger.warning(
+                    "chaos: killing serving replica %d at chunk %d "
+                    "per plan", rid, chunk_index,
+                )
+                raise ReplicaKilled(
+                    "chaos kill_replica {0} at chunk {1}".format(
+                        rid, chunk_index
+                    )
+                )
+        for f in slows:
+            if f["chunks"] and slowed["chunks"] >= f["chunks"]:
+                continue
+            slowed["chunks"] += 1
+            _time.sleep(f["per_chunk_sec"])
+            return
+
+    return fault
 
 
 def ingest_delay():

@@ -90,6 +90,25 @@ def _full_record():
             "goodput_rows_s": 18.2, "baseline_rows_s": 19.9,
             "goodput_dip_pct": 8.5,
         },
+        "serving_fleet": {
+            "slots": 2, "offered": 16, "host_cpus": 1,
+            "replicas": {
+                "1": {"served": 8, "shed": 8, "served_frac": 0.5,
+                      "rows_per_sec": 420.1, "wall_sec": 0.019},
+                "2": {"served": 16, "shed": 0, "served_frac": 1.0,
+                      "rows_per_sec": 7.5, "wall_sec": 2.13},
+                "3": {"served": 16, "shed": 0, "served_frac": 1.0,
+                      "rows_per_sec": 5.7, "wall_sec": 2.83},
+            },
+            "fleet_goodput_2x": 2.0, "fleet_goodput_3x": 2.0,
+            "wall_ratio_2x": 0.02, "token_exact": True,
+            "affinity": {"affinity_hit_rate": 0.703,
+                         "random_hit_rate": 0.594,
+                         "shared_frac": 0.8},
+            "fleet_affinity_hit_rate": 0.703,
+            "deploy": {"state": "done", "replicas_swapped": 3,
+                       "served": 206, "deploy_dropped": 0},
+        },
         "serving_prefix": {
             "rows": 32, "slots": 8, "prefix_len": 320,
             "cold_rows_per_sec": 33.5,
@@ -184,6 +203,10 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["serving_overload_goodput"] == 11.8  # reject-policy row
     assert parsed["swap_latency_ms"] == 41.3  # hot-swap transaction
     assert parsed["swap_dropped"] == 0  # the zero-downtime contract
+    # fleet plane (ISSUE 13): served-goodput at the 2x burst + the
+    # affinity hit rate on the 80%-shared workload
+    assert parsed["fleet_goodput_2x"] == 2.0
+    assert parsed["fleet_affinity_hit_rate"] == 0.703
     assert parsed["serving_prefix_gain"] == 1.653  # 80%-shared vs cold
     assert parsed["spec_accept_rate"] == 0.918
     # paged KV plane (ISSUE 12): zero-copy cached admits + int4 decode
@@ -213,6 +236,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "spark_feed_steps_s", "moe_tok_s", "serving_generate_rows_s",
         "serving_continuous_rows_s", "serving_overload_goodput",
         "swap_latency_ms", "swap_dropped",
+        "fleet_goodput_2x", "fleet_affinity_hit_rate",
         "serving_prefix_gain", "spec_accept_rate",
         "paged_admit_gain", "int4_tok_s",
         "async_ps_compressed_steps_s",

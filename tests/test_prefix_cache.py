@@ -17,7 +17,11 @@ import numpy as np
 import pytest
 
 from tensorflowonspark_tpu import serving, serving_engine
-from tensorflowonspark_tpu.prefix_cache import PrefixCache
+from tensorflowonspark_tpu.prefix_cache import (
+    FINGERPRINT_TOKENS,
+    PrefixCache,
+    fingerprint,
+)
 
 # ----------------------------------------------------------------------
 # host-side radix policy (opaque payloads)
@@ -151,6 +155,53 @@ TINY = {
     "vocab_size": 64, "num_layers": 2, "num_heads": 2, "head_dim": 8,
     "embed_dim": 16, "mlp_dim": 32, "max_seq_len": 96, "dtype": "float32",
 }
+
+
+class TestFingerprint:
+    """Affinity fingerprints (ISSUE 13 satellite): the fleet router
+    and the radix cache must agree on what "same prefix" means —
+    block-granular, content-keyed by the SAME key math, and
+    geometry-INDEPENDENT across ``block_tokens`` configurations."""
+
+    def test_equal_across_block_geometries_sharing_a_prefix(self):
+        # regression pin: replicas configured with different radix
+        # block widths MUST fingerprint a shared prefix identically,
+        # or affinity routing would scatter one prefix family
+        rng = np.random.RandomState(0)
+        head = rng.randint(1, 64, (FINGERPRINT_TOKENS,))
+        a = np.concatenate([head, rng.randint(1, 64, (9,))])
+        b = np.concatenate([head, rng.randint(1, 64, (21,))])
+        caches = [PrefixCache(block_tokens=w) for w in (4, 8, 16, 32)]
+        fps_a = {pc.fingerprint(a) for pc in caches}
+        fps_b = {pc.fingerprint(b) for pc in caches}
+        assert len(fps_a) == 1  # geometry-independent
+        assert fps_a == fps_b   # shared head -> same fingerprint
+        assert fps_a == {fingerprint(a)}  # module fn agrees
+
+    def test_distinguishes_heads_and_normalizes_dtype(self):
+        rng = np.random.RandomState(1)
+        a = rng.randint(1, 64, (24,)).astype(np.int32)
+        b = a.copy()
+        b[3] += 1  # differs INSIDE the head block
+        assert fingerprint(a) != fingerprint(b)
+        # differences past the head block do not change the route
+        c = a.copy()
+        c[FINGERPRINT_TOKENS + 2] += 1
+        assert fingerprint(a) == fingerprint(c)
+        # int32/int64 prompts agree (the radix _block_key rule)
+        assert fingerprint(a) == fingerprint(a.astype(np.int64))
+
+    def test_short_prompts_fingerprint_their_content(self):
+        a = _toks(5, 6, 7)
+        assert fingerprint(a) == fingerprint([5, 6, 7])
+        assert fingerprint(a) != fingerprint([5, 6])
+        assert isinstance(fingerprint(a), int)
+
+    def test_width_override(self):
+        a = _toks(*range(1, 33))
+        b = np.concatenate([a[:8], _toks(*range(50, 74))])
+        assert fingerprint(a, width=8) == fingerprint(b, width=8)
+        assert fingerprint(a) != fingerprint(b)
 
 
 def _gen_predict(max_new=6, extra=None, tiny=None):
