@@ -154,11 +154,17 @@ class RollingDeploy(object):
             from tensorflowonspark_tpu import hot_swap
 
             hot_swap.quarantine(self.step_dir, kind, message)
+        fids, traces = ([], []) if rid is None else (
+            router.outstanding_of(rid)
+        )
         self._tracer.mark(
             "deploy_halted", trace="deploy", severity="page",
             replica=rid, kind=str(kind),
             canary=(rid == self._order0),
             replicas_done=len(self.status["replicas_done"]),
+            # the requests in flight on the halted replica (ISSUE 14
+            # satellite: fleet actions name the requests they touch)
+            request_ids=fids, trace_ids=traces,
         )
         logger.warning(
             "rolling deploy HALTED at replica %s (%s): %s — %d of "
@@ -216,6 +222,7 @@ class RollingDeploy(object):
                 canary=self._order0, gate=self.gate,
                 step=self.status["target_step"],
             )
+            self._mark_drain(router, self._order_list[0])
             self._enter("drain", self._order_list[0])
             router.replica_set.drain(self._order_list[0])
             return False
@@ -344,6 +351,18 @@ class RollingDeploy(object):
         if self._i >= len(self._order_list):
             return self._done(router)
         nxt = self._order_list[self._i]
+        self._mark_drain(router, nxt)
         self._enter("drain", nxt)
         router.replica_set.drain(nxt)
         return False
+
+    def _mark_drain(self, router, rid):
+        """Journal the drain with the requests it strands in flight
+        (ISSUE 14 satellite: deploy events name the requests/traces
+        they touch, so forensics timelines connect fleet actions to
+        request stories)."""
+        fids, traces = router.outstanding_of(rid)
+        self._tracer.mark(
+            "deploy_drain", trace="deploy", replica=rid,
+            request_ids=fids, trace_ids=traces,
+        )

@@ -259,6 +259,16 @@ class Replica(object):
                     t for t in (req["out"] or []) if isinstance(t, int)
                 ]
                 accounted.add(idx)
+            # the chip/page-seconds this request accrued HERE flush to
+            # its ledger row now (the engine's terminal points never
+            # run for a dead replica's in-flight work): the spend was
+            # real, and the surviving replica's row continues it —
+            # per-request rows keep summing to the fleet's measured
+            # decode wall time (ISSUE 14 acceptance)
+            try:
+                eng._ledger_settle(req, close=False)
+            except Exception:  # noqa: BLE001 - accounting must never
+                pass  # break wreckage collection
         while True:
             try:
                 item = self._q.get_nowait()

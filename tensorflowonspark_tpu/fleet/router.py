@@ -42,6 +42,7 @@ See docs/serving.md "Fleet routing & rolling deploys".
 """
 
 import collections
+import itertools
 import logging
 import queue as queue_mod
 import time
@@ -51,6 +52,7 @@ import numpy as np
 from tensorflowonspark_tpu import serving_engine, telemetry
 from tensorflowonspark_tpu.fleet.replica import ReplicaSet
 from tensorflowonspark_tpu.prefix_cache import fingerprint
+from tensorflowonspark_tpu.telemetry import ledger as ledger_mod
 
 logger = logging.getLogger(__name__)
 
@@ -58,6 +60,21 @@ logger = logging.getLogger(__name__)
 #: token budget into the replica engines — added to the engine-level
 #: input mapping unless the caller already mapped a budget column
 FLEET_BUDGET_COL = "__fleet_max_new__"
+
+#: internal row column carrying each request's fleet-minted TRACE id
+#: into the replica engines (mapped to ``serving_engine.TRACE_INPUT``
+#: unless the caller already mapped a trace column): the engine's
+#: ``admission → queue_wait → prefill → decode_chunk×N → emit`` span
+#: chain then joins the router's trace, and a re-dispatch after
+#: ``kill_replica`` CONTINUES the same trace on the surviving replica
+#: — ``telemetry.merge_traces`` renders one connected, causally
+#: ordered story per request across replicas/processes (ISSUE 14).
+FLEET_TRACE_COL = "__fleet_trace__"
+
+#: per-process router sequence: trace ids are ``flt<router>-req<fid>``
+#: so rows in the process-wide usage ledger never collide across
+#: routers/jobs
+_ROUTER_SEQ = itertools.count(1)
 
 #: error-record kinds that re-raise under ``on_error="raise"`` (the
 #: replica engines always run in record mode; the router restores
@@ -257,6 +274,17 @@ class FleetRouter(object):
              if input_mapping[c] == serving_engine.BUDGET_INPUT), None
         )
         self.budget_col = self.user_budget_col or FLEET_BUDGET_COL
+        self.user_trace_col = next(
+            (c for c in input_mapping
+             if input_mapping[c] == serving_engine.TRACE_INPUT), None
+        )
+        self.trace_col = self.user_trace_col or FLEET_TRACE_COL
+        self.tenant_col = next(
+            (c for c in input_mapping
+             if input_mapping[c] == serving_engine.TENANT_INPUT), None
+        )
+        self._trace_prefix = "flt%d" % next(_ROUTER_SEQ)
+        self._ledger = ledger_mod.get_ledger()
         self.policy = policy
         self.on_error = on_error
         self.degrade_floor = max(1, int(degrade_floor))
@@ -331,6 +359,10 @@ class FleetRouter(object):
             "replicas": len(self.replicas),
             "dispatch_policy": self.dispatch_name,
             "fleet_policy": policy,
+            # fleet request id -> minted trace id (ISSUE 14): how a
+            # caller (or test) pulls the merged trace of a specific
+            # request after the run
+            "trace_ids": {},
         })
         self._tracer = telemetry.get_tracer()
         reg = telemetry.get_registry()
@@ -370,6 +402,14 @@ class FleetRouter(object):
     def _assigned_count(self, rid):
         return len(self._assigned[rid])
 
+    def outstanding_of(self, rid):
+        """``(request_ids, trace_ids)`` currently assigned to replica
+        ``rid`` — what fleet-action journal events attach so the
+        forensics timeline connects the action to the requests it
+        touched (ISSUE 14 satellite)."""
+        fids = sorted(self._assigned[rid])
+        return fids, [self.stats["trace_ids"].get(f) for f in fids]
+
     def health_status(self):
         """Fleet summary for ``/status``: routing policy, per-replica
         load snapshots, and the deploy state."""
@@ -395,6 +435,23 @@ class FleetRouter(object):
                       if self.deploy_history else None)
             ),
             "loads": self.replica_set.load(),
+            # per-replica cost rows (ISSUE 14): what each replica
+            # burned and produced so far — decode chip-seconds,
+            # tokens emitted, prefix tokens saved
+            "costs": {
+                r.replica_id: {
+                    "state": r.state,
+                    "chip_sec": round(float(
+                        r.stats.get("decode_wall_sec", 0.0)
+                    ), 6),
+                    "tokens_out": int(r.stats.get("tokens_out", 0)),
+                    "completed": int(r.stats.get("completed", 0)),
+                    "prefix_tokens_saved": int(
+                        r.stats.get("prefix_tokens_saved", 0)
+                    ),
+                }
+                for r in self.replicas
+            },
         }
 
     def load(self):
@@ -415,29 +472,51 @@ class FleetRouter(object):
     def engine_input_mapping(self, input_mapping=None):
         """The ENGINE-level mapping the replicas must be built with:
         the user mapping plus the router's internal budget column
-        (resumed re-dispatches carry reduced budgets through it)."""
+        (resumed re-dispatches carry reduced budgets through it) and
+        its internal trace column (the fleet-minted request trace id
+        every engine span then rides — ISSUE 14)."""
         m = dict(input_mapping or self.user_mapping)
         if not any(v == serving_engine.BUDGET_INPUT
                    for v in m.values()):
             m[FLEET_BUDGET_COL] = serving_engine.BUDGET_INPUT
+        if not any(v == serving_engine.TRACE_INPUT
+                   for v in m.values()):
+            m[FLEET_TRACE_COL] = serving_engine.TRACE_INPUT
         return m
 
     # -- admission -------------------------------------------------------
 
-    def _shed(self, fid, why):
+    def _shed(self, fid, rid, why):
         self.stats["shed"] += 1
         self._m["shed"].inc()
+        # the mark rides the REQUEST's trace and names it in attrs
+        # (ISSUE 14 satellite: fleet actions connect to the requests
+        # they touched, not just a generic trace="fleet")
         self._tracer.mark(
-            "fleet_shed", trace="fleet", severity="warn",
-            request_index=fid, queue_depth=self.queue_depth,
+            "fleet_shed", trace=rid, severity="warn",
+            request_index=fid, trace_id=rid,
+            queue_depth=self.queue_depth,
         )
+        self._ledger.close(rid, tokens_out=0)
         self._finished[fid] = serving_engine.error_record(
             "shed", fid, why
         )
 
+    def _rid_of(self, fid, row):
+        """The request's fleet trace id: minted here unless the caller
+        mapped its own :data:`~tensorflowonspark_tpu.serving_engine.
+        TRACE_INPUT` column with a usable value."""
+        if self.user_trace_col is not None and isinstance(row, dict):
+            v = row.get(self.user_trace_col)
+            if isinstance(v, str) and v:
+                return v
+        return "%s-req%d" % (self._trace_prefix, fid)
+
     def _admit(self, row):
         fid = self._n_in
         self._n_in += 1
+        rid = self._rid_of(fid, row)
+        self.stats["trace_ids"][fid] = rid
         if self.policy == "reject":
             # spill-before-shed: free replica room is admission
             # capacity too (the refill runs before dispatch, so
@@ -450,7 +529,7 @@ class FleetRouter(object):
             )
             if len(self._queue) >= cap:
                 self._shed(
-                    fid,
+                    fid, rid,
                     "request {0} shed: fleet admission queue full "
                     "({1} waiting, depth {2}, policy 'reject')".format(
                         fid, len(self._queue), self.queue_depth
@@ -484,12 +563,29 @@ class FleetRouter(object):
             ) if self.affinity_width else fingerprint(prompt)
         except Exception:  # noqa: BLE001 - validation is the engine's
             pass
+        tenant = None
+        if self.tenant_col is not None:
+            v = row.get(self.tenant_col) if isinstance(row, dict) else None
+            if isinstance(v, str) and v:
+                tenant = v  # junk values: the engine names the error
         self._reqs[fid] = {
             "row": row, "prompt": prompt, "budget": budget,
             "committed": [], "excluded": set(), "replica": None,
             "fingerprint": fp, "submit": self._clock(),
             "sent_at": None, "redispatches": 0,
+            "rid": rid, "tenant": tenant,
         }
+        # open the cost row at FLEET admission with the user-facing
+        # prompt size: a later re-dispatch re-admits prompt+committed
+        # engine-side, and the ledger's set-if-unset keeps this value
+        self._ledger.open(
+            rid, tenant=tenant,
+            tokens_in=int(prompt.shape[0]) if prompt is not None else None,
+        )
+        self._tracer.mark(
+            "fleet_admission", trace=rid, request_index=fid,
+            trace_id=rid,
+        )
         self._queue.append(fid)
 
     def _room(self, replica):
@@ -595,6 +691,9 @@ class FleetRouter(object):
             ):
                 self._queue.popleft()
                 self.stats["errors"] += 1
+                self._ledger.close(
+                    req["rid"], tokens_out=len(req["committed"])
+                )
                 self._finished[fid] = serving_engine.error_record(
                     "replica_lost", fid,
                     "request {0}: no live replica remains in the "
@@ -623,12 +722,22 @@ class FleetRouter(object):
                 np.asarray(committed, np.int32),
             ])
         row[self.budget_col] = req["budget"] - len(committed)
+        # the fleet trace id rides the row into the replica engine:
+        # its whole span chain joins this request's trace, and a
+        # re-dispatch CONTINUES the same trace on the next replica
+        row[self.trace_col] = req["rid"]
         req["replica"] = rid
         req["sent_at"] = self._clock()
         self._assigned[rid].add(fid)
         self._dispatch_count += 1
         self.stats["dispatched"] += 1
         self._m["dispatched"].inc()
+        if self._tracer.enabled:
+            self._tracer.add(
+                "fleet_dispatch", time.perf_counter(), 0.0,
+                trace=req["rid"], replica=rid, request_index=fid,
+                resumed_tokens=len(committed),
+            )
         replica.dispatch(fid, row)
 
     # -- completion / death handling -------------------------------------
@@ -671,10 +780,21 @@ class FleetRouter(object):
         self._m_live.set(
             sum(1 for r in self.replicas if r.alive)
         )
+        # the affected requests ride the mark's attrs (ISSUE 14
+        # satellite): the journal/forensics timeline can connect this
+        # fleet action to the requests it touched
+        touched = sorted(
+            set(wreck["committed"]) | set(wreck["queued"])
+            | set(wreck["finished"])
+        )
         self._tracer.mark(
             "replica_dead", trace="fleet", severity="page",
             replica=rid, error=str(replica.error),
             finished=len(wreck["finished"]), redispatching=n_redisp,
+            request_ids=touched,
+            trace_ids=[
+                self.stats["trace_ids"].get(f) for f in touched
+            ],
         )
         logger.warning(
             "fleet: replica %d died (%s); delivering %d finished "
@@ -713,9 +833,13 @@ class FleetRouter(object):
             req["redispatches"] += 1
             self.stats["redispatched"] += 1
             self._m["redispatched"].inc()
+            self._ledger.redispatch(req["rid"])
+            # the mark rides the request's OWN trace (the re-dispatch
+            # is one hop of that request's story), naming it in attrs
             self._tracer.mark(
-                "fleet_redispatch", trace="fleet", severity="warn",
-                request_index=fid, from_replica=rid,
+                "fleet_redispatch", trace=req["rid"], severity="warn",
+                request_index=fid, trace_id=req["rid"],
+                from_replica=rid,
                 tokens_committed=len(req["committed"]),
             )
         self._queue.extendleft(sorted(set(resumed), reverse=True))
@@ -748,11 +872,17 @@ class FleetRouter(object):
                     self._clean[rid] = 0
                     self.stats["evicted"] += 1
                     self._m["evictions"].inc()
+                    outstanding = sorted(self._assigned[rid])
                     self._tracer.mark(
                         "replica_evicted", trace="fleet",
                         severity="warn", replica=rid,
                         ewma_sec=round(self._lat_ewma[rid], 4),
                         fleet_median_sec=round(med, 4),
+                        request_ids=outstanding,
+                        trace_ids=[
+                            self.stats["trace_ids"].get(f)
+                            for f in outstanding
+                        ],
                     )
                     logger.warning(
                         "fleet: routing around slow replica %d "
@@ -821,6 +951,10 @@ class FleetRouter(object):
                 self.stats["drained"] += 1
             else:
                 self.stats["errors"] += 1
+            self._ledger.close(
+                req["rid"], tokens_out=rec.get("tokens_done", 0),
+                latency_sec=self._clock() - req["submit"],
+            )
             self._finished[fid] = {"error": rec}
             return
         if committed:
@@ -843,6 +977,14 @@ class FleetRouter(object):
                 out["generated_len"] = np.int32(
                     len(committed) + int(out["generated_len"])
                 )
+        # the AUTHORITATIVE emitted-token count for the cost row: the
+        # merged committed+continuation length (the replica engine's
+        # earlier close only saw its own continuation) — per-tenant
+        # token totals then match the emitted outputs exactly
+        if "generated_len" in out:
+            tokens_out = int(out["generated_len"])
+        else:
+            tokens_out = len(committed) + self.max_new
         if not self._user_emit_len:
             out.pop("generated_len", None)
         out = serving_engine.apply_output_mapping(
@@ -853,6 +995,10 @@ class FleetRouter(object):
         self.stats["latency_sec"][fid] = now - req["submit"]
         self.stats["done_at"][fid] = now - self._t0
         self._m["completed"].inc()
+        self._ledger.close(
+            req["rid"], tokens_out=tokens_out,
+            latency_sec=now - req["submit"],
+        )
         self._finished[fid] = out
 
     def _drain_ready(self):
@@ -923,10 +1069,15 @@ class FleetRouter(object):
         self.stats["per_replica"] = per
         for key in ("admitted", "prefix_hits", "prefix_tokens_saved",
                     "swaps", "swap_commits", "rollbacks",
-                    "swap_requeued", "watchdog_fires"):
+                    "swap_requeued", "watchdog_fires", "tokens_out"):
             self.stats[key] = sum(
                 int(s.get(key, 0)) for s in per.values()
             )
+        # fleet decode wall time: summed per-replica (each replica owns
+        # its chip — the ledger's chip-second rows sum back to this)
+        self.stats["decode_wall_sec"] = sum(
+            float(s.get("decode_wall_sec", 0.0)) for s in per.values()
+        )
 
     def close(self, timeout=30.0):
         self.replica_set.close(timeout=timeout)

@@ -27,6 +27,12 @@ The report reconstructs, across every executor found in the sources:
   Partitioning and Scheduling Problem: It's the Critical Path!") —
   with each link's exclusive contribution and the dominant phase
   named;
+- the **p99 exemplars** (ISSUE 14): the shared request-latency
+  histogram retains trace-id exemplars on its tail buckets (dump
+  bundles carry the snapshot), so the report names the exact request
+  living at the tail — and ``--request <trace>`` pins the critical
+  path / merged-trace export to that one request's cross-executor
+  story;
 - optionally a **merged Chrome trace** (``--trace``) via
   :func:`~tensorflowonspark_tpu.telemetry.tracing.merge_traces`, one
   Perfetto-loadable file with every executor's spans on the aligned
@@ -45,7 +51,14 @@ import sys
 
 from tensorflowonspark_tpu.telemetry import blackbox as _blackbox
 from tensorflowonspark_tpu.telemetry import journal as _journal
+from tensorflowonspark_tpu.telemetry import registry as _reg
 from tensorflowonspark_tpu.telemetry import tracing as _tracing
+
+#: The shared request-latency histogram (serving_engine.LATENCY_METRIC
+#: — spelled out so the analyzer stays jax-free): its tail-bucket
+#: exemplars carry TRACE ids, which is how ``explain`` names the exact
+#: p99 request and pulls its merged trace (ISSUE 14).
+LATENCY_METRIC = "serving.request_latency_sec"
 
 #: Event kinds that open an incident, in the order a timeline scan
 #: trusts them (the first of these on the aligned timeline is the
@@ -132,6 +145,7 @@ def load_sources(paths):
                 events=data.get("events") or [],
                 spans=data.get("spans") or [],
                 epoch_wall=(data.get("clock") or {}).get("epoch_wall"),
+                metrics=data.get("metrics"),
             ))
         elif "events" in data:
             # a TPUCluster.journal() export: fleet events with the
@@ -153,7 +167,7 @@ def load_sources(paths):
 
 
 def _source(path, executor=None, pid=None, events=None, spans=None,
-            epoch_wall=None, offset=0.0):
+            epoch_wall=None, offset=0.0, metrics=None):
     if executor is None and events:
         execs = {e.get("executor") for e in events}
         execs.discard(None)
@@ -163,6 +177,7 @@ def _source(path, executor=None, pid=None, events=None, spans=None,
         "path": path, "executor": executor, "pid": pid,
         "events": events or [], "spans": spans or [],
         "epoch_wall": epoch_wall, "offset": float(offset),
+        "metrics": metrics,
     }
 
 
@@ -290,12 +305,41 @@ def _busiest_trace(spans):
 # ----------------------------------------------------------------------
 
 
-def explain(paths, offsets=None):
+def latency_exemplars(sources, q=99):
+    """Tail-latency exemplars found in the sources' registry
+    snapshots (flight-recorder bundles carry one): each names the
+    TRACE id of a request that actually lives at/above the ``q``-th
+    percentile of the shared request-latency histogram.  Newest-
+    heaviest first, deduped by trace id."""
+    out = []
+    seen = set()
+    for src in sources:
+        snap = ((src.get("metrics") or {}).get("histograms") or {}).get(
+            LATENCY_METRIC
+        )
+        for ex in _reg.tail_exemplars(snap, q):
+            if ex["ref"] in seen:
+                continue
+            seen.add(ex["ref"])
+            out.append(dict(ex, source=src["path"]))
+    out.sort(key=lambda e: -e["value"])
+    return out
+
+
+def explain(paths, offsets=None, request=None):
     """Analyze dump/journal sources into one incident report dict.
 
     Keys: ``incident`` (fault_kind / trigger kind / executor /
     severity / t), ``timeline`` (aligned entries), ``critical_path``,
-    ``events_by_kind``, ``executors``, ``window_sec``, ``sources``.
+    ``events_by_kind``, ``executors``, ``window_sec``, ``sources``,
+    and ``p99_exemplars`` — tail-latency trace ids found in the
+    sources' registry snapshots (ISSUE 14: the shared latency
+    histogram retains trace-id exemplars on its tail buckets, so the
+    report can name the exact p99 request).  ``request`` pins the
+    critical-path analysis to ONE trace id (e.g. a reported
+    exemplar); when omitted and tail exemplars exist with recorded
+    spans, the heaviest exemplar's trace is preferred over the
+    busiest-trace heuristic.
     """
     sources = load_sources(
         paths if isinstance(paths, (list, tuple)) else [paths]
@@ -321,13 +365,23 @@ def explain(paths, offsets=None):
             "t": trigger["t"],
             "attrs": trigger["attrs"],
         }
-    # the critical path comes from the source with spans whose busiest
-    # trace carries the most work (usually the dump bundle of the
-    # faulted process)
+    # the critical path comes from: the caller-pinned request, else
+    # the heaviest tail-latency exemplar with recorded spans (the p99
+    # request the histogram named), else the busiest trace (usually
+    # the dump bundle of the faulted process)
     spans = []
     for src in sources:
         spans.extend(src["spans"])
-    trace_id = _busiest_trace(spans)
+    exemplars = latency_exemplars(sources)
+    span_traces = {s.get("trace") for s in spans}
+    trace_id = request
+    if trace_id is None:
+        trace_id = next(
+            (ex["ref"] for ex in exemplars if ex["ref"] in span_traces),
+            None,
+        )
+    if trace_id is None:
+        trace_id = _busiest_trace(spans)
     cp = critical_path(
         [s for s in spans if trace_id is None or s.get("trace") == trace_id]
     )
@@ -337,6 +391,7 @@ def explain(paths, offsets=None):
         "incident": incident,
         "timeline": timeline,
         "critical_path": cp,
+        "p99_exemplars": exemplars,
         "events_by_kind": counts,
         "faults": faults,
         "executors": sorted(
@@ -352,16 +407,23 @@ def explain(paths, offsets=None):
     }
 
 
-def merged_chrome(paths, offsets=None):
+def merged_chrome(paths, offsets=None, request=None):
     """One Perfetto-loadable Chrome trace over every source with
     spans, clock-aligned (see
-    :func:`~tensorflowonspark_tpu.telemetry.tracing.merge_traces`)."""
+    :func:`~tensorflowonspark_tpu.telemetry.tracing.merge_traces`).
+    ``request`` filters to ONE trace id — the merged cross-executor
+    story of a single request (e.g. a p99 exemplar)."""
     sources = load_sources(
         paths if isinstance(paths, (list, tuple)) else [paths]
     )
     offsets = offsets or {}
     parts = []
     for src in sources:
+        src = dict(src)
+        if request is not None:
+            src["spans"] = [
+                s for s in src["spans"] if s.get("trace") == request
+            ]
         if not src["spans"]:
             continue
         off = offsets.get(src["executor"], src["offset"])
@@ -420,6 +482,15 @@ def render_report(report):
             report["window_sec"], len(report["timeline"])
         )
     )
+    for ex in report.get("p99_exemplars", [])[:3]:
+        lines.append(
+            "p99 exemplar    : trace {0!r} at {1:.1f}ms (bucket <= "
+            "{2})".format(
+                ex["ref"], 1e3 * ex["value"],
+                "inf" if ex.get("bucket_hi") is None
+                else "%.4fs" % ex["bucket_hi"],
+            )
+        )
     cp = report["critical_path"]
     if cp["path"]:
         lines.append("critical path   : trace {0!r}, {1:.6f}s total, "
@@ -491,6 +562,12 @@ def main(argv=None):
         "here (Perfetto-loadable)",
     )
     ex.add_argument(
+        "--request", default=None,
+        help="pin the analysis to ONE request trace id (e.g. a "
+        "reported p99 exemplar): the critical path and --trace "
+        "export then tell that request's cross-executor story",
+    )
+    ex.add_argument(
         "--json", action="store_true",
         help="print the report as JSON instead of text",
     )
@@ -499,7 +576,7 @@ def main(argv=None):
     if args.offsets:
         with open(args.offsets) as f:
             offsets = json.load(f)
-    report = explain(args.paths, offsets=offsets)
+    report = explain(args.paths, offsets=offsets, request=args.request)
     text = render_report(report)
     if args.json:
         print(json.dumps(report, indent=2, default=str))
@@ -510,7 +587,9 @@ def main(argv=None):
             f.write(text + "\n")
     if args.trace:
         with open(args.trace, "w") as f:
-            json.dump(merged_chrome(args.paths, offsets=offsets), f)
+            json.dump(merged_chrome(
+                args.paths, offsets=offsets, request=args.request
+            ), f)
         print("merged Chrome trace written to {0}".format(args.trace))
     return 0
 

@@ -162,6 +162,18 @@ HasModelConfig = _mixin(
     "dict of model_config keys laid over the serving export's "
     "metadata at load time (prefix cache, draft model, chunk sizing)",
 )
+# cost attribution (docs/observability.md "Cost attribution & usage
+# ledger"): the DataFrame column carrying each row's TENANT key.  The
+# transform maps it onto the reserved "tenant" serving input, so the
+# usage ledger attributes tokens / chip-seconds / page-seconds to the
+# tenant (validated at admission on both schedules: non-string or
+# empty values become typed bad_tenant errors naming the row)
+HasTenantCol = _mixin(
+    "tenant_col",
+    "input column carrying the per-request tenant key for the usage "
+    "ledger (mapped to the reserved 'tenant' serving input)",
+    cap="TenantCol",
+)
 # the narrow-dtype data plane's widening stage (docs/data_plane.md):
 # a JSON-able dict of data.preprocess.make_preprocess kwargs.  On
 # TFModel it is fused in front of the predictor on device
@@ -254,6 +266,7 @@ _MODEL_MIXINS = (
     HasSchedule,
     HasSignatureDefKey,
     HasTagSet,
+    HasTenantCol,
 )
 
 
@@ -476,10 +489,18 @@ def _run_model_iter(rows, args, predictor_builder=None):
         _TRANSFORM_STATE["key"] = key
     predict = _TRANSFORM_STATE["predict"]
 
+    # setTenantCol: fold the tenant column into the input mapping as
+    # the reserved "tenant" serving input — the usage ledger then
+    # attributes each row's resources to its tenant (ISSUE 14)
+    input_mapping = dict(args.input_mapping or {})
+    tenant_col = getattr(args, "tenant_col", None)
+    if tenant_col:
+        input_mapping[tenant_col] = serving.TENANT_INPUT
+
     return serving.predict_rows(
         predict,
         rows,
-        input_mapping=args.input_mapping,
+        input_mapping=input_mapping,
         output_mapping=args.output_mapping,
         batch_size=args.batch_size,
         # setSchedule("continuous"): slot-level in-flight batching for

@@ -195,6 +195,18 @@ def _block_key(tokens):
     return np.asarray(tokens, np.int32).tobytes()
 
 
+def pages_for_tokens(n_tokens, page_tokens):
+    """Physical KV pages a ``n_tokens``-token context occupies at
+    ``page_tokens`` tokens per page (ceiling division; 0 for an empty
+    context).  The cost-attribution plane's occupancy unit: the usage
+    ledger integrates ``pages_for_tokens(context) × chunk_duration``
+    into per-request **page-seconds** (docs/observability.md "Cost
+    attribution & usage ledger"), so KV residency is charged in the
+    same currency the :class:`PagePool` allocates in."""
+    n, p = int(n_tokens), max(1, int(page_tokens))
+    return (n + p - 1) // p
+
+
 #: Canonical affinity-fingerprint width in tokens: the granularity at
 #: which the fleet router and the radix cache agree on "same prefix".
 #: It matches the default radix ``block_tokens`` (one head block), but

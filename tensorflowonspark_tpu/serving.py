@@ -28,6 +28,7 @@ Run the CLI with ``python -m tensorflowonspark_tpu.serving ...``.
 """
 
 import importlib
+import itertools
 import json
 import logging
 import os
@@ -420,6 +421,27 @@ def predict_rows(
     submit_t = {}
     if stats is not None:
         stats.setdefault("latency_sec", {})
+    # cost attribution (docs/observability.md "Cost attribution &
+    # usage ledger"): the static schedule records one ledger row per
+    # request too — tenant (reserved TENANT_INPUT column, validated
+    # like the continuous path), tokens in/out, latency.  Rows key by
+    # a per-job prefix so the process-wide ledger never collides
+    # across jobs.
+    from tensorflowonspark_tpu.telemetry import ledger as _ledger_mod
+
+    _ledger = _ledger_mod.get_ledger()
+    _job = "sj%d-" % next(_STATIC_JOB_SEQ)
+    tenant_col = next(
+        (c for c in input_mapping
+         if input_mapping[c] == serving_engine.TENANT_INPUT), None
+    )
+    prompt_cols = [
+        c for c in input_mapping
+        if input_mapping[c] in (
+            getattr(predict, "column_padding", None) or {}
+        )
+    ]
+    tenants = {}
     # generation predictors declare ragged columns (prompts of varying
     # length) via ``predict.column_padding = {input_name: pad_value}``;
     # those stack left-padded and ship a ``<input>_pad`` count column
@@ -505,21 +527,50 @@ def predict_rows(
 
     def _emit(flushed):
         for idx, r in flushed:
+            rid = _job + "req%d" % idx
             t_sub = submit_t.pop(idx, None)
+            lat = None
             if t_sub is not None:
                 lat = time.monotonic() - t_sub
-                lat_hist.observe(lat)
+                # the trace-id exemplar rides the shared histogram so
+                # tail buckets name a concrete request (ISSUE 14)
+                lat_hist.observe(lat, exemplar=rid)
                 if stats is not None:
                     stats["latency_sec"][idx] = lat
+            if _ledger.enabled:
+                toks_out = 0
+                if isinstance(r, dict) and "error" not in r:
+                    if "generated_len" in r:
+                        toks_out = int(np.asarray(r["generated_len"]))
+                    elif "generated" in r:
+                        toks_out = int(np.asarray(r["generated"]).size)
+                _ledger.record(
+                    rid, tenant=tenants.pop(idx, None),
+                    tokens_in=tokens_in.pop(idx, 0),
+                    tokens_out=toks_out, latency_sec=lat,
+                )
             yield r
 
+    tokens_in = {}
     for row in rows:
         idx = n_seen
         n_seen += 1
         submit_t[idx] = time.monotonic()
         try:
-            _validate_static_row(row, idx, input_mapping)
+            tenant = _validate_static_row(
+                row, idx, input_mapping, tenant_col
+            )
             buf.append(("ok", row, idx))
+            if _ledger.enabled and isinstance(row, dict):
+                if tenant is not None:
+                    tenants[idx] = tenant
+                if prompt_cols:
+                    try:
+                        tokens_in[idx] = int(
+                            np.asarray(row[prompt_cols[0]]).size
+                        )
+                    except Exception:  # noqa: BLE001 - accounting only
+                        pass
         except serving_engine.RequestValidationError as e:
             if on_error == "raise":
                 raise
@@ -535,11 +586,18 @@ def predict_rows(
             yield r
 
 
-def _validate_static_row(row, idx, input_mapping):
+#: per-process static-job sequence (ledger row namespacing)
+_STATIC_JOB_SEQ = itertools.count(1)
+
+
+def _validate_static_row(row, idx, input_mapping, tenant_col=None):
     """Static-schedule admission validation: every mapped input column
     must be present — a missing key used to surface as a bare
     ``KeyError`` from deep inside the batch flush; now the error names
-    the request index and the missing column at admission."""
+    the request index and the missing column at admission.  A mapped
+    reserved ``tenant`` column is validated here too (the SAME rule as
+    the continuous engine: non-empty string, typed ``bad_tenant``
+    error naming the request index and offending value)."""
     for col in sorted(input_mapping):
         if col not in row:
             raise serving_engine.RequestValidationError(
@@ -550,6 +608,9 @@ def _validate_static_row(row, idx, input_mapping):
                 ),
                 kind="missing_input", request_index=idx,
             )
+    if tenant_col is not None:
+        return serving_engine.validate_tenant(row, idx, tenant_col)
+    return None
 
 
 def _apply_output_mapping(out, output_mapping):
@@ -567,9 +628,16 @@ def _apply_output_mapping(out, output_mapping):
 #: reserved input names (re-exported from serving_engine): a row
 #: column mapped to BUDGET_INPUT carries that request's token budget
 #: (evicted after ``min(max_new, budget)`` tokens even without eos);
-#: one mapped to DEADLINE_INPUT carries its deadline in seconds
+#: one mapped to DEADLINE_INPUT carries its deadline in seconds; one
+#: mapped to TENANT_INPUT carries its tenant key for the usage ledger
+#: (validated on BOTH schedules — non-string/empty values are typed
+#: ``bad_tenant`` errors naming the request); TRACE_INPUT carries an
+#: explicit request trace id (the fleet router mints one per request
+#: when the caller doesn't)
 BUDGET_INPUT = serving_engine.BUDGET_INPUT
 DEADLINE_INPUT = serving_engine.DEADLINE_INPUT
+TENANT_INPUT = serving_engine.TENANT_INPUT
+TRACE_INPUT = serving_engine.TRACE_INPUT
 
 
 def _predict_rows_continuous(predict, rows, input_mapping,

@@ -71,6 +71,7 @@ import time
 import numpy as np
 
 from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.prefix_cache import pages_for_tokens
 
 logger = logging.getLogger(__name__)
 
@@ -131,6 +132,27 @@ BUDGET_INPUT = "max_new"
 #: is cancelled between chunks and returns a ``deadline`` record
 DEADLINE_INPUT = "deadline_sec"
 
+#: reserved input name: a row column mapped to it carries that
+#: request's TENANT key — the usage ledger (telemetry/ledger.py)
+#: attributes the request's resources (chip-seconds, page-seconds,
+#: tokens, wire bytes) to it.  Validated at admission on BOTH
+#: schedules: a non-string or empty value is a typed error naming the
+#: request index and the offending value.  Requests without a mapped
+#: tenant land on :data:`~tensorflowonspark_tpu.telemetry.ledger.
+#: DEFAULT_TENANT`.
+TENANT_INPUT = "tenant"
+
+#: reserved input name: a row column mapped to it carries the
+#: request's TRACE id.  The fleet router mints one per request at
+#: fleet admission and threads it through dispatch → replica feed →
+#: this engine, so the engine's span chain (admission → queue_wait →
+#: prefill → decode_chunk×N → emit) joins the router's trace — and a
+#: re-dispatch after a replica death continues the SAME trace on the
+#: surviving replica (docs/observability.md "Cost attribution & usage
+#: ledger").  Unmapped requests trace as ``req<N>`` exactly as
+#: before.
+TRACE_INPUT = "trace_id"
+
 #: admission policies (see module docstring)
 POLICIES = ("block", "reject", "degrade")
 
@@ -174,7 +196,8 @@ def error_record(kind, request_index, message, tokens_done=0,
 
     ``kind`` is one of: ``missing_input`` / ``bad_dtype`` /
     ``bad_shape`` / ``empty_prompt`` / ``too_long`` / ``bad_budget``
-    / ``bad_deadline`` (validation), ``admit`` / ``predict``
+    / ``bad_deadline`` / ``bad_tenant`` / ``bad_trace`` (validation),
+    ``admit`` / ``predict``
     (per-request capture), ``shed`` (admission control), ``deadline``
     (expiry — carries the committed ``partial`` tokens), ``drained``
     (a graceful :meth:`ServingEngine.drain` stopped admissions or
@@ -189,6 +212,31 @@ def error_record(kind, request_index, message, tokens_done=0,
     if partial is not None:
         rec["partial"] = [int(t) for t in partial]
     return {"error": rec}
+
+
+def validate_tenant(row, idx, tenant_col):
+    """Shared tenant-key validation for BOTH schedules: the reserved
+    :data:`TENANT_INPUT` column must hold a non-empty string (numpy
+    str scalars normalize); anything else is a typed
+    :class:`RequestValidationError` (kind ``bad_tenant``) naming the
+    request index and the offending value."""
+    v = row[tenant_col]
+    if isinstance(v, np.str_):
+        v = str(v)
+    if isinstance(v, bytes):
+        try:
+            v = v.decode("utf-8")
+        except UnicodeDecodeError:
+            v = None
+    if not isinstance(v, str) or not v:
+        raise RequestValidationError(
+            "request {0}: tenant column {1!r} must hold a non-empty "
+            "string tenant key, got {2!r}".format(
+                idx, tenant_col, row[tenant_col]
+            ),
+            kind="bad_tenant", request_index=idx,
+        )
+    return v
 
 
 def apply_output_mapping(out, output_mapping):
@@ -275,8 +323,11 @@ class ServingEngine(object):
       predict: generation predictor exposing ``make_slot_decoder``
         (``transformer.serving_builder(mode="generate")``).
       input_mapping: ``{column: input_name}``; exactly one column must
-        map to a ragged prompt input, optionally one to
-        :data:`BUDGET_INPUT` and one to :data:`DEADLINE_INPUT`.
+        map to a ragged prompt input, optionally one each to
+        :data:`BUDGET_INPUT`, :data:`DEADLINE_INPUT`,
+        :data:`TENANT_INPUT` (usage-ledger attribution) and
+        :data:`TRACE_INPUT` (an explicit request trace id — the fleet
+        router threads its minted ids through this).
       output_mapping: optional ``{output_name: column}`` rename.
       num_slots: in-flight KV-cache slots.
       chunk: decode steps per dispatch (None = predictor default).
@@ -361,6 +412,14 @@ class ServingEngine(object):
         self.deadline_col = next(
             (c for c in input_mapping
              if input_mapping[c] == DEADLINE_INPUT), None
+        )
+        self.tenant_col = next(
+            (c for c in input_mapping
+             if input_mapping[c] == TENANT_INPUT), None
+        )
+        self.trace_col = next(
+            (c for c in input_mapping
+             if input_mapping[c] == TRACE_INPUT), None
         )
         self.policy = policy
         self.on_error = on_error
@@ -475,6 +534,12 @@ class ServingEngine(object):
             # via _update_reuse_stats when the layout is paged
             "kv_layout": getattr(self.decoder, "kv_layout",
                                  "contiguous"),
+            # cost attribution (docs/observability.md "Cost
+            # attribution & usage ledger"): summed decode-chunk wall
+            # time (the denominator the ledger's per-request
+            # chip-second rows must sum back to) and tokens emitted
+            # by completed requests
+            "decode_wall_sec": 0.0, "tokens_out": 0,
         })
         self._reuse_base = dict(self._decoder_reuse_stats())
         # telemetry: metrics resolved ONCE (null singletons when
@@ -482,6 +547,23 @@ class ServingEngine(object):
         # request under trace id "req<idx>" (docs/observability.md)
         reg = telemetry.get_registry()
         self._tracer = telemetry.get_tracer()
+        # usage ledger (telemetry/ledger.py): per-request resource
+        # rows charged once per admit + once per decode CHUNK — far
+        # off the per-token path; no-ops when telemetry is disabled
+        from tensorflowonspark_tpu.telemetry import ledger as _ledger_mod
+
+        self._ledger = _ledger_mod.get_ledger()
+        # page-seconds currency: pages at the decoder's paged-KV page
+        # size, else the radix block width, else the canonical
+        # fingerprint block (prefix_cache.pages_for_tokens)
+        from tensorflowonspark_tpu import prefix_cache as _pc
+
+        pc = getattr(self.decoder, "prefix_cache", None)
+        self._page_tokens = int(
+            getattr(self.decoder, "_page_tokens", 0)
+            or (pc.block_tokens if pc is not None else 0)
+            or _pc.FINGERPRINT_TOKENS
+        )
         # always-on flight recorder (ISSUE 11): watchdog fires and
         # swap rollbacks below freeze the recent rings into a dump
         # bundle (telemetry/blackbox.py; None when disabled)
@@ -516,6 +598,7 @@ class ServingEngine(object):
         # scheduler state
         self._pending = []      # validated, waiting for a slot
         self._slot_req = {}     # slot -> in-flight request record
+        self._rids = {}         # input idx -> trace id (emit marks)
         self._finished = {}     # input idx -> output row / record
         self._emit_next = 0
         self._n_in = 0
@@ -596,6 +679,14 @@ class ServingEngine(object):
             "weight_generation": self.stats["weight_generation"],
             "swaps": self.stats["swaps"],
             "rollbacks": self.stats["rollbacks"],
+            # cost row (ISSUE 14): what this engine burned and
+            # produced — the fleet router surfaces one per replica
+            # on /status
+            "usage": {
+                "chip_sec": round(self.stats["decode_wall_sec"], 6),
+                "tokens_out": self.stats["tokens_out"],
+                "prefix_tokens_saved": self.stats["prefix_tokens_saved"],
+            },
         }
 
     # -- cross-request reuse accounting --------------------------------
@@ -630,7 +721,18 @@ class ServingEngine(object):
 
     # -- admission ------------------------------------------------------
 
-    def _validate(self, row, idx):
+    def _rid_of(self, row, idx):
+        """The request's trace id: the mapped :data:`TRACE_INPUT`
+        column when it carries a usable string (the fleet router's
+        minted id — lenient here; :meth:`_validate` rejects junk with
+        a typed error), else the engine-local ``req<idx>``."""
+        if self.trace_col is not None and isinstance(row, dict):
+            v = row.get(self.trace_col)
+            if isinstance(v, str) and v:
+                return v
+        return "req%d" % idx
+
+    def _validate(self, row, idx, rid=None):
         """Admission-time request validation; returns the request
         record or raises :class:`RequestValidationError` naming the
         request index and the offending column."""
@@ -704,9 +806,24 @@ class ServingEngine(object):
                     "number: {2}".format(idx, self.deadline_col, e),
                     kind="bad_deadline", request_index=idx,
                 )
+        tenant = validate_tenant(
+            row, idx, self.tenant_col
+        ) if self.tenant_col is not None else None
+        if self.trace_col is not None:
+            tv = row[self.trace_col]
+            if not isinstance(tv, str) or not tv:
+                raise RequestValidationError(
+                    "request {0}: trace column {1!r} must hold a "
+                    "non-empty string trace id, got {2!r}".format(
+                        idx, self.trace_col, tv
+                    ),
+                    kind="bad_trace", request_index=idx,
+                )
         now = self._clock()
         return {
             "idx": idx,
+            "rid": rid if rid is not None else self._rid_of(row, idx),
+            "tenant": tenant,
             "prompt": prompt.astype(np.int32, copy=False),
             "budget": budget,
             "eos_at": None,
@@ -718,6 +835,33 @@ class ServingEngine(object):
     def _record(self, idx, kind, message, tokens_done=0, partial=None):
         self._finished[idx] = error_record(
             kind, idx, message, tokens_done=tokens_done, partial=partial
+        )
+
+    def _ledger_settle(self, req, tokens_out=None, latency_sec=None,
+                       close=True):
+        """ONE ledger crossing per request: admission fields
+        (tenant/tokens_in/wire/prefix/queue-wait) and decode cost
+        (chip/page-seconds) accrue lock-free on the engine-local
+        request record (:meth:`_admit_free` / :meth:`_run_chunk`) and
+        settle here at the terminal point.  ``close=False`` is the
+        fleet replica's WRECKAGE flush — a dead replica's spend stays
+        attributed while the surviving replica continues the row
+        (fleet/replica.py)."""
+        self._ledger.settle(
+            req["rid"], tenant=req.get("tenant"),
+            tokens_in=len(req["prompt"]),
+            wire_bytes=req.pop("wire_bytes_acc", 0),
+            prefix_tokens_saved=req.pop("prefix_saved_acc", 0),
+            queue_wait_sec=req.pop("queue_wait_acc", 0.0),
+            chip_sec=req.pop("chip_sec", 0.0),
+            page_sec=req.pop("page_sec", 0.0),
+            tokens_out=tokens_out, latency_sec=latency_sec,
+            close=close,
+        )
+
+    def _ledger_close(self, req, tokens_out, latency_sec=None):
+        self._ledger_settle(
+            req, tokens_out=tokens_out, latency_sec=latency_sec
         )
 
     def _pull_one(self, it):
@@ -743,14 +887,17 @@ class ServingEngine(object):
                 return None
             idx = self._n_in
             self._n_in += 1
+            rid = self._rid_of(row, idx)
+            self._rids[idx] = rid
             try:
-                with self._tracer.span("admission", trace="req%d" % idx):
-                    return self._validate(row, idx)
+                with self._tracer.span("admission", trace=rid):
+                    return self._validate(row, idx, rid)
             except RequestValidationError as e:
                 if self.on_error == "raise":
                     raise
                 self.stats["errors"] += 1
                 self._m["errors"].inc()
+                self._ledger.settle(rid, tokens_out=0)
                 self._record(idx, e.kind, e)
         return None
 
@@ -779,10 +926,11 @@ class ServingEngine(object):
                 self.stats["shed"] += 1
                 self._m["shed"].inc()
                 self._tracer.mark(
-                    "shed", trace="req%d" % req["idx"], severity="warn",
-                    request_index=req["idx"],
+                    "shed", trace=req["rid"], severity="warn",
+                    request_index=req["idx"], trace_id=req["rid"],
                     queue_depth=self.queue_depth,
                 )
+                self._ledger_close(req, tokens_out=0)
                 self._record(
                     req["idx"], "shed",
                     "request {0} shed: admission queue full "
@@ -809,6 +957,10 @@ class ServingEngine(object):
                 # committed tokens — the record keeps them
                 committed = [t for t in (req["out"] or [])
                              if isinstance(t, int)]
+                self._ledger_close(
+                    req, tokens_out=len(committed),
+                    latency_sec=now - req["submit"],
+                )
                 self._record(
                     req["idx"], "deadline",
                     "request {0} expired after {1:.3f}s waiting for a "
@@ -872,7 +1024,7 @@ class ServingEngine(object):
                         self.stats["degraded"] += 1
                         self._m["degraded"].inc()
             prompt = req.get("resume_prompt", req["prompt"])
-            rid = "req%d" % req["idx"]
+            rid = req["rid"]
             wait = self._clock() - req["submit"]
             self._m_queue_wait.observe(wait)
             if self._tracer.enabled:
@@ -910,15 +1062,34 @@ class ServingEngine(object):
                     # counts against the new generation (handled at
                     # the next scheduling pass)
                     self._probation_errors += 1
+                self._ledger_close(req, tokens_out=0)
                 self._record(req["idx"], "admit", e)
                 continue  # the slot stays free for the next request
             committed = req["out"] or []
             req["out"] = list(committed) + [first]
+            req["admit_len"] = int(len(prompt))
             self.stats["admitted"] += 1
             self._m["admitted"].inc()
             self.stats["request_wire_bytes"] += int(
                 getattr(prompt, "nbytes", 0)
             )
+            # usage-ledger stashes, settled in ONE ledger call at the
+            # request's terminal point (_ledger_settle).  A watchdog/
+            # swap REQUEUE keeps its original submit time, so its
+            # "wait" includes decode already charged as chip time —
+            # skip the queue-wait accrual for those.
+            if self._ledger.enabled:
+                req["wire_bytes_acc"] = req.get(
+                    "wire_bytes_acc", 0
+                ) + int(getattr(prompt, "nbytes", 0))
+                if cached:
+                    req["prefix_saved_acc"] = req.get(
+                        "prefix_saved_acc", 0
+                    ) + cached
+                if "resume_prompt" not in req:
+                    req["queue_wait_acc"] = req.get(
+                        "queue_wait_acc", 0.0
+                    ) + wait
             self._slot_req[slot] = req
         return progressed
 
@@ -964,15 +1135,36 @@ class ServingEngine(object):
         self._m["chunks"].inc()
         if self._profile is not None:
             self._profile.step()
+        dur = time.perf_counter() - t_chunk0
+        self.stats["decode_wall_sec"] += dur
         if self._tracer.enabled:
             # one dispatch serves every in-flight lane: attribute the
             # SAME interval to each request's trace so a single
             # request's trace stays connected admission→…→emit
-            dur = time.perf_counter() - t_chunk0
             for req in self._slot_req.values():
                 self._tracer.add(
                     "decode_chunk", t_chunk0, dur,
-                    trace="req%d" % req["idx"], chunk=idx,
+                    trace=req["rid"], chunk=idx,
+                )
+        if self._slot_req and self._ledger.enabled:
+            # cost attribution: the chunk's wall time apportioned by
+            # live slot share (the per-request rows sum back to the
+            # measured decode wall time), and the KV occupancy
+            # integral — pages held × chunk duration — as
+            # page-seconds (docs/observability.md).  Accrued on the
+            # engine-LOCAL request record (plain float adds, no
+            # locks) and flushed to the ledger ONCE at the request's
+            # terminal point (:meth:`_ledger_flush`) — per-chunk
+            # ledger traffic would be the one place this plane could
+            # tax the decode cadence.
+            share = dur / len(self._slot_req)
+            for req in self._slot_req.values():
+                ctx = req.get("admit_len", len(req["prompt"])) + len(
+                    req["out"] or ()
+                )
+                req["chip_sec"] = req.get("chip_sec", 0.0) + share
+                req["page_sec"] = req.get("page_sec", 0.0) + (
+                    pages_for_tokens(ctx, self._page_tokens) * dur
                 )
         self._update_reuse_stats()
         if isinstance(toks, tuple):
@@ -1005,11 +1197,12 @@ class ServingEngine(object):
                 ) if committed else req["prompt"]
             )
             self._tracer.mark(
-                mark_event, trace="req%d" % req["idx"],
+                mark_event, trace=req["rid"],
                 severity=(
                     "warn" if mark_event == "watchdog_recover" else "info"
                 ),
-                request_index=req["idx"], tokens_committed=len(committed),
+                request_index=req["idx"], trace_id=req["rid"],
+                tokens_committed=len(committed),
             )
         self._pending[:0] = inflight
         return inflight
@@ -1231,6 +1424,7 @@ class ServingEngine(object):
                 continue
             self.stats["drained"] += 1
             self._m["drained"].inc()
+            self._ledger_close(req, tokens_out=0)
             self._record(
                 req["idx"], "drained",
                 "request {0} drained: engine stopped admissions "
@@ -1248,6 +1442,10 @@ class ServingEngine(object):
             committed = [t for t in req["out"] if isinstance(t, int)]
             self.stats["drained"] += 1
             self._m["drained"].inc()
+            self._ledger_close(
+                req, tokens_out=len(committed),
+                latency_sec=now - req["submit"],
+            )
             self._record(
                 req["idx"], "drained",
                 "request {0} cancelled by drain deadline; {1} "
@@ -1292,11 +1490,17 @@ class ServingEngine(object):
         self._finished[req["idx"]] = apply_output_mapping(
             out, self.output_mapping
         )
+        lat = t_done - req["submit"]
         self.stats["completed"] += 1
-        self.stats["latency_sec"][req["idx"]] = t_done - req["submit"]
+        self.stats["tokens_out"] += int(gen_len)
+        self.stats["latency_sec"][req["idx"]] = lat
         self.stats["done_at"][req["idx"]] = t_done - self._t0
         self._m["completed"].inc()
-        self._m_lat.observe(t_done - req["submit"])
+        # the latency observation carries the request's TRACE id as
+        # its exemplar: a p99 bucket then names a concrete request
+        # whose merged trace `forensics explain` can pull (ISSUE 14)
+        self._m_lat.observe(lat, exemplar=req["rid"])
+        self._ledger_close(req, tokens_out=int(gen_len), latency_sec=lat)
         self._note_clean_completion()
 
     def _expire_slot(self, slot, req, now):
@@ -1306,9 +1510,14 @@ class ServingEngine(object):
         self.stats["expired"] += 1
         self._m["expired"].inc()
         self._tracer.mark(
-            "deadline_cancel", trace="req%d" % req["idx"],
+            "deadline_cancel", trace=req["rid"],
             severity="warn",
-            request_index=req["idx"], tokens_done=len(committed),
+            request_index=req["idx"], trace_id=req["rid"],
+            tokens_done=len(committed),
+        )
+        self._ledger_close(
+            req, tokens_out=len(committed),
+            latency_sec=now - req["submit"],
         )
         self._record(
             req["idx"], "deadline",
@@ -1326,7 +1535,12 @@ class ServingEngine(object):
         """Stream completed rows in input order as soon as the head of
         the reorder buffer is ready."""
         while self._emit_next in self._finished:
-            self._tracer.mark("emit", trace="req%d" % self._emit_next)
+            self._tracer.mark(
+                "emit",
+                trace=self._rids.pop(
+                    self._emit_next, "req%d" % self._emit_next
+                ),
+            )
             yield self._finished.pop(self._emit_next)
             self._emit_next += 1
 

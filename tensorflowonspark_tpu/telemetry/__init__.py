@@ -40,7 +40,14 @@ driver.  This package is the one place they all publish now
   triggers (watchdog fire, swap rollback, supervisor restart, dead
   executor, leader failover, page-severity alerts), analyzed
   post-mortem by ``python -m tensorflowonspark_tpu.forensics
-  explain``.
+  explain``;
+- :mod:`~tensorflowonspark_tpu.telemetry.ledger` — the per-request /
+  per-tenant usage ledger (ISSUE 14): queue-wait, decode
+  chip-seconds, KV page-seconds, prefix tokens saved, wire bytes,
+  tokens in/out per request, aggregated under the reserved
+  ``"tenant"`` input with bounded top-K heavy-hitter tracking, fleet
+  totals riding the heartbeat piggyback as ``usage.*`` counters and
+  the ``/usage`` HTTP route.
 
 **Zero-cost-when-disabled**: ``TFOS_TELEMETRY=0`` (or
 ``set_enabled(False)``) makes every registry accessor return a shared
@@ -63,6 +70,7 @@ from tensorflowonspark_tpu.telemetry.registry import (  # noqa: F401
     histogram_percentile,
     set_enabled,
     snapshot_delta,
+    tail_exemplars,
 )
 from tensorflowonspark_tpu.telemetry.tracing import (  # noqa: F401
     Tracer,
@@ -85,6 +93,15 @@ from tensorflowonspark_tpu.telemetry.aggregate import (  # noqa: F401
     fleet_view,
     merge_snapshots,
     start_node_publisher,
+)
+from tensorflowonspark_tpu.telemetry.ledger import (  # noqa: F401
+    DEFAULT_TENANT,
+    SpaceSaving,
+    UsageLedger,
+    get_ledger,
+    merge_usage,
+    tenants_from_snapshot,
+    usage_openmetrics,
 )
 from tensorflowonspark_tpu.telemetry.health import (  # noqa: F401
     Alert,

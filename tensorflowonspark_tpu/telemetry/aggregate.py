@@ -60,13 +60,20 @@ def merge_snapshots(snapshots):
             agg = hists.setdefault(
                 name,
                 {"count": 0, "sum": 0.0, "buckets": {},
-                 "min": None, "max": None},
+                 "min": None, "max": None, "exemplars": {}},
             )
             agg["count"] += h.get("count", 0)
             agg["sum"] += h.get("sum", 0.0)
             for lo, hi, c in h.get("buckets", []):
                 key = (lo, hi)
                 agg["buckets"][key] = agg["buckets"].get(key, 0) + c
+            for lo, hi, ex in h.get("exemplars", []) or []:
+                # newest exemplar per bucket wins across executors —
+                # the reference stays one concrete recent request
+                key = (lo, hi)
+                prev = agg["exemplars"].get(key)
+                if prev is None or ex.get("ts", 0) > prev.get("ts", 0):
+                    agg["exemplars"][key] = ex
             for k, pick in (("min", min), ("max", max)):
                 v = h.get(k)
                 if v is not None:
@@ -84,6 +91,11 @@ def merge_snapshots(snapshots):
             "count": agg["count"], "sum": agg["sum"],
             "min": agg["min"], "max": agg["max"], "buckets": triples,
         }
+        if agg["exemplars"]:
+            h["exemplars"] = sorted(
+                ([lo, hi, ex] for (lo, hi), ex in agg["exemplars"].items()),
+                key=lambda t: t[0],
+            )
         h["p50"] = _registry.histogram_percentile(h, 50)
         h["p99"] = _registry.histogram_percentile(h, 99)
         if h["count"]:

@@ -17,7 +17,12 @@ or a human with ``curl`` can hit:
   with the reasons when not (the orchestrator-probe contract);
 - ``GET /status`` — compact JSON fleet summary: per-executor rates,
   active alerts, straggler hints, and the registered subsystem
-  providers (serving engine, hier-PS DCN link, partition ledger).
+  providers (serving engine, hier-PS DCN link, partition ledger);
+- ``GET /usage`` — per-tenant cost attribution (ISSUE 14): OpenMetrics
+  counters labeled ``tenant="..."`` with cardinality bounded by the
+  usage ledger's tenant table (round-trips :func:`parse_openmetrics`);
+  ``?format=json`` returns the full JSON view including the
+  heavy-hitter sketch estimates.
 
 :func:`parse_openmetrics` is the STRICT parser the tests round-trip
 ``/metrics`` output through — it enforces the format invariants a real
@@ -309,11 +314,34 @@ class _Handler(BaseHTTPRequestHandler):
                     200, "application/json",
                     json.dumps(plane.journal_events()).encode("utf-8"),
                 )
+            elif path == "/usage" and hasattr(plane, "usage"):
+                # per-tenant cost attribution (ISSUE 14): OpenMetrics
+                # counters with a bounded `tenant` label by default
+                # (round-trips the strict parser), the full JSON view
+                # (incl. heavy-hitter sketch estimates) on
+                # ?format=json
+                usage = plane.usage()
+                if "format=json" in (
+                    self.path.partition("?")[2] or ""
+                ):
+                    self._reply(
+                        200, "application/json",
+                        json.dumps(usage).encode("utf-8"),
+                    )
+                else:
+                    from tensorflowonspark_tpu.telemetry import (
+                        ledger as _ledger_mod,
+                    )
+
+                    body = _ledger_mod.usage_openmetrics(
+                        usage.get("tenants", {})
+                    ).encode("utf-8")
+                    self._reply(200, OPENMETRICS_CONTENT_TYPE, body)
             else:
                 self._reply(
                     404, "text/plain",
                     b"not found; routes: /metrics /healthz /status "
-                    b"/journal\n",
+                    b"/journal /usage\n",
                 )
         except Exception as e:  # noqa: BLE001 - a scrape must see 500,
             logger.warning(  # not a dropped connection

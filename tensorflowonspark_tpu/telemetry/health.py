@@ -1230,6 +1230,33 @@ class HealthPlane(object):
         }
         return out
 
+    def usage(self):
+        """The ``/usage`` payload (ISSUE 14): the FLEET-wide per-tenant
+        cost table, recovered from the merged scrape's
+        ``usage.<field>.<tenant>`` mirror counters (every executor's
+        ledger publishes them into its registry, the heartbeat
+        piggyback ships them, the normal counter merge sums them —
+        no second wire format), plus this process's own ledger detail
+        (top-K heavy hitters with sketch error bounds, tracked row
+        count)."""
+        from tensorflowonspark_tpu.telemetry import ledger as _ledger_mod
+
+        tenants = _ledger_mod.tenants_from_snapshot(
+            self.merged_snapshot()
+        )
+        local = _ledger_mod.get_ledger().snapshot()
+        if not tenants:
+            # nothing scraped yet (or a bare plane with no mirror
+            # counters): fall back to the local ledger's own table
+            tenants = local.get("tenants", {})
+        return {
+            "tenants": tenants,
+            "top": local.get("top", []),
+            "requests_tracked": local.get("requests_tracked", 0),
+            "rows_evicted": local.get("rows_evicted", 0),
+            "tenants_folded": local.get("tenants_folded", 0),
+        }
+
     def journal_events(self, limit=None):
         """The ``/journal`` payload: the fleet event record via
         ``journal_fn`` when wired, else this process's own journal."""

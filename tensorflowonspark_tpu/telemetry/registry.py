@@ -27,6 +27,7 @@ far tighter against numpy percentiles in tests/test_telemetry.py.
 import bisect
 import os
 import threading
+import time
 
 #: Env kill-switch: ``TFOS_TELEMETRY=0`` disables the default registry
 #: and tracer at import time (docs/observability.md "Overhead budget").
@@ -107,7 +108,7 @@ class Histogram(object):
 
     __slots__ = (
         "name", "bounds", "_counts", "_count", "_sum", "_min", "_max",
-        "_lock",
+        "_lock", "_exemplars",
     )
 
     def __init__(self, name, buckets=None):
@@ -120,8 +121,17 @@ class Histogram(object):
         self._min = None
         self._max = None
         self._lock = threading.Lock()
+        # bucket index -> (ref, value, wall ts): the newest observation
+        # per bucket that carried an exemplar reference (ISSUE 14 —
+        # trace ids, so a tail-latency bucket names the exact request
+        # whose merged trace explains it).  Bounded by the fixed bucket
+        # count; last-write-wins within a bucket.
+        self._exemplars = {}
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
+        """Record ``v``; ``exemplar`` optionally attaches a reference
+        (a trace id) to ``v``'s bucket — retained newest-per-bucket so
+        tail buckets always name a concrete offending request."""
         v = float(v)
         i = bisect.bisect_left(self.bounds, v)
         with self._lock:
@@ -132,6 +142,8 @@ class Histogram(object):
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._exemplars[i] = (str(exemplar), v, time.time())
 
     @property
     def count(self):
@@ -154,6 +166,7 @@ class Histogram(object):
             counts = list(self._counts)
             total, s = self._count, self._sum
             lo, hi = self._min, self._max
+            exemplars = dict(self._exemplars)
         out = {
             "count": total,
             # the EXACT running sum (never rounded, never re-derived
@@ -179,6 +192,18 @@ class Histogram(object):
                 if c
             ],
         }
+        if exemplars:
+            # [[lower, upper, {"ref", "value", "ts"}], ...] — the same
+            # bucket-edge convention as the count triples, so deltas
+            # and merges can align them without re-deriving bounds
+            out["exemplars"] = [
+                [
+                    self.bounds[i - 1] if i > 0 else 0.0,
+                    self.bounds[i] if i < len(self.bounds) else None,
+                    {"ref": ref, "value": val, "ts": ts},
+                ]
+                for i, (ref, val, ts) in sorted(exemplars.items())
+            ]
         if total:
             out["mean"] = s / total
         return out
@@ -227,6 +252,27 @@ def histogram_percentile(snapshot, q):
     return result
 
 
+def tail_exemplars(snapshot, q=99):
+    """Exemplars from the buckets at/above the ``q``-th percentile of
+    a histogram snapshot (or delta/merge) — "name me a request that
+    actually lives in the p99 tail", heaviest bucket first.  Returns
+    ``[{"ref", "value", "ts", "bucket_lo", "bucket_hi"}]`` (empty when
+    the histogram recorded no exemplars).  The forensics analyzer uses
+    the top entry to pull the exact merged trace of a tail request
+    (ISSUE 14 — docs/observability.md "Cost attribution & usage
+    ledger")."""
+    if not snapshot:
+        return []
+    p = histogram_percentile(snapshot, q)
+    out = []
+    for lo, hi, ex in snapshot.get("exemplars", []) or []:
+        top = lo if hi is None else hi
+        if top >= p:
+            out.append(dict(ex, bucket_lo=lo, bucket_hi=hi))
+    out.sort(key=lambda e: -e["value"])
+    return out
+
+
 # ----------------------------------------------------------------------
 # null objects: the disabled-mode fast path
 # ----------------------------------------------------------------------
@@ -258,7 +304,7 @@ class _NullHistogram(object):
     count = 0
     sum = 0.0
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         pass
 
     def percentile(self, q):
@@ -393,6 +439,14 @@ def snapshot_delta(cur, base):
             "sum": h.get("sum", 0.0) - b.get("sum", 0.0),
             "buckets": triples,
         }
+        if h.get("exemplars"):
+            # keep only exemplars whose bucket saw traffic in this
+            # window — a stale reference from before the base snapshot
+            # would mislead the window's tail analysis
+            live = {(lo, hi) for lo, hi, _c in triples}
+            ex = [e for e in h["exemplars"] if (e[0], e[1]) in live]
+            if ex:
+                d["exemplars"] = ex
         d["p50"] = histogram_percentile(d, 50)
         d["p99"] = histogram_percentile(d, 99)
         if d["count"]:

@@ -251,14 +251,18 @@ class Tracer(object):
     def clear(self):
         self._spans.clear()
 
-    def export_chrome(self):
+    def export_chrome(self, trace=None):
         """Chrome-trace / Perfetto JSON object.  Spans map to complete
         ('X') events; the trace id rides ``args.trace`` and the span
         tree rides ``args.parent``.  Also carries ``process_name`` /
         ``thread_name`` metadata ('M') events — appended AFTER the
         spans, so old consumers indexing ``traceEvents[0]`` still see
         the first span — keeping a merged multi-executor trace
-        (:func:`merge_traces`) row-named."""
+        (:func:`merge_traces`) row-named.
+
+        ``trace`` filters the export to ONE trace id — the shape the
+        cost-attribution plane hands to :func:`merge_traces` to render
+        a single request's fleet-wide story (ISSUE 14)."""
         pid = os.getpid()
         pname = self.process_name or "pid{0}".format(pid)
         events = []
@@ -267,7 +271,10 @@ class Tracer(object):
             "args": {"name": pname},
         }]
         tids = []
-        for s in list(self._spans):
+        spans = list(self._spans)
+        if trace is not None:
+            spans = [s for s in spans if s.get("trace") == trace]
+        for s in spans:
             if s["tid"] not in tids:
                 tids.append(s["tid"])
                 meta.append({

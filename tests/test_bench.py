@@ -167,6 +167,11 @@ def _full_record():
             "serving_forensics_overhead_pct": 1.5,
             "forensics_dumps": 1,
             "journal_events": 42,
+            "ledger_overhead_pct": 1.4,
+            "usage_top_tenant_share": 0.52,
+            "usage_tenants": 4,
+            "usage_requests": 24,
+            "latency_exemplars": 3,
         },
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
                          "async_compressed_steps_per_sec": 61.7,
@@ -224,6 +229,10 @@ def test_summary_is_compact_standalone_json(tmp_path):
     assert parsed["alerts_fired"] == 1
     # forensics plane (ISSUE 11): journal + flight recorder live
     assert parsed["forensics_overhead_pct"] == 1.8
+    # cost-attribution plane (ISSUE 14): ledger + exemplars riding
+    # the full stack, and the skewed workload's heavy hitter
+    assert parsed["ledger_overhead_pct"] == 1.4
+    assert parsed["usage_top_tenant_share"] == 0.52
     assert parsed["wall_sec"] == 741.2
 
 
@@ -244,7 +253,8 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "serving_u8_vs_f32",
         "decode_overlap_gain", "telemetry_overhead_pct",
         "health_overhead_pct", "alerts_fired",
-        "forensics_overhead_pct", "wall_sec",
+        "forensics_overhead_pct", "ledger_overhead_pct",
+        "usage_top_tenant_share", "wall_sec",
         "full_record",
     ])
 

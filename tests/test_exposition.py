@@ -202,6 +202,32 @@ class TestHttpRoutes:
         assert code == 200
         assert json.loads(body)["healthy"] is True
 
+    def test_usage_round_trips_strict_parser(self, plane):
+        # ISSUE 14: /usage exposes per-tenant counters with a bounded
+        # `tenant` label and must round-trip the strict parser; the
+        # per-tenant table rides the scraped mirror counters
+        p, srv = plane
+        from tensorflowonspark_tpu.telemetry import ledger as ledger_mod
+
+        led = ledger_mod.get_ledger()
+        led.reset()
+        led.record("usage-route-req", tenant="route-t", tokens_in=3,
+                   tokens_out=9, latency_sec=0.01)
+        code, body = _get(srv.url + "/usage")
+        assert code == 200
+        fams = exposition.parse_openmetrics(body)
+        assert "usage_tokens_out" in fams
+        samples = {
+            labels["tenant"]: v
+            for _n, labels, v in fams["usage_tokens_out"]["samples"]
+        }
+        assert samples.get("route-t") == 9.0
+        code, body = _get(srv.url + "/usage?format=json")
+        assert code == 200
+        j = json.loads(body)
+        assert j["tenants"]["route-t"]["tokens_out"] == 9
+        led.reset()
+
 
 class TestHealthzFlip:
     def test_dead_executor_heartbeat_flips_healthz(self):
