@@ -1,0 +1,28 @@
+"""Invariant analysis plane (ISSUE 15): the build-time discipline
+layer for a stack whose correctness rests on conventions.
+
+Two tools plus the contract registries they check against:
+
+- :mod:`~tensorflowonspark_tpu.analysis.lint` — **tfoslint**, an
+  AST-based rule engine with repo-specific rules no generic linter
+  carries (use-after-donate, host-sync-in-hot-path, recompile
+  hazards, contract-string drift, thread hygiene, lock discipline)::
+
+      python -m tensorflowonspark_tpu.analysis.lint tensorflowonspark_tpu/
+
+- :mod:`~tensorflowonspark_tpu.analysis.locksan` — a **runtime
+  lock-order sanitizer**: instrumented ``Lock``/``RLock`` factories
+  record the global acquisition graph per thread and report cycles as
+  typed ``potential_deadlock`` records naming both lock sites.
+  Armed via ``TFOS_LOCKSAN=1`` (the chaos CI lanes run with it on).
+
+The contract registries are
+:data:`tensorflowonspark_tpu.serving_engine.RESERVED_INPUTS` (the
+reserved request-row columns) and
+:mod:`tensorflowonspark_tpu.telemetry.catalog` (the metric-name
+table the docs are generated from).  See docs/static_analysis.md.
+
+(Import ``analysis.lint`` / ``analysis.locksan`` directly — this
+package module stays import-free so ``python -m ...analysis.lint``
+never double-imports the CLI module.)
+"""

@@ -1,0 +1,1104 @@
+"""tfoslint — the repo-specific AST rule engine (ISSUE 15).
+
+Generic linters check Python; these rules check *this stack's*
+invariants — the conventions PRs 1–14 rely on but nothing enforced:
+
+========  ==========================================================
+TFOS001   **use-after-donate** — a buffer passed in a
+          ``donate_argnums`` position of a jitted program is dead
+          the moment the call dispatches; reading it afterwards is
+          silent aliasing on CPU and corruption on TPU.
+TFOS002   **host-sync-in-hot-path** — ``.item()``, ``np.asarray``/
+          ``np.array``, ``jax.device_get`` or ``int()/float()/
+          bool()`` on device values inside functions reachable from
+          the decode/step hot loops (``step_chunk``,
+          ``dispatch_chunk``, ``train_on_feed``) stall the dispatch
+          pipeline on a device round trip.
+TFOS003   **recompile hazard** — a computed Python scalar
+          (``len(...)``, arithmetic) interpolated into a jit static
+          argument or a compiled-program cache key recompiles per
+          distinct value.
+TFOS004   **contract-string drift** — a raw literal where a reserved
+          request-column constant exists
+          (``serving_engine.RESERVED_INPUTS``), or a metric name at a
+          ``counter()``/``gauge()``/``histogram()`` call site that
+          the catalog (``telemetry/catalog.py``) doesn't know.
+TFOS005   **thread hygiene** — a non-daemon thread with no visible
+          ``join()`` path (leaks the interpreter at exit), or a bare
+          ``except:`` / ``except Exception: pass`` swallowing
+          failures inside a loop (a daemon loop that eats its own
+          death).
+TFOS006   **lock discipline** — ``.acquire()`` outside a ``with``
+          block or a try/finally ``.release()`` leaks the lock on
+          any exception between acquire and release.
+========  ==========================================================
+
+Suppression (reason REQUIRED — a bare ``disable=`` is ignored)::
+
+    x = donated  # tfoslint: disable=TFOS001(rebound before reuse)
+
+on the finding's line, or on a comment-only line directly above it.
+Findings are fingerprinted line-number-independently into a baseline
+file (``analysis/baseline.json``); CI fails only on NEW findings, so
+adopting a new rule never blocks the tree on legacy sites.
+
+CLI::
+
+    python -m tensorflowonspark_tpu.analysis.lint [paths...]
+        [--baseline FILE] [--write-baseline] [--no-baseline]
+        [--json] [--list]
+"""
+
+import argparse
+import ast
+import collections
+import hashlib
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+
+from tensorflowonspark_tpu.telemetry import catalog
+
+#: rule id -> one-line description (the doc table is generated
+#: against this in tests/test_analysis.py)
+RULES = {
+    "TFOS001": "use-after-donate: donated jit buffer read after dispatch",
+    "TFOS002": "host sync inside a decode/step hot path",
+    "TFOS003": "recompile hazard: computed scalar in a jit static arg "
+               "or program-cache key",
+    "TFOS004": "raw string where a reserved-column/metric-name "
+               "contract constant exists",
+    "TFOS005": "thread hygiene: non-daemon thread without join, or "
+               "exception swallowed in a loop",
+    "TFOS006": "lock acquired outside with/try-finally",
+}
+
+#: the hot-loop roots TFOS002 walks the call graph from
+HOT_ROOTS = ("step_chunk", "dispatch_chunk", "train_on_feed")
+
+#: names whose attribute calls read a device array back to host
+_HOST_PULL_MODULES = ("np", "numpy", "onp")
+_HOST_PULL_FUNCS = ("asarray", "array")
+
+Finding = collections.namedtuple(
+    "Finding", "rule path line col message hint"
+)
+
+# matches anywhere in a comment, so the pragma can ride an existing
+# trailing comment: `except Exception:  # noqa - tfoslint: disable=...`
+_SUPPRESS_RE = re.compile(
+    r"#.*?tfoslint:\s*disable=((?:TFOS\d{3}\([^)]*\)\s*,?\s*)+)"
+)
+_SUPPRESS_ITEM_RE = re.compile(r"(TFOS\d{3})\(([^)]*)\)")
+
+
+def _unparse(node):
+    try:
+        return ast.unparse(node)
+    except Exception:
+        return "<expr>"
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+
+def parse_suppressions(src):
+    """``{lineno: {rule: reason}}`` — a comment-only line's
+    suppressions also cover the next code line, so long statements
+    can carry the pragma above themselves."""
+    out = {}
+    comment_only = {}
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return out
+    code_lines = set()
+    for tok in toks:
+        if tok.type == tokenize.COMMENT:
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {}
+            for rule, reason in _SUPPRESS_ITEM_RE.findall(m.group(1)):
+                if reason.strip():  # a reason is REQUIRED
+                    rules[rule] = reason.strip()
+            if not rules:
+                continue
+            line = tok.start[0]
+            out.setdefault(line, {}).update(rules)
+            stripped = src.splitlines()[line - 1].strip()
+            if stripped.startswith("#"):
+                comment_only[line] = rules
+        elif tok.type not in (
+            tokenize.NL, tokenize.NEWLINE, tokenize.INDENT,
+            tokenize.DEDENT, tokenize.ENCODING, tokenize.ENDMARKER,
+        ):
+            code_lines.add(tok.start[0])
+    # a comment-only pragma covers the next code line
+    for line, rules in comment_only.items():
+        nxt = line + 1
+        while nxt not in code_lines and nxt <= line + 50:
+            if nxt in comment_only:
+                break
+            nxt += 1
+        if nxt in code_lines:
+            out.setdefault(nxt, {}).update(rules)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# shared AST bookkeeping
+
+
+class _Module:
+    """One parsed file plus the derived maps every rule shares."""
+
+    def __init__(self, path, src, tree):
+        self.path = path
+        self.src = src
+        self.lines = src.splitlines()
+        self.tree = tree
+        self.parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        # all function defs by bare name (methods included)
+        self.functions = collections.defaultdict(list)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name].append(node)
+        self.jitted = self._collect_jitted()
+
+    def ancestors(self, node):
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+    def enclosing_statement(self, node):
+        """The statement node a nested expression belongs to."""
+        stmt = node
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.stmt):
+                stmt = anc
+                break
+        return stmt
+
+    # -- jit collection -----------------------------------------------------
+
+    @staticmethod
+    def _is_jit_call(call):
+        if not isinstance(call, ast.Call):
+            return False
+        f = call.func
+        return (isinstance(f, ast.Name) and f.id == "jit") or (
+            isinstance(f, ast.Attribute) and f.attr == "jit"
+        )
+
+    @staticmethod
+    def _int_tuple(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for e in node.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    out.append(e.value)
+            return tuple(out)
+        return ()
+
+    @staticmethod
+    def _str_tuple(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return (node.value,)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return tuple(
+                e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            )
+        return ()
+
+    def _jit_spec(self, call):
+        spec = {"donate": (), "donate_names": (),
+                "static": (), "static_names": ()}
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                spec["donate"] = self._int_tuple(kw.value)
+            elif kw.arg == "donate_argnames":
+                spec["donate_names"] = self._str_tuple(kw.value)
+            elif kw.arg == "static_argnums":
+                spec["static"] = self._int_tuple(kw.value)
+            elif kw.arg == "static_argnames":
+                spec["static_names"] = self._str_tuple(kw.value)
+        return spec
+
+    def _collect_jitted(self):
+        """``{callable-key: spec}`` for every ``x = jax.jit(f, ...)``
+        / ``self._x = jit(f, ...)`` binding in the module.  Keys are
+        the bare name (``x``) or attribute name (``_x`` — matched
+        against ``self._x(...)``/``obj._x(...)`` call sites)."""
+        out = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Assign) or not self._is_jit_call(
+                node.value
+            ):
+                continue
+            spec = self._jit_spec(node.value)
+            if not any(spec.values()):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = spec
+                elif isinstance(tgt, ast.Attribute):
+                    out[tgt.attr] = spec
+        return out
+
+    def jit_spec_for_call(self, call):
+        """The jit spec a call site resolves to, or None.  Handles
+        bound names, ``self.<attr>`` calls, and the direct
+        ``jax.jit(f, ...)(args)`` form."""
+        f = call.func
+        if isinstance(f, ast.Name) and f.id in self.jitted:
+            return self.jitted[f.id]
+        if isinstance(f, ast.Attribute) and f.attr in self.jitted:
+            return self.jitted[f.attr]
+        if self._is_jit_call(f):
+            spec = self._jit_spec(f)
+            if any(spec.values()):
+                return spec
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TFOS001 — use-after-donate
+
+
+def _assigned_names(stmt):
+    """Names (re)bound by a statement — the write targets."""
+    out = set()
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [i.optional_vars for i in stmt.items if i.optional_vars]
+    for tgt in targets:
+        for n in ast.walk(tgt):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def _rule_tfos001(mod, findings):
+    for fns in mod.functions.values():
+        for fn in fns:
+            _tfos001_function(mod, fn, findings)
+
+
+def _tfos001_function(mod, fn, findings):
+    # events keyed by line: donation calls, rebinds, loads
+    donations = []  # (end_line, name, call)
+    rebinds = collections.defaultdict(list)  # name -> [line]
+    loads = collections.defaultdict(list)  # name -> [(line, node)]
+    for node in ast.walk(fn):
+        if isinstance(node, ast.stmt):
+            for name in _assigned_names(node):
+                rebinds[name].append(node.lineno)
+        if isinstance(node, ast.Call):
+            spec = mod.jit_spec_for_call(node)
+            if spec and (spec["donate"] or spec["donate_names"]):
+                donated = set()
+                for pos in spec["donate"]:
+                    if pos < len(node.args) and isinstance(
+                        node.args[pos], ast.Name
+                    ):
+                        donated.add(node.args[pos].id)
+                for kw in node.keywords:
+                    if kw.arg in spec["donate_names"] and isinstance(
+                        kw.value, ast.Name
+                    ):
+                        donated.add(kw.value.id)
+                end = getattr(node, "end_lineno", node.lineno)
+                for name in donated:
+                    donations.append((end, name, node))
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            loads[node.id].append((node.lineno, node))
+    for end, name, call in donations:
+        # the first rebind strictly after the donating call closes
+        # the window; `state = f(state)` rebinds on the call's own
+        # statement, which also closes it
+        stmt = mod.enclosing_statement(call)
+        if name in _assigned_names(stmt):
+            continue
+        nxt = min(
+            (l for l in rebinds.get(name, ()) if l > end),
+            default=float("inf"),
+        )
+        for line, node in loads.get(name, ()):
+            if end < line < nxt:
+                findings.append(Finding(
+                    "TFOS001", mod.path, line, node.col_offset,
+                    "'%s' was donated to a jitted program on line %d "
+                    "and read again — the buffer is dead after "
+                    "dispatch (silent aliasing on CPU, corruption on "
+                    "TPU)" % (name, call.lineno),
+                    "rebind the name from the program's result "
+                    "(e.g. `%s = fn(%s)`) or drop it from "
+                    "donate_argnums" % (name, name),
+                ))
+                break  # one finding per donation window
+
+
+# ---------------------------------------------------------------------------
+# TFOS002 — host sync in hot path
+
+
+def _call_edges(fn):
+    """Names a function calls (bare and attribute call targets)."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                out.add(node.func.attr)
+    return out
+
+
+def _hot_reachable(mod):
+    """``{function-name: root}`` for every function reachable from a
+    hot root over the module-local name call graph."""
+    reach = {}
+    queue = [r for r in HOT_ROOTS if r in mod.functions]
+    for r in queue:
+        reach[r] = r
+    while queue:
+        name = queue.pop()
+        for fn in mod.functions[name]:
+            for callee in _call_edges(fn):
+                if callee in mod.functions and callee not in reach:
+                    reach[callee] = reach[name]
+                    queue.append(callee)
+    return reach
+
+
+def _device_tainted(mod, fn, expr):
+    """Heuristic: does this expression's subtree touch a device
+    value — a jnp/np attribute call, a jitted-program result name,
+    or an ``.item()``/``.sum()`` style reduction on one?"""
+    jit_results = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            f = node.value.func
+            tainted = (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("jnp", "jax") + _HOST_PULL_MODULES
+            ) or mod.jit_spec_for_call(node.value) is not None
+            if tainted:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        jit_results.add(tgt.id)
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            v = node.func.value
+            if isinstance(v, ast.Name) and v.id in (
+                ("jnp", "jax") + _HOST_PULL_MODULES
+            ):
+                return True
+            if isinstance(v, ast.Name) and v.id in jit_results:
+                return True
+        if isinstance(node, ast.Name) and node.id in jit_results:
+            return True
+    return False
+
+
+def _rule_tfos002(mod, findings):
+    reach = _hot_reachable(mod)
+    for name, root in reach.items():
+        for fn in mod.functions[name]:
+            _tfos002_function(mod, fn, root, findings)
+
+
+def _tfos002_function(mod, fn, root, findings):
+    where = (
+        "in '%s'" % fn.name if fn.name == root
+        else "in '%s' (reachable from hot loop '%s')" % (fn.name, root)
+    )
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "item" and not node.args:
+            findings.append(Finding(
+                "TFOS002", mod.path, node.lineno, node.col_offset,
+                ".item() %s synchronizes the device pipeline" % where,
+                "keep the value on device, or move the host pull to "
+                "the resolve/emit side of the dispatch split",
+            ))
+        elif (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and (
+                (f.value.id in _HOST_PULL_MODULES
+                 and f.attr in _HOST_PULL_FUNCS)
+                or (f.value.id == "jax" and f.attr == "device_get")
+            )
+        ):
+            # np.asarray on a HOST value is fine — only flag when the
+            # argument plausibly holds a device array
+            if node.args and _device_tainted(mod, fn, node.args[0]):
+                findings.append(Finding(
+                    "TFOS002", mod.path, node.lineno, node.col_offset,
+                    "%s.%s(...) on a device value %s blocks on a "
+                    "device→host transfer" % (f.value.id, f.attr, where),
+                    "batch the readback into the chunk-resolve sync "
+                    "point instead of the dispatch path",
+                ))
+        elif (
+            isinstance(f, ast.Name)
+            and f.id in ("int", "float", "bool")
+            and len(node.args) == 1
+            and _device_tainted(mod, fn, node.args[0])
+        ):
+            findings.append(Finding(
+                "TFOS002", mod.path, node.lineno, node.col_offset,
+                "%s(...) on a traced/device value %s forces a host "
+                "sync" % (f.id, where),
+                "carry the value as a device scalar, or sync once at "
+                "the chunk boundary",
+            ))
+
+
+# ---------------------------------------------------------------------------
+# TFOS003 — recompile hazard
+
+
+_SCALAR_CALLS = ("len", "int", "float", "round", "ord", "abs")
+
+
+def _computed_scalar(expr):
+    """True when the expression is a per-call-site computed Python
+    scalar (the recompile driver): a ``len()/int()``-style call, or
+    arithmetic over one.  Plain names/attributes/constants are
+    config-stable and pass."""
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Name) and expr.func.id in _SCALAR_CALLS:
+            return True
+        return False
+    if isinstance(expr, (ast.BinOp, ast.UnaryOp)):
+        return any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id in _SCALAR_CALLS
+            for n in ast.walk(expr)
+        )
+    return False
+
+
+def _fstring_interpolates(expr):
+    return isinstance(expr, ast.JoinedStr) and any(
+        isinstance(v, ast.FormattedValue) for v in expr.values
+    )
+
+
+def _rule_tfos003(mod, findings):
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            spec = mod.jit_spec_for_call(node)
+            if spec and (spec["static"] or spec["static_names"]):
+                _tfos003_static_args(mod, node, spec, findings)
+        # program-cache keys: X[key] = ... / X.setdefault(key, ...)
+        # where X smells like a compiled-program cache
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript):
+                    _tfos003_cache_key(mod, tgt, findings)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "setdefault"
+            and node.args
+        ):
+            fake = ast.Subscript(
+                value=node.func.value, slice=node.args[0], ctx=ast.Load()
+            )
+            ast.copy_location(fake, node)
+            ast.fix_missing_locations(fake)
+            _tfos003_cache_key(mod, fake, findings)
+
+
+def _tfos003_static_args(mod, call, spec, findings):
+    checks = []
+    for pos in spec["static"]:
+        if pos < len(call.args):
+            checks.append(("position %d" % pos, call.args[pos]))
+    for kw in call.keywords:
+        if kw.arg in spec["static_names"]:
+            checks.append(("'%s'" % kw.arg, kw.value))
+    for label, expr in checks:
+        if _computed_scalar(expr):
+            findings.append(Finding(
+                "TFOS003", mod.path, expr.lineno, expr.col_offset,
+                "computed scalar `%s` in static jit arg %s — every "
+                "distinct value triggers a full recompile"
+                % (_unparse(expr), label),
+                "bucket the value (pad to a bound) or hoist it to a "
+                "config constant",
+            ))
+
+
+_CACHE_NAME_RE = re.compile(r"(cache|_jits?|programs)$", re.IGNORECASE)
+
+
+def _tfos003_cache_key(mod, sub, findings):
+    base = _unparse(sub.value)
+    if not _CACHE_NAME_RE.search(base.split(".")[-1]):
+        return
+    key = sub.slice
+    parts = key.elts if isinstance(key, ast.Tuple) else [key]
+    for part in parts:
+        if _computed_scalar(part) or _fstring_interpolates(part):
+            findings.append(Finding(
+                "TFOS003", mod.path, part.lineno, part.col_offset,
+                "computed scalar `%s` in compiled-program cache key "
+                "`%s[...]` — unbounded key space means unbounded "
+                "compiles" % (_unparse(part), base),
+                "key on the padded/bucketed shape, not the raw value",
+            ))
+            return
+
+
+# ---------------------------------------------------------------------------
+# TFOS004 — contract strings
+
+
+_RESERVED = frozenset(catalog.RESERVED_INPUT_COLUMNS)
+# built by zip so the reserved names aren't themselves literal keys
+# here (the linter lints itself in CI)
+_RESERVED_CONST = dict(zip(
+    catalog.RESERVED_INPUT_COLUMNS,
+    ("serving_engine.BUDGET_INPUT (telemetry-side: "
+     "catalog.BUDGET_COLUMN)",
+     "serving_engine.DEADLINE_INPUT (telemetry-side: "
+     "catalog.DEADLINE_COLUMN)",
+     "serving_engine.TENANT_INPUT (telemetry-side: "
+     "catalog.TENANT_COLUMN)",
+     "serving_engine.TRACE_INPUT (telemetry-side: "
+     "catalog.TRACE_COLUMN)"),
+))
+_METRIC_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def _rule_tfos004(mod, findings):
+    for node in ast.walk(mod.tree):
+        # metric names at factory call sites must be catalog rows
+        if (
+            isinstance(node, ast.Call)
+            and (
+                (isinstance(node.func, ast.Attribute)
+                 and node.func.attr in _METRIC_FACTORIES)
+                or (isinstance(node.func, ast.Name)
+                    and node.func.id in _METRIC_FACTORIES)
+            )
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            name = node.args[0].value
+            if "." in name and not catalog.known(name):
+                findings.append(Finding(
+                    "TFOS004", mod.path, node.lineno, node.col_offset,
+                    "metric name %r is not in telemetry/catalog.py — "
+                    "it will never reach the docs, the SLO rules, or "
+                    "the drift check" % name,
+                    "add a row to telemetry.catalog.METRICS (the doc "
+                    "table regenerates from it)",
+                ))
+        # reserved request-column names spelled raw in key-ish spots
+        for lit, ctx in _reserved_literals(node):
+            findings.append(Finding(
+                "TFOS004", mod.path, lit.lineno, lit.col_offset,
+                "raw reserved-column literal %r (%s) — the contract "
+                "constant %s exists"
+                % (lit.value, ctx, _RESERVED_CONST[lit.value]),
+                "import the constant; a renamed contract then "
+                "refactors instead of silently forking",
+            ))
+
+
+def _is_reserved_const(node):
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value in _RESERVED
+    )
+
+
+def _reserved_literals(node):
+    """Yield (Constant, context) for reserved names used as keys —
+    dict-literal keys, subscript keys, ``.get()`` keys, and
+    ``==``/``in`` comparisons.  Value positions (docstrings, the
+    defining assignments, message strings) never match."""
+    if isinstance(node, ast.Dict):
+        for k in node.keys:
+            if _is_reserved_const(k):
+                yield k, "dict key"
+    elif isinstance(node, ast.Subscript):
+        sl = node.slice
+        if _is_reserved_const(sl):
+            yield sl, "subscript key"
+    elif isinstance(node, ast.Compare):
+        for op, cmp in zip(node.ops, node.comparators):
+            if isinstance(op, (ast.Eq, ast.NotEq, ast.In, ast.NotIn)):
+                if _is_reserved_const(cmp):
+                    yield cmp, "comparison"
+        if isinstance(node.ops[0], (ast.Eq, ast.NotEq, ast.In, ast.NotIn)):
+            if _is_reserved_const(node.left):
+                yield node.left, "comparison"
+    elif (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr in ("get", "pop", "setdefault")
+        and node.args
+        and _is_reserved_const(node.args[0])
+    ):
+        yield node.args[0], ".%s() key" % node.func.attr
+
+
+# ---------------------------------------------------------------------------
+# TFOS005 — thread hygiene
+
+
+def _rule_tfos005(mod, findings):
+    join_targets = set()
+    for node in ast.walk(mod.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+        ):
+            join_targets.add(_unparse(node.func.value))
+            if isinstance(node.func.value, ast.Attribute):
+                join_targets.add(node.func.value.attr)
+            elif isinstance(node.func.value, ast.Name):
+                join_targets.add(node.func.value.id)
+    for node in ast.walk(mod.tree):
+        if _is_thread_ctor(node):
+            _tfos005_thread(mod, node, join_targets, findings)
+        if isinstance(node, ast.ExceptHandler):
+            _tfos005_handler(mod, node, findings)
+
+
+def _is_thread_ctor(node):
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    return (isinstance(f, ast.Name) and f.id == "Thread") or (
+        isinstance(f, ast.Attribute) and f.attr == "Thread"
+    )
+
+
+def _tfos005_thread(mod, call, join_targets, findings):
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            if not (
+                isinstance(kw.value, ast.Constant) and kw.value.value is False
+            ):
+                return  # daemon=True (or dynamic): fine
+    # non-daemon: require a visible join/drain path on the bind target
+    stmt = mod.enclosing_statement(call)
+    names = set()
+    if isinstance(stmt, ast.Assign):
+        for tgt in stmt.targets:
+            names.add(_unparse(tgt))
+            if isinstance(tgt, ast.Attribute):
+                names.add(tgt.attr)
+            elif isinstance(tgt, ast.Name):
+                names.add(tgt.id)
+    if names & join_targets:
+        return
+    findings.append(Finding(
+        "TFOS005", mod.path, call.lineno, call.col_offset,
+        "non-daemon Thread with no join() in this module — it can "
+        "hold the interpreter open past shutdown",
+        "pass daemon=True for background loops, or keep a handle and "
+        "join it on the drain path",
+    ))
+
+
+def _tfos005_handler(mod, handler, findings):
+    in_loop = any(
+        isinstance(a, (ast.For, ast.While)) for a in mod.ancestors(handler)
+    )
+    bare = handler.type is None
+    swallow = (
+        len(handler.body) == 1
+        and isinstance(handler.body[0], ast.Pass)
+        and isinstance(handler.type, ast.Name)
+        and handler.type.id in ("Exception", "BaseException")
+    )
+    if bare and in_loop:
+        findings.append(Finding(
+            "TFOS005", mod.path, handler.lineno, handler.col_offset,
+            "bare `except:` inside a loop — the loop eats "
+            "KeyboardInterrupt/SystemExit and its own death",
+            "catch Exception (or narrower) and record the failure "
+            "before continuing",
+        ))
+    elif bare:
+        findings.append(Finding(
+            "TFOS005", mod.path, handler.lineno, handler.col_offset,
+            "bare `except:` also swallows "
+            "KeyboardInterrupt/SystemExit",
+            "catch Exception (or narrower)",
+        ))
+    elif swallow and in_loop:
+        findings.append(Finding(
+            "TFOS005", mod.path, handler.lineno, handler.col_offset,
+            "`except %s: pass` inside a loop silently discards every "
+            "failure the loop ever hits" % handler.type.id,
+            "log/record the exception, or narrow the type",
+        ))
+
+
+# ---------------------------------------------------------------------------
+# TFOS006 — lock discipline
+
+
+def _acquire_receiver(stmt):
+    """The `.acquire()` receiver source for an acquire statement."""
+    call = None
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+    elif isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+        call = stmt.value
+    if (
+        call is not None
+        and isinstance(call.func, ast.Attribute)
+        and call.func.attr == "acquire"
+    ):
+        # only lock-SHAPED signatures: acquire() / acquire(blocking[,
+        # timeout]) — domain APIs that happen to be called `acquire`
+        # (the prefix cache's lease acquire takes a token list) pass
+        if len(call.args) > 2 or any(
+            kw.arg not in ("blocking", "timeout") for kw in call.keywords
+        ):
+            return None
+        if any(
+            not isinstance(a, ast.Constant)
+            or not isinstance(a.value, (bool, int, float))
+            for a in call.args
+        ):
+            return None
+        # non-blocking trylocks manage their own failure path
+        for kw in call.keywords:
+            if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+                if kw.value.value is False:
+                    return None
+        if call.args and isinstance(call.args[0], ast.Constant):
+            if call.args[0].value is False:
+                return None
+        return _unparse(call.func.value)
+    return None
+
+
+def _releases(nodes, receiver):
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "release"
+                and _unparse(node.func.value) == receiver
+            ):
+                return True
+    return False
+
+
+def _rule_tfos006(mod, findings):
+    for node in ast.walk(mod.tree):
+        body = getattr(node, "body", None)
+        if not isinstance(body, list):
+            continue
+        for seq_name in ("body", "orelse", "finalbody"):
+            seq = getattr(node, seq_name, None)
+            if isinstance(seq, list):
+                _tfos006_sequence(mod, seq, findings)
+
+
+def _tfos006_sequence(mod, seq, findings):
+    for i, stmt in enumerate(seq):
+        receiver = _acquire_receiver(stmt)
+        if receiver is None:
+            continue
+        # pattern A: acquire as the first statement(s) of a try whose
+        # finally releases (the enclosing Try's body IS this seq)
+        guarded = False
+        for anc in mod.ancestors(stmt):
+            if isinstance(anc, ast.Try) and stmt in anc.body:
+                if _releases(anc.finalbody, receiver):
+                    guarded = True
+                break
+        # pattern B: `x.acquire()` immediately followed by
+        # `try: ... finally: x.release()`
+        if not guarded and i + 1 < len(seq):
+            nxt = seq[i + 1]
+            if isinstance(nxt, ast.Try) and _releases(
+                nxt.finalbody, receiver
+            ):
+                guarded = True
+        if not guarded:
+            findings.append(Finding(
+                "TFOS006", mod.path, stmt.lineno, stmt.col_offset,
+                "`%s.acquire()` outside with/try-finally — any "
+                "exception before the release leaks the lock and "
+                "wedges every other thread" % receiver,
+                "use `with %s:` or follow the acquire with "
+                "`try: ... finally: %s.release()`"
+                % (receiver, receiver),
+            ))
+
+
+# ---------------------------------------------------------------------------
+# engine
+
+
+_RULE_FNS = (
+    _rule_tfos001, _rule_tfos002, _rule_tfos003,
+    _rule_tfos004, _rule_tfos005, _rule_tfos006,
+)
+
+
+def lint_source(src, path="<string>", rules=None):
+    """Lint one source string.  Returns (findings, suppressed) —
+    both lists of :class:`Finding`, suppression pragmas already
+    applied."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(
+            "TFOS000", path, e.lineno or 0, 0,
+            "syntax error: %s" % e.msg, "",
+        )], []
+    mod = _Module(path, src, tree)
+    raw = []
+    for fn in _RULE_FNS:
+        rule_id = fn.__name__[-7:].upper()
+        if rules and rule_id.upper() not in {r.upper() for r in rules}:
+            continue
+        fn(mod, raw)
+    sup = parse_suppressions(src)
+    findings, suppressed = [], []
+    for f in sorted(raw, key=lambda f: (f.line, f.col, f.rule)):
+        if f.rule in sup.get(f.line, {}):
+            suppressed.append(f)
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def iter_py_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in ("__pycache__", ".git", "node_modules")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ))
+
+
+def _relpath(path):
+    try:
+        rel = os.path.relpath(os.path.abspath(path), _repo_root())
+    except ValueError:
+        return path
+    return rel if not rel.startswith("..") else path
+
+
+def lint_paths(paths, rules=None):
+    """Lint files/trees.  Returns (findings, suppressed) with paths
+    repo-root-relative so fingerprints are stable across checkouts."""
+    findings, suppressed = [], []
+    for fp in iter_py_files(paths):
+        with open(fp, encoding="utf-8") as f:
+            src = f.read()
+        got, sup = lint_source(src, path=_relpath(fp), rules=rules)
+        findings.extend(got)
+        suppressed.extend(sup)
+    return findings, suppressed
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "baseline.json"
+)
+
+
+def fingerprint(finding, line_text, occurrence=0):
+    """Line-number-independent identity: rule + path + the stripped
+    source text + an occurrence index for identical lines.  Moving
+    code keeps its baseline entry; editing the flagged line retires
+    it."""
+    h = hashlib.sha1()
+    h.update(("%s|%s|%s|%d" % (
+        finding.rule, finding.path.replace(os.sep, "/"),
+        line_text.strip(), occurrence,
+    )).encode("utf-8"))
+    return h.hexdigest()[:16]
+
+
+def fingerprints(findings, sources=None):
+    """``{fingerprint: finding}`` with occurrence disambiguation.
+    ``sources`` optionally maps a finding path to its source text
+    (for in-memory fixtures); otherwise the file is read from disk
+    (relative paths resolve against the repo root)."""
+    counts = collections.Counter()
+    out = {}
+    src_cache = {
+        p: s.splitlines() for p, s in (sources or {}).items()
+    }
+    for f in findings:
+        if f.path not in src_cache:
+            for cand in (f.path, os.path.join(_repo_root(), f.path)):
+                try:
+                    with open(cand, encoding="utf-8") as fh:
+                        src_cache[f.path] = fh.read().splitlines()
+                    break
+                except OSError:
+                    continue
+            else:
+                src_cache[f.path] = []
+        lines = src_cache[f.path]
+        text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        key = (f.rule, f.path, text.strip())
+        fp = fingerprint(f, text, counts[key])
+        counts[key] += 1
+        out[fp] = f
+    return out
+
+
+def load_baseline(path):
+    if not os.path.exists(path):
+        return set()
+    with open(path) as f:
+        data = json.load(f)
+    return set(data.get("findings", ()))
+
+
+def write_baseline(path, fps):
+    with open(path, "w") as f:
+        json.dump(
+            {"version": 1,
+             "tool": "tfoslint",
+             "note": "accepted legacy findings — CI fails only on "
+                     "fingerprints NOT in this list; regenerate with "
+                     "--write-baseline",
+             "findings": sorted(fps)},
+            f, indent=1,
+        )
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def _format(f, new=False):
+    tag = " [new]" if new else ""
+    out = "%s:%d:%d: %s%s %s" % (
+        f.path, f.line, f.col, f.rule, tag, f.message
+    )
+    if f.hint:
+        out += "\n    hint: %s" % f.hint
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tensorflowonspark_tpu.analysis.lint",
+        description="tfoslint: repo-specific invariant rules "
+                    "(TFOS001..TFOS006)",
+    )
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__)))],
+                    help="files or trees (default: the package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, baseline ignored")
+    ap.add_argument("--rules", help="comma-separated rule subset")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--list", action="store_true",
+                    help="also print baselined findings")
+    args = ap.parse_args(argv)
+
+    rules = args.rules.split(",") if args.rules else None
+    findings, suppressed = lint_paths(args.paths, rules=rules)
+    fps = fingerprints(findings)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, fps.keys())
+        print("tfoslint: baseline written: %d finding(s) -> %s"
+              % (len(fps), args.baseline))
+        return 0
+
+    base = set() if args.no_baseline else load_baseline(args.baseline)
+    new = {fp: f for fp, f in fps.items() if fp not in base}
+    old = {fp: f for fp, f in fps.items() if fp in base}
+    stale = base - set(fps)
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f._asdict() for f in new.values()],
+            "baselined": [f._asdict() for f in old.values()],
+            "suppressed": len(suppressed),
+            "stale_baseline": len(stale),
+        }, indent=1))
+        return 1 if new else 0
+
+    for f in sorted(new.values(), key=lambda f: (f.path, f.line)):
+        print(_format(f, new=not args.no_baseline))
+    if args.list:
+        for f in sorted(old.values(), key=lambda f: (f.path, f.line)):
+            print(_format(f))
+    counts = collections.Counter(f.rule for f in new.values())
+    summary = ", ".join(
+        "%s x%d" % (r, n) for r, n in sorted(counts.items())
+    ) or "none"
+    print("tfoslint: %d new finding(s) [%s], %d baselined, "
+          "%d suppressed-with-reason, %d stale baseline entr%s"
+          % (len(new), summary, len(old), len(suppressed),
+             len(stale), "y" if len(stale) == 1 else "ies"))
+    if stale:
+        print("tfoslint: stale entries retire on the next "
+              "--write-baseline")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

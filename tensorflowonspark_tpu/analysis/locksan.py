@@ -1,0 +1,401 @@
+"""locksan — a runtime lock-order sanitizer (ISSUE 15).
+
+~10 thread families cross this stack's locks (the serving scheduler,
+the decode watchdog, ``_GradDrain``, ``DcnLink``,
+``CheckpointWatcher``, fleet replica workers, the health scrape loop,
+the usage-ledger settle path, supervisor heartbeaters, the journal
+bus) and nothing enforces that they agree on an acquisition order.  A
+lock-order inversion deadlocks only under the exact interleaving the
+chaos lanes try to provoke — this module makes the *order* itself the
+observable, lockdep-style:
+
+- :func:`install` monkeypatches ``threading.Lock``/``threading.RLock``
+  so every lock created afterwards is an instrumented wrapper that
+  records, per thread, the stack of locks currently held.
+- Acquiring ``B`` while holding ``A`` adds the edge ``A → B`` to a
+  global acquisition graph, keyed by the locks' **creation sites** (a
+  lockdep "lock class": every instance born at one line is the same
+  class, so per-request/per-metric instances don't explode the
+  graph).
+- A new edge that closes a cycle produces a typed
+  ``potential_deadlock`` report naming every lock class on the cycle
+  and BOTH acquisition stacks of each edge — the inversion is
+  reported the first time the *order* is observed, no deadlock
+  needed.
+
+Arming::
+
+    TFOS_LOCKSAN=1 python -m pytest tests/ -m chaos ...
+
+``tests/conftest.py`` installs the sanitizer when the env var is set
+and fails the session if any cycle was reported (the chaos CI lanes
+run this way).  In code::
+
+    from tensorflowonspark_tpu.analysis import locksan
+    locksan.install()
+    ...
+    assert not locksan.reports()
+
+Notes and limits:
+
+- Same-class edges (two instances born at one site, e.g. the metric
+  registry's per-metric locks) are ignored — ordering within one
+  homogeneous family needs instance identity that a class-keyed
+  graph deliberately gives up.
+- Non-blocking ``acquire(blocking=False)`` trylocks never deadlock a
+  correct caller and are not recorded as edges (the hold itself still
+  is, so a blocking acquire UNDER a trylock hold still reports).
+- ``threading.Condition`` support: the wrapper exposes
+  ``_release_save``/``_acquire_restore``/``_is_owned`` so a Condition
+  wrapping an instrumented RLock keeps recursive holds intact.
+"""
+
+import os
+import sys
+import threading
+import traceback
+import _thread
+
+__all__ = [
+    "install", "uninstall", "installed", "enabled",
+    "Lock", "RLock", "reports", "reset", "check_clean",
+    "LockSanitizer", "ENV_VAR",
+]
+
+ENV_VAR = "TFOS_LOCKSAN"
+
+#: frames of acquisition stack kept per edge endpoint
+STACK_DEPTH = 8
+
+
+def enabled(env=None):
+    """True when the env var arms the sanitizer."""
+    return (env if env is not None else os.environ).get(ENV_VAR) == "1"
+
+
+def _site(skip):
+    """``file:line`` of the caller, skipping sanitizer frames."""
+    f = sys._getframe(skip)
+    while f is not None and f.f_globals.get("__name__") == __name__:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return "%s:%d" % (f.f_code.co_filename, f.f_lineno)
+
+
+def _stack(skip, depth=STACK_DEPTH):
+    frames = traceback.extract_stack(sys._getframe(skip))
+    frames = [
+        fr for fr in frames
+        if os.path.basename(fr.filename) != "locksan.py"
+    ][-depth:]
+    return ["%s:%d in %s" % (fr.filename, fr.lineno, fr.name)
+            for fr in frames]
+
+
+class LockSanitizer:
+    """The acquisition-graph recorder.  One global instance backs the
+    module-level API; tests may build private ones."""
+
+    def __init__(self):
+        # the sanitizer's own lock is a RAW _thread lock so
+        # instrumentation can never recurse into itself
+        self._mu = _thread.allocate_lock()
+        self._tls = threading.local()
+        # lock-class key -> {succ-key: edge-info}
+        self._edges = {}
+        self._names = {}
+        self._reports = []
+        self._seen_cycles = set()
+        self.locks_created = 0
+
+    # -- per-thread held stack ---------------------------------------------
+
+    def _held(self):
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    # -- graph -------------------------------------------------------------
+
+    def note_created(self, key, name):
+        with self._mu:
+            self.locks_created += 1
+            self._names.setdefault(key, name)
+
+    def note_acquired(self, lock, blocking, stack):
+        """Called AFTER a successful acquire.  Records edges from
+        every currently-held lock class, runs cycle detection, then
+        pushes the hold.  Reports are emitted OUTSIDE ``_mu`` — the
+        emit path (telemetry counters) acquires instrumented locks
+        and must be able to re-enter the recorder."""
+        held = self._held()
+        fresh = []
+        if blocking:
+            with self._mu:
+                for prev, prev_stack in held:
+                    if prev.key == lock.key:
+                        continue  # same lock class: see module notes
+                    edges = self._edges.setdefault(prev.key, {})
+                    if lock.key not in edges:
+                        edges[lock.key] = {
+                            "from": prev.name, "to": lock.name,
+                            "from_site": prev.site, "to_site": lock.site,
+                            "thread": threading.current_thread().name,
+                            "held_stack": list(prev_stack),
+                            "acquire_stack": list(stack),
+                        }
+                        report = self._check_cycle(lock.key)
+                        if report is not None:
+                            self._reports.append(report)
+                            fresh.append(report)
+        held.append((lock, stack))
+        for report in fresh:
+            self._emit(report)
+
+    def note_released(self, lock):
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][0] is lock:
+                del held[i]
+                return
+
+    def _check_cycle(self, start):
+        """DFS from ``start``; a path back to ``start`` is a cycle.
+        Called with ``_mu`` held, right after a new edge lands."""
+        path, seen = [], set()
+
+        def dfs(node):
+            if node in seen:
+                return False
+            seen.add(node)
+            path.append(node)
+            for succ in self._edges.get(node, ()):
+                if succ == start:
+                    return True
+                if dfs(succ):
+                    return True
+            path.pop()
+            return False
+
+        if not dfs(start):
+            return None
+        cycle = path[:]  # start .. last-before-start
+        key = frozenset(cycle)
+        if key in self._seen_cycles:
+            return None
+        self._seen_cycles.add(key)
+        edges = []
+        ring = cycle + [cycle[0]]
+        for a, b in zip(ring, ring[1:]):
+            info = self._edges.get(a, {}).get(b)
+            if info:
+                edges.append(info)
+        return {
+            "kind": "potential_deadlock",
+            "cycle": [self._names.get(k, k) for k in cycle],
+            "sites": list(cycle),
+            "edges": edges,
+            "thread": threading.current_thread().name,
+        }
+
+    def _emit(self, report):
+        # journal/tracer integration is best-effort: the sanitizer
+        # must keep working in processes that never import telemetry
+        try:
+            from tensorflowonspark_tpu import telemetry
+
+            telemetry.get_registry().counter("locksan.cycles").inc()
+            telemetry.get_tracer().mark(
+                "potential_deadlock", severity="page",
+                cycle=" -> ".join(report["cycle"]),
+                thread=report["thread"],
+            )
+        except Exception:
+            pass
+        sys.stderr.write(
+            "locksan: POTENTIAL DEADLOCK: %s\n"
+            % format_report(report)
+        )
+
+    # -- results -----------------------------------------------------------
+
+    def reports(self):
+        with self._mu:
+            return list(self._reports)
+
+    def reset(self):
+        with self._mu:
+            self._edges.clear()
+            self._reports[:] = []
+            self._seen_cycles.clear()
+
+    def check_clean(self):
+        """Raise AssertionError with every report when cycles were
+        observed (the chaos-lane gate)."""
+        reps = self.reports()
+        if reps:
+            raise AssertionError(
+                "locksan observed %d potential deadlock(s):\n%s"
+                % (len(reps),
+                   "\n".join(format_report(r) for r in reps))
+            )
+
+
+def format_report(report):
+    """One human-readable block per cycle: the lock ring plus each
+    edge's two acquisition sites and stacks."""
+    lines = ["lock-order cycle: %s -> (back to) %s"
+             % (" -> ".join(report["cycle"]), report["cycle"][0])]
+    for e in report["edges"]:
+        lines.append(
+            "  edge %s (created %s) -> %s (created %s) on thread %s"
+            % (e["from"], e["from_site"], e["to"], e["to_site"],
+               e["thread"])
+        )
+        lines.append("    holding-since:")
+        lines.extend("      " + fr for fr in e["held_stack"][-3:])
+        lines.append("    acquiring-at:")
+        lines.extend("      " + fr for fr in e["acquire_stack"][-3:])
+    return "\n".join(lines)
+
+
+_global = LockSanitizer()
+
+
+def reports():
+    return _global.reports()
+
+
+def reset():
+    _global.reset()
+
+
+def check_clean():
+    _global.check_clean()
+
+
+class _InstrumentedLock:
+    """Duck-compatible ``Lock``/``RLock`` wrapper.  The inner lock
+    does the real blocking; the wrapper reports transitions to the
+    sanitizer."""
+
+    __slots__ = ("_inner", "key", "name", "site", "_san")
+
+    def __init__(self, inner, san, name=None):
+        self._inner = inner
+        self._san = san
+        self.site = _site(2)
+        # the creation site IS the lock class (lockdep-style); an
+        # explicit name refines the class so two named locks born on
+        # one line stay distinct
+        self.key = "%s#%s" % (self.site, name) if name else self.site
+        self.name = name or "lock@%s" % os.path.basename(self.site)
+        san.note_created(self.key, self.name)
+
+    def acquire(self, blocking=True, timeout=-1):
+        # tfoslint: disable=TFOS006(this IS the lock implementation the rule protects; callers hold the discipline)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._san.note_acquired(self, blocking, _stack(2))
+        return ok
+
+    def release(self):
+        self._inner.release()
+        self._san.note_released(self)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        # tfoslint: disable=TFOS006(the with-protocol half itself; __exit__ is the paired release)
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<locksan %r wrapping %r>" % (self.name, self._inner)
+
+    # Condition-protocol passthrough (threading.Condition duck-calls
+    # these when present so recursive RLock holds survive wait()):
+    def _release_save(self):
+        state = self._inner._release_save() if hasattr(
+            self._inner, "_release_save"
+        ) else (self._inner.release() or None)
+        self._san.note_released(self)
+        return state
+
+    def _acquire_restore(self, state):
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            # tfoslint: disable=TFOS006(Condition-protocol restore: the wait() caller owns the discipline)
+            self._inner.acquire()
+        self._san.note_acquired(self, True, _stack(2))
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _at_fork_reinit(self):
+        self._inner._at_fork_reinit()
+
+
+_orig = {}
+
+
+def Lock(name=None, _san=None):
+    """An instrumented non-reentrant lock (direct factory — works
+    whether or not :func:`install` patched the module)."""
+    real = _orig.get("Lock") or _thread.allocate_lock
+    return _InstrumentedLock(real(), _san or _global, name=name)
+
+
+def RLock(name=None, _san=None):
+    """An instrumented reentrant lock."""
+    real = _orig.get("RLock") or _thread.RLock
+    return _InstrumentedLock(real(), _san or _global, name=name)
+
+
+def installed():
+    return bool(_orig)
+
+
+def install():
+    """Patch ``threading.Lock``/``threading.RLock`` so every lock
+    created from here on is instrumented.  Idempotent; pair with
+    :func:`uninstall`.  Locks created BEFORE install stay raw — the
+    graph only sees the post-install world, which is what the test
+    session arms at import time."""
+    if _orig:
+        return False
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    threading.Lock = Lock
+    threading.RLock = RLock
+    return True
+
+
+def uninstall():
+    """Restore the real factories (instrumented locks already handed
+    out keep working — they wrap real primitives)."""
+    if not _orig:
+        return False
+    threading.Lock = _orig.pop("Lock")
+    threading.RLock = _orig.pop("RLock")
+    return True
+
+
+def install_if_enabled(env=None):
+    """The conftest hook: arm only when ``TFOS_LOCKSAN=1``."""
+    if enabled(env):
+        return install()
+    return False

@@ -754,6 +754,7 @@ class TPUCluster(object):
                     m = self._connect(by_id[eid])
                     if str(m.get("state")._getvalue()) == "stopped":
                         pending.discard(eid)
+                # tfoslint: disable=TFOS005(liveness probe: a node mid-restart answers on a later pass; the deadline below bounds the loop)
                 except Exception:  # noqa: BLE001 - node may be mid-restart
                     pass
             if not pending:
@@ -854,6 +855,7 @@ class TPUCluster(object):
                         ),
                         "pending": len(m.ledger("pending")._getvalue()),
                     }
+                # tfoslint: disable=TFOS005(metrics snapshot stays partial for a node mid-restart; nothing to recover here)
                 except Exception:  # noqa: BLE001 - node mid-restart /
                     pass  # gone: its snapshot simply lacks the ledger
         view = aggregate.fleet_view(per)

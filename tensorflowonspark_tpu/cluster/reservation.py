@@ -1050,6 +1050,7 @@ class Heartbeater(object):
                 try:
                     if self._client is not None:
                         self._client.close()
+                # tfoslint: disable=TFOS005(closing a socket the failed beat already killed; the retry path reopens it)
                 except Exception:  # noqa: BLE001 - socket already gone
                     pass
                 self._client = None

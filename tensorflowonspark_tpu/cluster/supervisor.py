@@ -336,6 +336,7 @@ class Supervisor(object):
                     compute_state = self.mgr.get(
                         "compute_state"
                     )._getvalue()
+                # tfoslint: disable=TFOS005(manager teardown race; compute_state=None takes the abnormal-death path below)
                 except Exception:  # noqa: BLE001 - manager going down
                     pass
                 if compute_state == "finished":

@@ -14,7 +14,6 @@ plane feeds anyway); the Spark adapter in
 """
 
 import logging
-import os
 import re
 
 import numpy as np

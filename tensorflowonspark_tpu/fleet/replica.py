@@ -267,8 +267,14 @@ class Replica(object):
             # decode wall time (ISSUE 14 acceptance)
             try:
                 eng._ledger_settle(req, close=False)
-            except Exception:  # noqa: BLE001 - accounting must never
-                pass  # break wreckage collection
+            except Exception as e:  # noqa: BLE001
+                # accounting must never break wreckage collection —
+                # but a broken ledger should not stay invisible either
+                # (surfaced by the ISSUE 15 tfoslint sweep)
+                logger.debug(
+                    "wreckage ledger flush failed for %r: %s",
+                    req.get("rid"), e,
+                )
         while True:
             try:
                 item = self._q.get_nowait()

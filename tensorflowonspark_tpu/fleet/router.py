@@ -573,7 +573,7 @@ class FleetRouter(object):
             "committed": [], "excluded": set(), "replica": None,
             "fingerprint": fp, "submit": self._clock(),
             "sent_at": None, "redispatches": 0,
-            "rid": rid, "tenant": tenant,
+            "rid": rid, serving_engine.TENANT_INPUT: tenant,
         }
         # open the cost row at FLEET admission with the user-facing
         # prompt size: a later re-dispatch re-admits prompt+committed

@@ -2210,7 +2210,9 @@ class SlotDecoder:
             buf, off, acc, prop = pending
             toks = np.asarray(buf)
             valid = np.asarray(off)
+            # tfoslint: disable=TFOS002(resolve_chunk IS the one sanctioned sync point - see docstring; the watchdog wraps exactly this)
             self.spec_accepted += int(np.asarray(acc).sum())
+            # tfoslint: disable=TFOS002(same sanctioned sync point as the line above)
             self.spec_proposed += int(np.asarray(prop).sum())
             return toks, valid
         toks = np.asarray(pending)

@@ -18,8 +18,6 @@ Requires ``num_heads % axis_size == 0``; otherwise use
 :mod:`.ring_attention` (which has no head-count constraint).
 """
 
-import jax
-import jax.numpy as jnp
 from jax import lax
 
 from tensorflowonspark_tpu import compat

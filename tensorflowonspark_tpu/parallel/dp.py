@@ -329,6 +329,7 @@ class SyncTrainer(object):
         steps = 0
         if checkpointer is not None and checkpointer.latest_step() is not None:
             state = checkpointer.restore(state)
+            # tfoslint: disable=TFOS002(one-time checkpoint-resume sync BEFORE the hot loop starts)
             steps = int(jax.device_get(state.step))
             logger.info("resumed from checkpoint at step %d", steps)
         # fleet telemetry: the training-step trace (feed_wait → h2d →
@@ -521,4 +522,5 @@ def all_hosts_ready(local_flag):
     flags = multihost_utils.process_allgather(
         np.asarray([1 if local_flag else 0], dtype=np.uint8)
     )
+    # tfoslint: disable=TFOS002(the global-stop allgather IS a sync point by contract; microseconds against a step)
     return bool(np.all(flags))

@@ -418,6 +418,7 @@ class DcnLink(object):
         Returns the sequence assigned."""
         if self._next_seq is None:
             raise RuntimeError("DcnLink.attach() must run before submit()")
+        # tfoslint: disable=TFOS006(staleness-window semaphore: the DCN pusher thread releases it when the window lands - cross-thread handoff by design)
         self._slots.acquire()
         seq, self._next_seq = self._next_seq, self._next_seq + 1
         with self._lock:
@@ -430,6 +431,7 @@ class DcnLink(object):
         """Failover re-push: a predecessor's unacked window, sequence
         preserved — the server ledger dedups it if it actually
         landed."""
+        # tfoslint: disable=TFOS006(same staleness-window semaphore handoff as submit)
         self._slots.acquire()
         with self._lock:
             self._pending[seq] = (delta, base)

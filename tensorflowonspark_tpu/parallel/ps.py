@@ -1085,6 +1085,7 @@ class _GradDrain(object):
         the staleness window is full.  Raises any error a previous
         window hit (once)."""
         self._raise_pending()
+        # tfoslint: disable=TFOS006(staleness-window semaphore: the _GradDrain thread releases it after the round trip - cross-thread handoff by design)
         self._slots.acquire()
         self._q.put(device_grads)
 

@@ -72,6 +72,7 @@ import numpy as np
 
 from tensorflowonspark_tpu import telemetry
 from tensorflowonspark_tpu.prefix_cache import pages_for_tokens
+from tensorflowonspark_tpu.telemetry import catalog as _catalog
 
 logger = logging.getLogger(__name__)
 
@@ -152,6 +153,22 @@ TENANT_INPUT = "tenant"
 #: ledger").  Unmapped requests trace as ``req<N>`` exactly as
 #: before.
 TRACE_INPUT = "trace_id"
+
+#: THE consolidated reserved-input contract (ISSUE 15): every column
+#: name the serving surface claims for itself, in one tuple.  The
+#: tfoslint rule TFOS004 flags any of these spelled as a raw literal
+#: elsewhere; the import-light twin the telemetry layer reads is
+#: ``telemetry.catalog.RESERVED_INPUT_COLUMNS`` — the assert below
+#: keeps the two registries from ever drifting.
+RESERVED_INPUTS = (
+    BUDGET_INPUT, DEADLINE_INPUT, TENANT_INPUT, TRACE_INPUT,
+)
+
+assert RESERVED_INPUTS == _catalog.RESERVED_INPUT_COLUMNS, (
+    "serving_engine.RESERVED_INPUTS drifted from "
+    "telemetry.catalog.RESERVED_INPUT_COLUMNS: %r != %r"
+    % (RESERVED_INPUTS, _catalog.RESERVED_INPUT_COLUMNS)
+)
 
 #: admission policies (see module docstring)
 POLICIES = ("block", "reject", "degrade")
@@ -823,7 +840,7 @@ class ServingEngine(object):
         return {
             "idx": idx,
             "rid": rid if rid is not None else self._rid_of(row, idx),
-            "tenant": tenant,
+            TENANT_INPUT: tenant,
             "prompt": prompt.astype(np.int32, copy=False),
             "budget": budget,
             "eos_at": None,
@@ -848,7 +865,7 @@ class ServingEngine(object):
         attributed while the surviving replica continues the row
         (fleet/replica.py)."""
         self._ledger.settle(
-            req["rid"], tenant=req.get("tenant"),
+            req["rid"], tenant=req.get(TENANT_INPUT),
             tokens_in=len(req["prompt"]),
             wire_bytes=req.pop("wire_bytes_acc", 0),
             prefix_tokens_saved=req.pop("prefix_saved_acc", 0),

@@ -46,6 +46,7 @@ import re
 import threading
 
 from tensorflowonspark_tpu.telemetry import registry as _registry
+from tensorflowonspark_tpu.telemetry.catalog import TENANT_COLUMN
 
 #: Tenant assigned to requests that carry no ``"tenant"`` input.
 DEFAULT_TENANT = "default"
@@ -242,12 +243,12 @@ class UsageLedger(object):
         if not delta:
             return
         row[field] += delta
-        t = self._tenant_totals(row["tenant"])
+        t = self._tenant_totals(row[TENANT_COLUMN])
         t[field] += delta
-        self._mirror_inc(field, row["tenant"], delta)
+        self._mirror_inc(field, row[TENANT_COLUMN], delta)
         if field in ("tokens_in", "tokens_out"):
             # heavy-hitter sketch weighs tenants by token volume
-            self.sketch.add(row["tenant"], delta)
+            self.sketch.add(row[TENANT_COLUMN], delta)
 
     def _retag(self, row, tenant):
         """Name a row's tenant.  Only a row with NOTHING accrued yet
@@ -255,11 +256,11 @@ class UsageLedger(object):
         once usage has landed on a tenant it stays there — moving it
         would rewind the monotonic mirror counters, which the health
         plane would read as a process restart."""
-        if row["tenant"] == tenant:
+        if row[TENANT_COLUMN] == tenant:
             return
         if any(row[f] for f in FIELDS):
             return
-        row["tenant"] = tenant
+        row[TENANT_COLUMN] = tenant
 
     def _get_or_create(self, rid, fresh_if_closed=False):
         row = self._rows.get(rid)
@@ -411,7 +412,7 @@ class UsageLedger(object):
         """Newest-last per-request rows (optionally one tenant's)."""
         with self._lock:
             out = [dict(r) for r in self._rows.values()
-                   if tenant is None or r["tenant"] == tenant]
+                   if tenant is None or r[TENANT_COLUMN] == tenant]
         if limit is not None:
             out = out[-int(limit):]
         return out

@@ -610,7 +610,9 @@ def poison_row(kind, prompt_col="prompt", length=8, vocab=64, seed=0):
             0, vocab, (1 << 16,)
         ).astype(np.int32)}
     if kind == "bad_budget":
-        return {prompt_col: good, "max_new": "not-a-number"}
+        from tensorflowonspark_tpu.serving_engine import BUDGET_INPUT
+
+        return {prompt_col: good, BUDGET_INPUT: "not-a-number"}
     raise ValueError(
         "unknown poison kind {0!r}; pick one of {1}".format(
             kind, POISON_KINDS

@@ -32,6 +32,40 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# ISSUE 15: arm the runtime lock-order sanitizer when TFOS_LOCKSAN=1
+# (the chaos CI lanes run this way).  Installed at conftest import so
+# every lock the suite creates — serving scheduler, watchdog,
+# _GradDrain, DcnLink, CheckpointWatcher, replica workers, health
+# scrape, ledger — lands in the acquisition graph; the sessionfinish
+# hook below fails the run if any lock-order cycle was observed.
+from tensorflowonspark_tpu.analysis import locksan  # noqa: E402
+
+locksan.install_if_enabled()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not locksan.installed():
+        return
+    reps = locksan.reports()
+    if reps:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        lines = ["TFOS_LOCKSAN: %d potential deadlock(s) observed:"
+                 % len(reps)]
+        lines += [locksan.format_report(r) for r in reps]
+        text = "\n".join(lines)
+        if tr is not None:
+            tr.write_line(text, red=True)
+        else:
+            print(text)
+        session.exitstatus = 3
+    else:
+        tr = session.config.pluginmanager.get_plugin("terminalreporter")
+        if tr is not None:
+            tr.write_line(
+                "TFOS_LOCKSAN: lock-order clean (%d locks instrumented, "
+                "0 cycles)" % locksan._global.locks_created
+            )
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -1,0 +1,860 @@
+"""ISSUE 15: the invariant analysis plane.
+
+Three surfaces under test:
+
+- **tfoslint** (`analysis/lint.py`): every TFOS00x rule fires exactly
+  where its bad fixture says and stays quiet on the good twin;
+  suppressions need a reason; the baseline diff reports only NEW
+  findings; the real package lints clean against the checked-in
+  baseline (the acceptance command).
+- **locksan** (`analysis/locksan.py`): acquisition-order cycles are
+  reported as typed ``potential_deadlock`` records with both sites;
+  consistent order, reentrant RLocks, and trylocks stay clean;
+  ``install()`` really patches ``threading.Lock``/``RLock``.
+  Deliberate-cycle tests use PRIVATE sanitizer instances so an armed
+  session (``TFOS_LOCKSAN=1``) never sees them in the global gate.
+- **contract registries**: ``serving_engine.RESERVED_INPUTS`` ==
+  ``telemetry.catalog.RESERVED_INPUT_COLUMNS``; the docs metric table
+  matches the catalog byte-for-byte (drift test); every literal
+  metric name in the package is catalog-known.
+"""
+
+import json
+import os
+import textwrap
+import threading
+
+import pytest
+
+from tensorflowonspark_tpu.analysis import lint, locksan
+from tensorflowonspark_tpu.telemetry import catalog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "tensorflowonspark_tpu")
+
+
+def findings_of(src, rule=None):
+    got, _sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+    if rule:
+        got = [f for f in got if f.rule == rule]
+    return got
+
+
+def rules_of(src):
+    return sorted({f.rule for f in findings_of(src)})
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestTFOS001UseAfterDonate:
+    BAD = """
+        import jax
+
+        step_fn = jax.jit(step, donate_argnums=(0,))
+
+        def run(state, batch):
+            out = step_fn(state, batch)
+            norm = state.norm()  # read of a dead buffer
+            return out, norm
+    """
+
+    def test_fires(self):
+        got = findings_of(self.BAD, "TFOS001")
+        assert len(got) == 1
+        assert got[0].line == 8
+        assert "donated" in got[0].message
+        assert "state" in got[0].message
+
+    def test_rebind_from_result_is_clean(self):
+        src = """
+            import jax
+
+            step_fn = jax.jit(step, donate_argnums=(0,))
+
+            def run(state, batches):
+                for b in batches:
+                    state = step_fn(state, b)
+                return state
+        """
+        assert findings_of(src, "TFOS001") == []
+
+    def test_rebind_then_use_is_clean(self):
+        src = """
+            import jax
+
+            f = jax.jit(g, donate_argnums=(0,))
+
+            def run(buf):
+                f(buf)
+                buf = fresh()
+                return buf.sum()
+        """
+        assert findings_of(src, "TFOS001") == []
+
+    def test_attribute_bound_jit(self):
+        src = """
+            import jax
+
+            class Decoder:
+                def __init__(self):
+                    self._chunk = jax.jit(impl, donate_argnums=(0,))
+
+                def step(self, cache, keys):
+                    toks = self._chunk(cache, keys)
+                    return cache[0], toks  # cache was donated
+        """
+        got = findings_of(src, "TFOS001")
+        assert len(got) == 1 and "cache" in got[0].message
+
+    def test_donate_argnames(self):
+        src = """
+            import jax
+
+            f = jax.jit(g, donate_argnames=("state",))
+
+            def run(s):
+                out = f(1, state=s)
+                return s.mean()
+        """
+        got = findings_of(src, "TFOS001")
+        assert len(got) == 1 and "'s'" in got[0].message
+
+
+class TestTFOS002HostSync:
+    def test_item_in_hot_root(self):
+        src = """
+            def step_chunk(self, toks):
+                return toks[0].item()
+        """
+        got = findings_of(src, "TFOS002")
+        assert len(got) == 1 and ".item()" in got[0].message
+
+    def test_reachable_helper_flagged_with_root_named(self):
+        src = """
+            def dispatch_chunk(self):
+                return self._refill()
+
+            def _refill(self):
+                import jax.numpy as jnp
+                mask = jnp.ones((4,))
+                return bool(mask)
+        """
+        got = findings_of(src, "TFOS002")
+        assert len(got) == 1
+        assert "dispatch_chunk" in got[0].message
+        assert "_refill" in got[0].message
+
+    def test_unreachable_function_not_flagged(self):
+        src = """
+            def debug_dump(x):
+                return x.item()
+        """
+        assert findings_of(src, "TFOS002") == []
+
+    def test_asarray_on_device_value(self):
+        src = """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def train_on_feed(self, feed):
+                loss = jnp.mean(self.step())
+                return np.asarray(loss)
+        """
+        got = findings_of(src, "TFOS002")
+        assert len(got) == 1 and "np.asarray" in got[0].message
+
+    def test_asarray_on_host_value_clean(self):
+        src = """
+            import numpy as np
+
+            def train_on_feed(self, rows):
+                batch = np.asarray(rows)  # host list -> fine
+                return batch
+        """
+        assert findings_of(src, "TFOS002") == []
+
+    def test_int_on_jit_result(self):
+        src = """
+            import jax.numpy as jnp
+
+            def step_chunk(self):
+                acc = jnp.sum(self.counters)
+                return int(acc)
+        """
+        got = findings_of(src, "TFOS002")
+        assert len(got) == 1 and "int(...)" in got[0].message
+
+
+class TestTFOS003Recompile:
+    def test_len_in_static_argnums(self):
+        src = """
+            import jax
+
+            pad = jax.jit(impl, static_argnums=(1,))
+
+            def admit(self, prompt):
+                return pad(prompt, len(prompt))
+        """
+        got = findings_of(src, "TFOS003")
+        assert len(got) == 1 and "len(prompt)" in got[0].message
+
+    def test_static_argnames(self):
+        src = """
+            import jax
+
+            f = jax.jit(impl, static_argnames=("width",))
+
+            def run(self, xs):
+                return f(xs, width=len(xs) + 1)
+        """
+        got = findings_of(src, "TFOS003")
+        assert len(got) == 1
+
+    def test_constant_and_name_static_ok(self):
+        src = """
+            import jax
+
+            f = jax.jit(impl, static_argnums=(1, 2))
+
+            def run(self, xs, bucket):
+                return f(xs, 128, bucket)
+        """
+        assert findings_of(src, "TFOS003") == []
+
+    def test_fstring_cache_key(self):
+        src = """
+            def compile_for(self, prompt):
+                self._jits[f"p{len(prompt)}"] = build(prompt)
+        """
+        got = findings_of(src, "TFOS003")
+        assert len(got) == 1 and "cache key" in got[0].message
+
+    def test_len_in_cache_key_tuple(self):
+        src = """
+            def admit(self, prompt):
+                self.program_cache[(self.width, len(prompt))] = 1
+        """
+        got = findings_of(src, "TFOS003")
+        assert len(got) == 1
+
+    def test_bucketed_cache_key_ok(self):
+        src = """
+            def admit(self, prompt, bucket):
+                self.program_cache[(self.width, bucket)] = 1
+        """
+        assert findings_of(src, "TFOS003") == []
+
+
+class TestTFOS004Contracts:
+    def test_reserved_dict_key(self):
+        src = """
+            def poison(col, good):
+                return {col: good, "max_new": "nan"}
+        """
+        got = findings_of(src, "TFOS004")
+        assert len(got) == 1
+        assert "BUDGET_INPUT" in got[0].message
+
+    def test_reserved_subscript_get_compare(self):
+        src = """
+            def f(row):
+                a = row["deadline_sec"]
+                b = row.get("tenant")
+                c = "trace_id" in row
+                return a, b, c
+        """
+        got = findings_of(src, "TFOS004")
+        assert len(got) == 3
+        assert {g.line for g in got} == {3, 4, 5}
+
+    def test_value_positions_clean(self):
+        src = '''
+            def f():
+                """The reserved "max_new" input is documented here."""
+                msg = "pass max_new to bound the generation"
+                BUDGET_INPUT = "max_new"  # the defining assignment
+                return msg, BUDGET_INPUT
+        '''
+        assert findings_of(src, "TFOS004") == []
+
+    def test_unknown_metric_name(self):
+        src = """
+            def init(reg):
+                return reg.counter("myapp.requests_totl")
+        """
+        got = findings_of(src, "TFOS004")
+        assert len(got) == 1
+        assert "catalog" in got[0].message
+
+    def test_known_and_dynamic_metric_names_clean(self):
+        src = """
+            def init(reg):
+                a = reg.counter("serving.admitted")
+                b = reg.histogram("train.step_sec")
+                c = reg.counter("usage.tokens_out.tenant-7")
+                return a, b, c
+        """
+        assert findings_of(src, "TFOS004") == []
+
+    def test_undotted_strings_ignored(self):
+        src = """
+            def f(reg):
+                return reg.counter("plain")  # not a metric namespace
+        """
+        assert findings_of(src, "TFOS004") == []
+
+
+class TestTFOS005Threads:
+    def test_non_daemon_thread_no_join(self):
+        src = """
+            import threading
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+        """
+        got = findings_of(src, "TFOS005")
+        assert len(got) == 1 and "non-daemon" in got[0].message
+
+    def test_daemon_thread_ok(self):
+        src = """
+            import threading
+
+            def start(self):
+                t = threading.Thread(target=loop, daemon=True)
+                t.start()
+        """
+        assert findings_of(src, "TFOS005") == []
+
+    def test_non_daemon_with_join_ok(self):
+        src = """
+            import threading
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+        """
+        assert findings_of(src, "TFOS005") == []
+
+    def test_bare_except_in_loop(self):
+        src = """
+            def loop(self):
+                while True:
+                    try:
+                        self.beat()
+                    except:
+                        continue
+        """
+        got = findings_of(src, "TFOS005")
+        assert len(got) == 1 and "bare" in got[0].message
+
+    def test_swallow_pass_in_loop(self):
+        src = """
+            def loop(self):
+                for item in self.q:
+                    try:
+                        handle(item)
+                    except Exception:
+                        pass
+        """
+        got = findings_of(src, "TFOS005")
+        assert len(got) == 1 and "discards" in got[0].message
+
+    def test_handled_exception_ok(self):
+        src = """
+            def loop(self):
+                for item in self.q:
+                    try:
+                        handle(item)
+                    except Exception as e:
+                        log(e)
+        """
+        assert findings_of(src, "TFOS005") == []
+
+
+class TestTFOS006Locks:
+    def test_naked_acquire(self):
+        src = """
+            def f(self):
+                self._lock.acquire()
+                self.update()
+                self._lock.release()
+        """
+        got = findings_of(src, "TFOS006")
+        assert len(got) == 1 and "finally" in got[0].hint
+
+    def test_with_statement_ok(self):
+        src = """
+            def f(self):
+                with self._lock:
+                    self.update()
+        """
+        assert findings_of(src, "TFOS006") == []
+
+    def test_acquire_then_try_finally_ok(self):
+        src = """
+            def f(self):
+                self._lock.acquire()
+                try:
+                    self.update()
+                finally:
+                    self._lock.release()
+        """
+        assert findings_of(src, "TFOS006") == []
+
+    def test_acquire_inside_try_with_finally_release_ok(self):
+        src = """
+            def f(self):
+                try:
+                    self._lock.acquire()
+                    self.update()
+                finally:
+                    self._lock.release()
+        """
+        assert findings_of(src, "TFOS006") == []
+
+    def test_trylock_ok(self):
+        src = """
+            def f(self):
+                if self._lock.acquire(blocking=False):
+                    self._lock.release()
+        """
+        assert findings_of(src, "TFOS006") == []
+
+    def test_domain_acquire_api_ok(self):
+        # the prefix cache's lease API happens to be called acquire
+        src = """
+            def admit(self, pc, prompt, n):
+                lease = pc.acquire(prompt, limit_tokens=n - 1)
+                return lease
+        """
+        assert findings_of(src, "TFOS006") == []
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestSuppression:
+    BAD_LINE = """
+        def step_chunk(self, toks):
+            return toks[0].item(){pragma}
+    """
+
+    def test_same_line_pragma(self):
+        src = self.BAD_LINE.format(
+            pragma="  # tfoslint: disable=TFOS002(sanctioned sync)"
+        )
+        got, sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+        assert got == []
+        assert len(sup) == 1 and sup[0].rule == "TFOS002"
+
+    def test_line_above_pragma(self):
+        src = """
+            def step_chunk(self, toks):
+                # tfoslint: disable=TFOS002(sanctioned sync)
+                return toks[0].item()
+        """
+        got, sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+        assert got == [] and len(sup) == 1
+
+    def test_reason_required(self):
+        src = self.BAD_LINE.format(pragma="  # tfoslint: disable=TFOS002()")
+        got, sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+        assert len(got) == 1 and sup == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        src = self.BAD_LINE.format(
+            pragma="  # tfoslint: disable=TFOS005(not the right rule)"
+        )
+        got, _sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+        assert len(got) == 1
+
+    def test_pragma_rides_trailing_comment(self):
+        src = self.BAD_LINE.format(
+            pragma="  # noqa: X - tfoslint: disable=TFOS002(combined)"
+        )
+        got, sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+        assert got == [] and len(sup) == 1
+
+    def test_multiple_rules_one_pragma(self):
+        src = """
+            import threading
+
+            def loop(self):
+                while True:
+                    try:
+                        self.beat()
+                    # tfoslint: disable=TFOS005(supervised loop: the watchdog re-raises)
+                    except:
+                        continue
+        """
+        got, sup = lint.lint_source(textwrap.dedent(src), path="fx.py")
+        assert got == [] and len(sup) == 1
+
+
+class TestBaseline:
+    BAD = ("def step_chunk(self, toks):\n"
+           "    return toks[0].item()\n")
+
+    def test_fingerprint_survives_line_moves(self):
+        a, _ = lint.lint_source(self.BAD, path="fx.py")
+        moved = "\n\n\n" + self.BAD
+        b, _ = lint.lint_source(moved, path="fx.py")
+        fa = list(lint.fingerprints(a, sources={"fx.py": self.BAD}))
+        fb = list(lint.fingerprints(b, sources={"fx.py": moved}))
+        assert fa == fb and len(fa) == 1
+
+    def test_fingerprint_changes_with_text(self):
+        edited = self.BAD.replace("toks[0]", "toks[1]")
+        a, _ = lint.lint_source(self.BAD, path="fx.py")
+        b, _ = lint.lint_source(edited, path="fx.py")
+        fa = list(lint.fingerprints(a, sources={"fx.py": self.BAD}))
+        fb = list(lint.fingerprints(b, sources={"fx.py": edited}))
+        assert fa != fb
+
+    def test_baseline_masks_old_finding_only(self, tmp_path):
+        fx = tmp_path / "fx.py"
+        fx.write_text(self.BAD)
+        base = tmp_path / "baseline.json"
+        # accept the current state
+        rc = lint.main([str(fx), "--baseline", str(base),
+                        "--write-baseline"])
+        assert rc == 0 and base.exists()
+        # clean against the baseline
+        assert lint.main([str(fx), "--baseline", str(base)]) == 0
+        # a NEW finding still fails
+        fx.write_text(self.BAD +
+                      "def dispatch_chunk(self, t):\n"
+                      "    return t.item()\n")
+        assert lint.main([str(fx), "--baseline", str(base)]) == 1
+
+    def test_stale_entries_reported_not_fatal(self, tmp_path, capsys):
+        fx = tmp_path / "fx.py"
+        fx.write_text(self.BAD)
+        base = tmp_path / "baseline.json"
+        lint.main([str(fx), "--baseline", str(base), "--write-baseline"])
+        fx.write_text("def clean():\n    return 1\n")
+        assert lint.main([str(fx), "--baseline", str(base)]) == 0
+        out = capsys.readouterr().out
+        assert "1 stale baseline entry" in out
+
+    def test_package_lints_clean_against_checked_in_baseline(self):
+        # THE acceptance command:
+        #   python -m tensorflowonspark_tpu.analysis.lint tensorflowonspark_tpu/
+        assert lint.main([PKG]) == 0
+
+    def test_checked_in_baseline_is_near_empty(self):
+        with open(lint.DEFAULT_BASELINE) as f:
+            data = json.load(f)
+        assert len(data["findings"]) <= 5
+
+    def test_json_output(self, tmp_path, capsys):
+        fx = tmp_path / "fx.py"
+        fx.write_text(self.BAD)
+        rc = lint.main([str(fx), "--no-baseline", "--json"])
+        assert rc == 1
+        out = json.loads(capsys.readouterr().out)
+        assert out["new"][0]["rule"] == "TFOS002"
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestContractRegistries:
+    def test_reserved_inputs_consolidated(self):
+        from tensorflowonspark_tpu import serving_engine as se
+
+        assert se.RESERVED_INPUTS == catalog.RESERVED_INPUT_COLUMNS
+        assert se.RESERVED_INPUTS == (
+            se.BUDGET_INPUT, se.DEADLINE_INPUT,
+            se.TENANT_INPUT, se.TRACE_INPUT,
+        )
+
+    def test_catalog_no_duplicates(self):
+        assert catalog.duplicates() == []
+
+    def test_catalog_known(self):
+        assert catalog.known("serving.admitted")
+        assert catalog.known("usage.chip_sec.some-tenant")
+        assert not catalog.known("serving.admited")
+
+    def test_docs_table_matches_catalog(self):
+        doc = os.path.join(REPO, "docs", "observability.md")
+        assert catalog.check_docs(doc) == []
+
+    def test_docs_drift_detected(self, tmp_path):
+        doc = os.path.join(REPO, "docs", "observability.md")
+        with open(doc) as f:
+            text = f.read()
+        tampered = tmp_path / "observability.md"
+        tampered.write_text(text.replace(
+            "| `serving.admitted` |", "| `serving.admited` |"
+        ))
+        drift = catalog.check_docs(str(tampered))
+        assert drift and any("serving.admitted" in d for d in drift)
+
+    def test_catalog_cli_check(self, capsys):
+        doc = os.path.join(REPO, "docs", "observability.md")
+        assert catalog.main(["--check", doc]) == 0
+        assert "matches the catalog" in capsys.readouterr().out
+
+    def test_every_rule_documented(self):
+        page = os.path.join(REPO, "docs", "static_analysis.md")
+        with open(page) as f:
+            text = f.read()
+        for rule in lint.RULES:
+            assert rule in text, "rule %s missing from docs" % rule
+
+
+# ---------------------------------------------------------------------------
+
+
+def _pair(san):
+    return (locksan.Lock(name="A", _san=san),
+            locksan.Lock(name="B", _san=san))
+
+
+class TestLockSan:
+    def test_inversion_reported(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        assert san.reports() == []
+        with b:
+            with a:
+                pass
+        reps = san.reports()
+        assert len(reps) == 1
+        r = reps[0]
+        assert r["kind"] == "potential_deadlock"
+        assert set(r["cycle"]) == {"A", "B"}
+        # both edges carry both sites and stacks
+        assert len(r["edges"]) == 2
+        for e in r["edges"]:
+            assert e["from_site"] and e["to_site"]
+            assert e["held_stack"] and e["acquire_stack"]
+
+    def test_consistent_order_clean(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        for _ in range(5):
+            with a:
+                with b:
+                    pass
+        assert san.reports() == []
+
+    def test_three_lock_cycle(self):
+        san = locksan.LockSanitizer()
+        a = locksan.Lock(name="A", _san=san)
+        b = locksan.Lock(name="B", _san=san)
+        c = locksan.Lock(name="C", _san=san)
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        assert san.reports() == []
+        with c:
+            with a:
+                pass
+        reps = san.reports()
+        assert len(reps) == 1
+        assert set(reps[0]["cycle"]) == {"A", "B", "C"}
+
+    def test_cycle_deduplicated(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        for _ in range(3):
+            with b:
+                with a:
+                    pass
+        assert len(san.reports()) == 1
+
+    def test_rlock_reentrant_no_self_report(self):
+        san = locksan.LockSanitizer()
+        r = locksan.RLock(name="R", _san=san)
+        with r:
+            with r:
+                with r:
+                    pass
+        assert san.reports() == []
+
+    def test_trylock_records_no_edge(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        with b:
+            assert a.acquire(blocking=False)
+            a.release()
+        assert san.reports() == []
+
+    def test_blocking_under_trylock_hold_still_reports(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        assert b.acquire(blocking=False)
+        try:
+            with a:
+                pass
+        finally:
+            b.release()
+        assert len(san.reports()) == 1
+
+    def test_cross_thread_inversion(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        order = []
+
+        def t1():
+            with a:
+                with b:
+                    order.append("t1")
+
+        def t2():
+            with b:
+                with a:
+                    order.append("t2")
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        th = threading.Thread(target=t2)
+        th.start()
+        th.join()
+        assert order == ["t1", "t2"]
+        assert len(san.reports()) == 1
+
+    def test_check_clean_raises_with_sites(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        with pytest.raises(AssertionError) as ei:
+            san.check_clean()
+        assert "lock-order cycle" in str(ei.value)
+        assert "test_analysis.py" in str(ei.value)
+
+    def test_format_report(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        text = locksan.format_report(san.reports()[0])
+        assert "A" in text and "B" in text
+        assert "holding-since" in text and "acquiring-at" in text
+
+    def test_reset(self):
+        san = locksan.LockSanitizer()
+        a, b = _pair(san)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        assert san.reports()
+        san.reset()
+        assert san.reports() == []
+
+    def test_install_patches_threading(self):
+        was = locksan.installed()
+        if not was:
+            assert locksan.install()
+        try:
+            assert threading.Lock is locksan.Lock
+            assert threading.RLock is locksan.RLock
+            lk = threading.Lock()
+            assert isinstance(lk, locksan._InstrumentedLock)
+            with lk:
+                assert lk.locked()
+            assert not lk.locked()
+            # a Condition over an instrumented RLock keeps recursive
+            # holds intact through wait()
+            cond = threading.Condition(threading.RLock())
+            hit = []
+
+            def waiter():
+                with cond:
+                    while not hit:
+                        cond.wait(timeout=5)
+
+            t = threading.Thread(target=waiter, daemon=True)
+            t.start()
+            with cond:
+                hit.append(1)
+                cond.notify_all()
+            t.join(timeout=5)
+            assert not t.is_alive()
+        finally:
+            if not was:
+                locksan.uninstall()
+        assert locksan.installed() == was
+
+    def test_install_idempotent_and_uninstall(self):
+        was = locksan.installed()
+        if was:
+            pytest.skip("armed session owns the global install")
+        assert locksan.install()
+        try:
+            assert not locksan.install()  # second install is a no-op
+        finally:
+            assert locksan.uninstall()
+        assert not locksan.uninstall()
+        assert threading.Lock is not locksan.Lock
+
+    def test_enabled_env(self):
+        assert locksan.enabled({"TFOS_LOCKSAN": "1"})
+        assert not locksan.enabled({"TFOS_LOCKSAN": "0"})
+        assert not locksan.enabled({})
+
+    def test_thread_zoo_consistent_order_clean(self):
+        # a mini version of the repo's thread shape: N workers all
+        # taking (scheduler -> registry -> queue-internal) in the
+        # same order, plus a Condition-paced drain — must stay clean
+        san = locksan.LockSanitizer()
+        sched = locksan.Lock(name="scheduler", _san=san)
+        reg = locksan.Lock(name="registry", _san=san)
+        led = locksan.Lock(name="ledger", _san=san)
+        done = []
+
+        def worker(i):
+            for _ in range(20):
+                with sched:
+                    with reg:
+                        pass
+                with reg:
+                    with led:
+                        pass
+            done.append(i)
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(done) == 6
+        assert san.reports() == []
+        san.check_clean()

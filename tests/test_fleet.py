@@ -20,7 +20,7 @@ import pytest
 
 from tensorflowonspark_tpu import serving, serving_engine, telemetry
 from tensorflowonspark_tpu.fleet.deploy import RollingDeploy
-from tensorflowonspark_tpu.fleet.replica import Replica, ReplicaSet
+from tensorflowonspark_tpu.fleet.replica import ReplicaSet
 from tensorflowonspark_tpu.fleet.router import (
     FLEET_BUDGET_COL,
     FleetRouter,

@@ -7,7 +7,6 @@ HealthPlane scrape loop incl. the auto-profiler trigger path.
 """
 
 import json
-import threading
 import time
 
 import pytest

@@ -13,7 +13,6 @@ without CPU cross-process collectives); the single-process mesh tests
 cover the collective math everywhere.
 """
 
-import threading
 
 import numpy as np
 import pytest

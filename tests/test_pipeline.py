@@ -14,7 +14,6 @@ from tensorflowonspark_tpu.pipeline import (
     Namespace,
     TFEstimator,
     TFModel,
-    TFParams,
 )
 
 W_TRUE = np.array([3.14, 1.618], np.float32)

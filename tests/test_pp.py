@@ -14,7 +14,6 @@ import optax
 import pytest
 
 pytestmark = pytest.mark.slow
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tensorflowonspark_tpu.parallel import pp
