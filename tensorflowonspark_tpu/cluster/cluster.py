@@ -313,6 +313,8 @@ class TPUCluster(object):
         #: fleet health plane (started by start_health_plane(); stopped
         #: by shutdown())
         self.health = None
+        #: remediation engine (started by start_remediation())
+        self.remediation = None
         self._profile_seq = itertools.count(1)
 
     # -- data plane ----------------------------------------------------
@@ -605,6 +607,9 @@ class TPUCluster(object):
             SIGALRM guard (reference: TFCluster.py:136-144).
         """
         deadline = time.monotonic() + timeout
+        if self.remediation is not None:
+            self.remediation.stop()
+            self.remediation = None
         if self.health is not None:
             self.health.stop()
             from tensorflowonspark_tpu.telemetry import health as _health
@@ -1070,6 +1075,112 @@ class TPUCluster(object):
                 "unable to clear health hint on executor %s",
                 executor_id, exc_info=True,
             )
+
+    # -- remediation verbs (ISSUE 16) ----------------------------------
+
+    def _compute_node(self, executor_id):
+        return next(
+            (n for n in self.cluster_info
+             if n["executor_id"] == int(executor_id)
+             and n["job_name"] in ("worker", "chief", "master")),
+            None,
+        )
+
+    def hold_executor(self, executor_id, reason=None):
+        """Elastic shrink (the remediation engine's straggler
+        actuator): write a ``remediation_hold`` into the node's kv —
+        its supervisor quiesces the compute process, bumps the gang
+        generation so the survivors re-rendezvous at reduced width,
+        and parks (heartbeating, registered, NOT training) until
+        :meth:`release_executor`.  Requires ``elastic=True``.
+        Returns True when the hold was delivered."""
+        if not self.elastic:
+            raise RuntimeError(
+                "hold_executor needs an elastic cluster (the shrink "
+                "is a supervised re-rendezvous)"
+            )
+        node_meta = self._compute_node(executor_id)
+        if node_meta is None:
+            logger.warning(
+                "hold request for unknown executor %s", executor_id
+            )
+            return False
+        try:
+            m = self._connect(node_meta)
+            m.set("remediation_hold", {
+                "reason": str(reason or "remediation"),
+                "t": time.time(),
+            })
+        except Exception:  # noqa: BLE001 - node mid-restart
+            logger.warning(
+                "unable to deliver hold to executor %s",
+                executor_id, exc_info=True,
+            )
+            return False
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "remediation_hold_set", trace="cluster", severity="warn",
+            executor_id=int(executor_id), reason=reason,
+        )
+        if self.monitor is not None:
+            # a held node reports compute_alive (state 'held'), but
+            # give the transition the same grace as a restart so the
+            # kill→held window never reads as a death
+            self.monitor.clear_straggler(int(executor_id))
+        return True
+
+    def release_executor(self, executor_id):
+        """Elastic grow: clear the node's ``remediation_hold`` — its
+        supervisor claims the next generation and respawns, and the
+        gang re-rendezvouses back to full width.  Returns True when
+        the release was delivered."""
+        node_meta = self._compute_node(executor_id)
+        if node_meta is None:
+            return False
+        try:
+            m = self._connect(node_meta)
+            m.set("remediation_hold", None)
+        except Exception:  # noqa: BLE001 - node mid-restart
+            logger.warning(
+                "unable to deliver release to executor %s",
+                executor_id, exc_info=True,
+            )
+            return False
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "remediation_hold_cleared", trace="cluster",
+            executor_id=int(executor_id),
+        )
+        return True
+
+    def start_remediation(self, router=None, policies=None,
+                          guardrails=None, interval=None, **overrides):
+        """Wire and START the audited remediation engine over this
+        cluster's live planes (requires :meth:`start_health_plane`
+        first — the engine reads its SLO cursor and straggler hints).
+        Returns the running :class:`~tensorflowonspark_tpu.
+        remediation.engine.RemediationEngine` (also kept on
+        ``self.remediation``; ``stop()`` it before shutdown)."""
+        if self.health is None:
+            raise RuntimeError(
+                "start_remediation needs the health plane — call "
+                "start_health_plane(...) first"
+            )
+        from tensorflowonspark_tpu import remediation as _remediation
+
+        eng = _remediation.wire(
+            self.health, router=router, cluster=self,
+            policies=policies, guardrails=guardrails,
+            interval=(
+                self.health.interval if interval is None
+                else float(interval)
+            ),
+            **overrides
+        )
+        self.remediation = eng
+        return eng.start()
 
     def tensorboard_url(self):
         """URL of the cluster's tensorboard, if one was launched

@@ -340,6 +340,39 @@ def _manager_first_call(cluster_info, executor_id, call):
         return mgr, call(mgr)
 
 
+def _route_around_hold(cluster_info, executor_id, mgr, state, probe):
+    """Pick a live, un-held COMPUTE peer's manager for this feed task.
+
+    The data-plane half of a remediation hold (ISSUE 16): a held node
+    keeps its heartbeats and registrations but drains nothing, so its
+    share of the feed must flow to the survivors of the elastic
+    shrink.  Falls back to the local manager when every peer is
+    held/terminating/unreachable — the normal feed_timeout + elastic
+    requeue path then applies."""
+    for node in sorted(cluster_info, key=lambda n: n["executor_id"]):
+        peer = node["executor_id"]
+        if peer == executor_id or node.get("job_name") in ("ps", "eval"):
+            continue
+        try:
+            m2, (st2, cs2) = _manager_first_call(
+                cluster_info, peer, probe
+            )
+        except Exception:  # noqa: BLE001 - peer mid-restart: next one
+            continue
+        if cs2 != "held" and st2 != "terminating":
+            logger.info(
+                "executor %d is held by remediation; forwarding this "
+                "partition to executor %d", executor_id, peer,
+            )
+            return m2, st2
+    logger.warning(
+        "executor %d is held and no live peer accepts its feed; "
+        "feeding locally (the elastic requeue will recover it)",
+        executor_id,
+    )
+    return mgr, state
+
+
 def _local_executor_workdir():
     from tensorflowonspark_tpu.engine import TFOS_EXECUTOR_WORKDIR
 
@@ -930,12 +963,27 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
             pid = first.pid
         elif first is not None:
             iterator = itertools.chain([first], iterator)
-        mgr, state = _manager_first_call(
-            cluster_info,
-            _local_executor_id(),
-            lambda m: str(m.get("state")._getvalue()),
+        def _node_probe(m):
+            st = str(m.get("state")._getvalue())
+            try:
+                cs = m.get("compute_state")._getvalue()
+            except Exception:  # noqa: BLE001 - kv is best effort
+                cs = None
+            return st, cs
+
+        local_eid = _local_executor_id()
+        mgr, (state, cstate) = _manager_first_call(
+            cluster_info, local_eid, _node_probe,
         )
         logger.info("connected to node manager, state=%s", state)
+        if cstate == "held" and state != "terminating":
+            # remediation hold (ISSUE 16): this node's compute is
+            # deliberately quiesced (elastic shrink), so nothing will
+            # ever drain its queue — route the partition to a live
+            # peer instead of wedging until feed_timeout
+            mgr, state = _route_around_hold(
+                cluster_info, local_eid, mgr, state, _node_probe
+            )
         if pid is not None and state != "terminating":
             mgr.ledger("begin", pid)
         terminating = state == "terminating"
@@ -1178,6 +1226,22 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         # error poll; the error queue is polled at ~1/s (each poll is a
         # manager RPC, and a 10/s rate per in-flight feed task is real
         # load at reference scale) while the wakeup stays at 0.1s.
+        def _check_held():
+            # remediation hold: a held executor's compute process is
+            # parked in the rendezvous barrier and will never drain
+            # rows that were already in flight when the hold landed —
+            # fail fast so the elastic requeue re-feeds this partition
+            # to a live peer instead of wedging until feed_timeout
+            try:
+                cs = mgr.get("compute_state")._getvalue()
+            except Exception:  # noqa: BLE001 - kv is best effort
+                return
+            if cs == "held":
+                raise RuntimeError(
+                    "executor held by remediation while batches were "
+                    "in flight; failing fast so the partition requeues"
+                )
+
         deadline = time.monotonic() + feed_timeout
         next_err_poll = 0.0
         if ring is not None:
@@ -1191,6 +1255,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
                     break
                 if time.monotonic() >= next_err_poll:
                     _check_error_queue(mgr, err_q)
+                    _check_held()
                     next_err_poll = time.monotonic() + 1.0
                 time.sleep(0.05)
                 if time.monotonic() >= deadline:
@@ -1202,6 +1267,7 @@ def train(cluster_info, cluster_meta, feed_timeout=600, qname="input"):
         while not joinThr.wait(0.1):
             if time.monotonic() >= next_err_poll:
                 _check_error_queue(mgr, err_q)
+                _check_held()
                 next_err_poll = time.monotonic() + 1.0
             if time.monotonic() >= deadline:
                 raise RuntimeError(

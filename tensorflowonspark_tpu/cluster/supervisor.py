@@ -121,6 +121,13 @@ class Supervisor(object):
         self.restarts = 0
         self.proc = None
         self.heartbeater = None
+        #: remediation hold (ISSUE 16): True while the driver's
+        #: ``hold_executor`` kv quiesces this node's compute — the
+        #: elastic-shrink actuator.  A held node keeps its heartbeats
+        #: and registrations (so the monitor sees it healthy and peer
+        #: barriers never stall on it) but spawns no compute until
+        #: the hold clears.
+        self._held = False
         self._stop = threading.Event()
         self._thread = None
         self._chaos_fn = chaos_fn
@@ -282,8 +289,11 @@ class Supervisor(object):
         if self.proc is not None and self.proc.is_alive():
             return True
         try:
+            # 'held' = a remediation hold quiesced the compute on
+            # purpose (elastic shrink) — deliberate, not a death
             return (
-                self.mgr.get("compute_state")._getvalue() == "finished"
+                self.mgr.get("compute_state")._getvalue()
+                in ("finished", "held")
             )
         except Exception:  # noqa: BLE001 - manager gone = node dying
             return False
@@ -328,6 +338,8 @@ class Supervisor(object):
         while not self._stop.is_set():
             self.proc.join(timeout=self.interval / 2.0)
             state = self._node_state()
+            if self.elastic and self._hold_step(state):
+                continue
             if not self.proc.is_alive():
                 if state in ("terminating", "stopped"):
                     break  # orderly teardown, nothing to supervise
@@ -371,6 +383,153 @@ class Supervisor(object):
         # heartbeats stay up until the node is told to stop, so the
         # driver can still distinguish 'compute done' from 'node gone'
         self._await_stop_then_quiesce()
+
+    # -- remediation hold (elastic shrink/grow, ISSUE 16) --------------
+
+    def _hold_request(self):
+        """The driver-written ``remediation_hold`` kv (dict) or None."""
+        try:
+            rec = self.mgr.get("remediation_hold")
+            if hasattr(rec, "_getvalue"):
+                rec = rec._getvalue()
+        except Exception:  # noqa: BLE001 - kv is best effort
+            return None
+        return rec if isinstance(rec, dict) else None
+
+    def _hold_step(self, state):
+        """One watch-loop round of hold handling; True when this
+        round was consumed by it (enter / stay parked / exit)."""
+        if state in ("terminating", "stopped"):
+            # node teardown outranks a hold; the normal path breaks
+            self._held = False
+            return False
+        hold = self._hold_request()
+        if hold is not None and not self._held:
+            self._enter_hold(hold)
+            return True
+        if not self._held:
+            return False
+        if hold is None:
+            self._exit_hold()
+            return True
+        # stay parked — but keep registering at newer generations so
+        # surviving peers' re-rendezvous barriers never stall on us
+        peer_gen = (
+            self.heartbeater.cluster_generation
+            if self.heartbeater is not None else 0
+        )
+        if peer_gen > self.generation:
+            self._register_held(peer_gen)
+        # the dead proc makes join() return immediately — pace the
+        # loop explicitly while parked
+        self._stop.wait(self.interval / 2.0)
+        return True
+
+    def _enter_hold(self, hold):
+        """Elastic shrink: quiesce compute, bump the gang generation
+        so survivors re-rendezvous at reduced width, and park without
+        respawning.  Deliberate — no restart is charged."""
+        self._held = True
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "executor_held", trace="executor%d" % self.ctx.executor_id,
+            severity="warn", executor_id=self.ctx.executor_id,
+            reason=hold.get("reason"),
+        )
+        logger.warning(
+            "executor %d entering remediation hold (%s): quiescing "
+            "compute and shrinking the gang",
+            self.ctx.executor_id, hold.get("reason"),
+        )
+        if self.proc.is_alive():
+            self.proc.terminate()
+            self.proc.join(timeout=10)
+            if self.proc.is_alive():
+                self.proc.kill()
+                self.proc.join(timeout=10)
+        self._reset_data_plane()
+        # AFTER the reset — _reset_data_plane clears compute_state as
+        # its last act, and the 'held' flag is what keeps heartbeats
+        # reporting compute_alive (and feeders routing around us) for
+        # the whole life of the hold
+        try:
+            self.mgr.set("compute_state", "held")
+        except Exception:  # noqa: BLE001 - kv is best effort
+            pass
+        try:
+            client = reservation.Client(self.server_addr)
+            new_gen = client.rebirth(
+                self.ctx.executor_id, self.generation
+            )
+            client.close()
+        except Exception:  # noqa: BLE001 - server gone: stay parked
+            logger.warning(
+                "executor %d could not claim a shrink generation",
+                self.ctx.executor_id, exc_info=True,
+            )
+            return
+        self._register_held(new_gen)
+
+    def _register_held(self, generation):
+        """Register this (quiesced) node at ``generation`` and stand
+        at the barrier: peers rendezvous at the reduced width with
+        this node present-but-parked, and the pod leader is elected
+        among the OTHERS (a held node must not carry DCN duty)."""
+        self.generation = int(generation)
+        try:
+            client = reservation.Client(self.server_addr)
+            meta = dict(self.node_meta, generation=self.generation)
+            client.register(meta)
+            self._await_generation(client, self.generation)
+            peers = [
+                e for e in self._peers_at_generation(
+                    client, self.generation
+                )
+                if e != self.ctx.executor_id
+            ]
+            if peers:
+                self._publish_leader(peers)
+            client.close()
+        except Exception:  # noqa: BLE001 - barrier is best-effort
+            logger.warning(
+                "executor %d held re-registration at generation %d "
+                "was incomplete", self.ctx.executor_id,
+                self.generation, exc_info=True,
+            )
+
+    def _exit_hold(self):
+        """Elastic grow: the hold cleared — claim the next generation
+        (peers re-rendezvous back to full width) and respawn."""
+        self._held = False
+        from tensorflowonspark_tpu import telemetry
+
+        telemetry.get_tracer().mark(
+            "executor_released",
+            trace="executor%d" % self.ctx.executor_id,
+            executor_id=self.ctx.executor_id,
+        )
+        logger.info(
+            "executor %d remediation hold cleared: rejoining the "
+            "gang", self.ctx.executor_id,
+        )
+        try:
+            self.mgr.set("compute_state", None)
+        except Exception:  # noqa: BLE001 - kv is best effort
+            pass
+        try:
+            client = reservation.Client(self.server_addr)
+            new_gen = client.rebirth(
+                self.ctx.executor_id, self.generation
+            )
+            client.close()
+        except Exception:  # noqa: BLE001 - server gone: no cluster left
+            logger.error(
+                "executor %d could not claim a re-grow generation",
+                self.ctx.executor_id, exc_info=True,
+            )
+            return
+        self._park_and_respawn(new_gen)
 
     def _final_beat(self):
         """Push one immediate compute_alive=False beat so the monitor

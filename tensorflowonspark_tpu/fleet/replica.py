@@ -329,6 +329,17 @@ class ReplicaSet(object):
             import jax
 
             devs = jax.devices()
+        # construction knobs kept for spawn(): the autoscaling verb
+        # (ISSUE 16) builds late replicas exactly like the initial set
+        self._predict = predict
+        self._predict_factory = predict_factory
+        self._input_mapping = input_mapping
+        self._num_slots = num_slots
+        self._chunk = chunk
+        self._queue_depth = queue_depth
+        self._engine_opts = engine_opts
+        self._devs = devs
+        self._poll_sec = poll_sec
         predicts = []
         for i in range(n):
             if predict_factory is not None:
@@ -336,26 +347,49 @@ class ReplicaSet(object):
             elif i == 0:
                 predicts.append(predict)
             else:
-                factory = getattr(predict, "make_replica", None)
-                if factory is None:
-                    raise ValueError(
-                        "fleet serving with {0} replicas needs a "
-                        "predictor exposing make_replica() (transformer."
-                        "serving_builder generation predictors do) — "
-                        "each replica must own its decoder; this "
-                        "predictor has none".format(n)
-                    )
-                predicts.append(factory())
+                predicts.append(self._replica_predict(n))
         self.replicas = [
-            Replica(
-                i, predicts[i], input_mapping, self.completions,
-                num_slots=num_slots, chunk=chunk,
-                queue_depth=queue_depth, engine_opts=engine_opts,
-                device=devs[i % len(devs)] if devs else None,
-                poll_sec=poll_sec,
-            )
-            for i in range(n)
+            self._build(i, predicts[i]) for i in range(n)
         ]
+
+    def _replica_predict(self, n):
+        factory = getattr(self._predict, "make_replica", None)
+        if factory is None:
+            raise ValueError(
+                "fleet serving with {0} replicas needs a "
+                "predictor exposing make_replica() (transformer."
+                "serving_builder generation predictors do) — "
+                "each replica must own its decoder; this "
+                "predictor has none".format(n)
+            )
+        return factory()
+
+    def _build(self, rid, predict):
+        devs = self._devs
+        return Replica(
+            rid, predict, self._input_mapping, self.completions,
+            num_slots=self._num_slots, chunk=self._chunk,
+            queue_depth=self._queue_depth,
+            engine_opts=self._engine_opts,
+            device=devs[rid % len(devs)] if devs else None,
+            poll_sec=self._poll_sec,
+        )
+
+    def spawn(self):
+        """Build, append, and START one more replica (its id is the
+        next list index — the router shares this list, so the new
+        replica is routable the moment this returns).  The autoscale /
+        capacity-restore actuator (ISSUE 16); construction mirrors the
+        initial set (``predict_factory`` when given, else
+        ``predict.make_replica()``)."""
+        rid = len(self.replicas)
+        if self._predict_factory is not None:
+            predict = self._predict_factory()
+        else:
+            predict = self._replica_predict(rid + 1)
+        r = self._build(rid, predict)
+        self.replicas.append(r)
+        return r.start()
 
     def __len__(self):
         return len(self.replicas)

@@ -165,6 +165,7 @@ def _prefix_affinity(router, req, candidates):
         router._m["affinity_hits"].inc()
         return target
     router.stats["affinity_spills"] += 1
+    router._spill_times.append(router._clock())
     return _least_loaded(router, req, candidates)
 
 
@@ -239,7 +240,7 @@ class FleetRouter(object):
                  affinity_width=None, slow_factor=4.0,
                  min_slow_sec=0.05, suspect_rounds=2, probe_every=8,
                  readmit_rounds=3, stats=None, clock=None, seed=0,
-                 poll_sec=0.05):
+                 poll_sec=0.05, pressure_window=30.0):
         if policy not in serving_engine.POLICIES:
             raise ValueError(
                 "fleet policy must be one of {0}, got {1!r}".format(
@@ -356,6 +357,7 @@ class FleetRouter(object):
             "degraded": 0, "drained": 0, "redispatched": 0,
             "replica_deaths": 0, "affinity_hits": 0,
             "affinity_spills": 0, "evicted": 0, "readmitted": 0,
+            "scaled_up": 0, "scaled_down": 0,
             "replicas": len(self.replicas),
             "dispatch_policy": self.dispatch_name,
             "fleet_policy": policy,
@@ -376,7 +378,17 @@ class FleetRouter(object):
         }
         self._m_live = reg.gauge("fleet.live_replicas")
         self._m_live.set(len(self.replicas))
+        self._m_spawned = reg.counter("fleet.replicas_spawned")
+        self._m_retired = reg.counter("fleet.replicas_retired")
         self._t0 = self._clock()
+        # windowed admission-pressure statistic (ISSUE 16 satellite):
+        # occupancy samples + shed/spill event times over the last
+        # ``pressure_window`` seconds, so autoscaling decisions and
+        # operators (/status) read the same number
+        self.pressure_window = max(1.0, float(pressure_window))
+        self._occupancy_samples = collections.deque()  # (t, occupancy)
+        self._shed_times = collections.deque()
+        self._spill_times = collections.deque()
         # /status provider (weakref-bound like the engine's: a
         # finished router must never pin its replicas' decoders)
         import weakref
@@ -410,10 +422,58 @@ class FleetRouter(object):
         fids = sorted(self._assigned[rid])
         return fids, [self.stats["trace_ids"].get(f) for f in fids]
 
+    def _note_pressure(self):
+        """One admission-pressure sample per serve pass (bounded by
+        the window — trimmed on both sample and read)."""
+        now = self._clock()
+        self._occupancy_samples.append(
+            (now, len(self._queue) / float(self.queue_depth))
+        )
+        horizon = now - self.pressure_window
+        for dq in (self._occupancy_samples, self._shed_times,
+                   self._spill_times):
+            while dq and (dq[0][0] if dq is self._occupancy_samples
+                          else dq[0]) < horizon:
+                dq.popleft()
+
+    def pressure(self):
+        """The windowed admission-pressure statistic (ISSUE 16
+        satellite): queue occupancy (now / mean / peak over the last
+        ``pressure_window`` seconds) plus shed and affinity-spill
+        rates over the same window.  Rides ``/status`` (fleet
+        provider) so the autoscaling policy and an operator read the
+        SAME number; also the sensor behind the remediation engine's
+        spawn/retire decisions."""
+        now = self._clock()
+        horizon = now - self.pressure_window
+        occ = [v for (t, v) in self._occupancy_samples if t >= horizon]
+        sheds = sum(1 for t in self._shed_times if t >= horizon)
+        spills = sum(1 for t in self._spill_times if t >= horizon)
+        occ_now = len(self._queue) / float(self.queue_depth)
+        return {
+            "window_sec": self.pressure_window,
+            "occupancy": round(occ_now, 4),
+            "occupancy_mean": round(
+                sum(occ) / len(occ), 4
+            ) if occ else round(occ_now, 4),
+            "occupancy_peak": round(max(occ), 4) if occ else round(
+                occ_now, 4
+            ),
+            "queued": len(self._queue),
+            "queue_depth": self.queue_depth,
+            "shed_per_sec": round(sheds / self.pressure_window, 4),
+            "spill_per_sec": round(spills / self.pressure_window, 4),
+            "free_slots": sum(
+                max(0, self._room(r)) for r in self.replicas
+                if r.alive and r.state == "live"
+            ),
+        }
+
     def health_status(self):
         """Fleet summary for ``/status``: routing policy, per-replica
         load snapshots, and the deploy state."""
         return {
+            "pressure": self.pressure(),
             "replicas": len(self.replicas),
             "live": sum(
                 1 for r in self.replicas
@@ -489,6 +549,7 @@ class FleetRouter(object):
     def _shed(self, fid, rid, why):
         self.stats["shed"] += 1
         self._m["shed"].inc()
+        self._shed_times.append(self._clock())
         # the mark rides the REQUEST's trace and names it in attrs
         # (ISSUE 14 satellite: fleet actions connect to the requests
         # they touched, not just a generic trace="fleet")
@@ -1006,6 +1067,94 @@ class FleetRouter(object):
             yield self._finished.pop(self._emit_next)
             self._emit_next += 1
 
+    # -- remediation verbs (ISSUE 16) ------------------------------------
+
+    def deploy_active(self):
+        """True while a rolling deploy is mid-step — the remediation
+        engine's conflict rule reads this (never fight a deploy)."""
+        return self._deploy is not None and not self._deploy.finished
+
+    def set_policy(self, policy):
+        """Flip the fleet admission policy at runtime (``_pull`` and
+        ``_admit`` consult ``self.policy`` every pass, so the flip
+        takes effect on the next serve pass).  The remediation
+        engine's degrade-on-page actuator; returns the PRIOR policy
+        so the caller can restore it on resolve."""
+        if policy not in serving_engine.POLICIES:
+            raise ValueError(
+                "fleet policy must be one of {0}, got {1!r}".format(
+                    serving_engine.POLICIES, policy
+                )
+            )
+        prior, self.policy = self.policy, policy
+        self.stats["fleet_policy"] = policy
+        if policy != prior:
+            self._tracer.mark(
+                "fleet_policy_changed", trace="fleet",
+                policy=policy, prior=prior,
+            )
+        return prior
+
+    def scale_up(self):
+        """Spawn one replica (ReplicaSet.spawn) and route to it
+        immediately — the autoscaling / capacity-restore actuator.
+        Returns the new replica id."""
+        r = self.replica_set.spawn()
+        self.stats["replicas"] = len(self.replicas)
+        self.stats["scaled_up"] += 1
+        self._m_spawned.inc()
+        self._m_live.set(sum(
+            1 for x in self.replicas
+            if x.alive and x.state == "live"
+        ))
+        self._tracer.mark(
+            "replica_spawned", trace="fleet",
+            replica_id=r.replica_id, replicas=len(self.replicas),
+        )
+        return r.replica_id
+
+    def scale_down(self, replica_id=None):
+        """Retire one live replica: drain it (no new traffic; its
+        in-flight work completes and the collect path drains it back
+        to the queue on close) and close it.  Picks the least-loaded
+        live replica when ``replica_id`` is None; refuses to retire
+        the last live replica.  Returns the retired id, or None when
+        nothing is retirable."""
+        live = [
+            r for r in self.replicas
+            if r.alive and r.state == "live"
+        ]
+        if len(live) <= 1:
+            return None
+        if replica_id is None:
+            r = min(
+                live, key=lambda x: (
+                    self._assigned_count(x.replica_id), x.replica_id
+                )
+            )
+        else:
+            r = self.replicas[replica_id]
+            if not (r.alive and r.state == "live"):
+                return None
+        rid = r.replica_id
+        fids, trace_ids = self.outstanding_of(rid)
+        self.replica_set.drain(rid)
+        # the STOP sentinel queues BEHIND any dispatched rows: the
+        # worker finishes in-flight work ("done" completions flow
+        # normally), then posts "stopped" and exits
+        r.close()
+        self.stats["scaled_down"] += 1
+        self._m_retired.inc()
+        self._m_live.set(sum(
+            1 for x in self.replicas
+            if x.alive and x.state == "live"
+        ))
+        self._tracer.mark(
+            "replica_retired", trace="fleet", severity="warn",
+            replica_id=rid, request_ids=fids, trace_ids=trace_ids,
+        )
+        return rid
+
     # -- rolling deploys -------------------------------------------------
 
     def start_rolling_deploy(self, params=None, step=None,
@@ -1044,6 +1193,7 @@ class FleetRouter(object):
         while True:
             self._deploy_step()
             self._pull(it)
+            self._note_pressure()
             self._dispatch()
             self._collect()
             for r in self._drain_ready():

@@ -71,7 +71,21 @@ FAULT_KINDS = (
     "restart",
     "executor_restart",
     "swap_rollback",
+    "replica_dead",
+    "remediation_budget_exhausted",
+    "straggler_flagged",
     "alert_firing",
+)
+
+#: Remediation-plane event kinds (ISSUE 16): the policy engine's
+#: audited decisions and guardrail events.  Rendered as their own
+#: report section — a decision is a RESPONSE, not a trigger (except
+#: budget exhaustion, which is an incident and sits in FAULT_KINDS).
+REMEDIATION_KINDS = (
+    "remediation_decision",
+    "remediation_deferred",
+    "remediation_budget_exhausted",
+    "remediation_rearmed",
 )
 
 #: Triggering event kind → the injected/root fault it implies (the
@@ -89,6 +103,9 @@ FAULT_MAP = {
     "swap_rollback": "corrupt_checkpoint",
     "checkpoint_quarantined": "corrupt_checkpoint",
     "alert_firing": "slo_burn",
+    "straggler_flagged": "slow_executor",
+    "replica_dead": "kill_replica",
+    "remediation_budget_exhausted": "remediation_runaway",
 }
 
 
@@ -387,6 +404,12 @@ def explain(paths, offsets=None, request=None):
     )
     cp["trace"] = trace_id
     faults = [ev for ev in timeline if ev["kind"] in FAULT_KINDS]
+    # the remediation plane's audited decisions (ISSUE 16): what the
+    # policy engine did — or deliberately did not do — about the
+    # faults above, with the triggering evidence it journaled
+    remediation = [
+        ev for ev in timeline if ev["kind"] in REMEDIATION_KINDS
+    ]
     return {
         "incident": incident,
         "timeline": timeline,
@@ -394,6 +417,7 @@ def explain(paths, offsets=None, request=None):
         "p99_exemplars": exemplars,
         "events_by_kind": counts,
         "faults": faults,
+        "remediation": remediation,
         "executors": sorted(
             {ev["executor"] for ev in timeline
              if ev["executor"] is not None},
@@ -505,6 +529,40 @@ def render_report(report):
             )
     else:
         lines.append("critical path   : no timed spans in the sources")
+    rem = report.get("remediation") or []
+    if rem:
+        lines.append("-- remediation decisions (why did the fleet do "
+                     "that?) --")
+        t0r = report["timeline"][0]["t"] if report["timeline"] else 0.0
+        for ev in rem[:20]:
+            attrs = ev.get("attrs") or {}
+            if ev["kind"] == "remediation_decision":
+                desc = "{0} by {1}{2}{3}".format(
+                    attrs.get("action"), attrs.get("policy"),
+                    " on {0}".format(attrs["target"])
+                    if attrs.get("target") else "",
+                    "" if attrs.get("executed")
+                    else (" [dry-run]" if attrs.get("dry_run")
+                          else " [not executed]"),
+                )
+                evidence = attrs.get("evidence")
+                if evidence:
+                    desc += "  evidence: {0}".format(
+                        json.dumps(evidence, sort_keys=True)[:160]
+                    )
+                if attrs.get("reason"):
+                    desc += "  ({0})".format(attrs["reason"])
+            else:
+                desc = "{0} {1}".format(
+                    ev["kind"],
+                    json.dumps(attrs, sort_keys=True)[:120]
+                    if attrs else "",
+                ).rstrip()
+            lines.append(
+                "    +{0:>9.3f}s  [{1:>4}] {2}".format(
+                    ev["t"] - t0r, ev["severity"], desc
+                )
+            )
     lines.append("-- clock-aligned timeline (fault-class + page "
                  "events) --")
     shown = 0

@@ -443,3 +443,37 @@ class CheckpointWatcher(object):
 
     def __exit__(self, *exc):
         self.close()
+
+
+# ----------------------------------------------------------------------
+# SLO-probation rollback (ISSUE 16)
+# ----------------------------------------------------------------------
+
+
+def flag_probation_fault(engine, reason="slo_burn", count=1):
+    """Count an EXTERNAL fault against ``engine``'s post-swap
+    probation window, extending probation from request-level errors
+    (device faults, watchdog wedges) to fleet-level signals — the
+    remediation engine calls this when post-swap SLO burn exceeds
+    budget.
+
+    Returns True when the engine is inside a probation window (the
+    rollback lands on its next scheduling pass, via the same
+    ``_maybe_swap`` path as a request-error rollback — never
+    concurrently with a dispatch); False when there is nothing to
+    roll back (no swap on probation), so the caller can journal a
+    no-op instead of pretending it acted.
+    """
+    if getattr(engine, "_prev_weights", None) is None:
+        return False
+    # same cross-thread contract as the watchdog's wedge accounting:
+    # a plain int bump the scheduler thread reads between chunks
+    engine._probation_errors += max(1, int(count))
+    from tensorflowonspark_tpu import telemetry
+
+    telemetry.get_tracer().mark(
+        "probation_slo_fault", trace="serve", severity="warn",
+        reason=str(reason),
+        weight_generation=engine.stats.get("weight_generation"),
+    )
+    return True

@@ -1,0 +1,125 @@
+"""Audited remediation: the policy engine that closes the fleet's
+sensor→actuator loop (ROADMAP item 3, ISSUE 16).
+
+Every sensor the stack grew — SLO burn and straggler attribution
+(telemetry/health.py), the typed event journal with clock-aligned
+causality (telemetry/journal.py, forensics.py), per-request cost
+rows (telemetry/ledger.py), the router's windowed admission pressure
+(fleet/router.py) — and every actuator — supervised restart and
+elastic re-rendezvous (cluster/supervisor.py), validated hot-swap
+and probation rollback (hot_swap.py), leader re-election
+(parallel/hier_ps.py), replica lifecycle verbs and rolling deploys
+(fleet/) — existed before this package, with a human between them.
+This package is the missing middle: policies read the sensors
+through cursors, guardrails (cooldowns, rate limits, a global action
+budget, hysteresis, dry-run, the deploy-conflict rule) bound what
+may execute, and every decision lands in the journal as a typed
+``remediation_decision`` event carrying its triggering evidence, so
+``forensics explain`` answers "why did the fleet do that?" the same
+way it answers "what failed?".
+
+Quick start (serving-only)::
+
+    from tensorflowonspark_tpu import remediation
+
+    eng = remediation.wire(plane, router=router).start()
+    ...
+    eng.stop()
+
+Docs: docs/fault_tolerance.md "Self-driving remediation".
+"""
+
+from tensorflowonspark_tpu.remediation.actuators import (  # noqa: F401
+    Actuators, ClusterActuators, CombinedActuators, FleetActuators,
+    UnsupportedAction,
+)
+from tensorflowonspark_tpu.remediation.engine import (  # noqa: F401
+    Guardrails, RemediationEngine, Sensors, SensorSnapshot,
+)
+from tensorflowonspark_tpu.remediation.policy import (  # noqa: F401
+    ACTIONS, AutoscalePolicy, FaultResponsePolicy, Intent,
+    PageAlertPolicy, Policy, SloRollbackPolicy, StragglerPolicy,
+    default_policies,
+)
+
+
+def wire(plane=None, router=None, cluster=None, policies=None,
+         guardrails=None, interval=1.0, clock=None, **overrides):
+    """Build a :class:`RemediationEngine` over the LIVE planes.
+
+    Args:
+      plane: a :class:`~tensorflowonspark_tpu.telemetry.health.
+        HealthPlane` (alerts via the ``alerts_since`` cursor +
+        straggler hints).  None is allowed for router-only wiring.
+      router: a :class:`~tensorflowonspark_tpu.fleet.router.
+        FleetRouter` — binds the serving verbs, the pressure sensor,
+        the probation sensor, and the deploy-conflict rule.
+      cluster: a :class:`~tensorflowonspark_tpu.cluster.cluster.
+        TPUCluster` — binds elastic shrink/grow and the fleet-shipped
+        journal sensor (falls back to this process's own journal).
+      policies / guardrails / interval / clock: forwarded to the
+        engine; ``overrides`` forward to :func:`default_policies`
+        when ``policies`` is None.
+    """
+    from tensorflowonspark_tpu import telemetry
+
+    slo = hints_fn = None
+    if plane is not None:
+        slo = plane.slo
+        hints_fn = lambda: dict(plane.hints)  # noqa: E731
+    journal = events_fn = None
+    if cluster is not None:
+        def events_fn():
+            return (cluster.journal() or {}).get("events", [])
+    else:
+        journal = telemetry.get_journal()
+    pressure_fn = fleet_fn = probation_fn = deploy_fn = None
+    if router is not None:
+        pressure_fn = router.pressure
+
+        def fleet_fn():
+            return {
+                "replicas": len(router.replicas),
+                "live": sum(
+                    1 for r in router.replicas
+                    if r.alive and r.state == "live"
+                ),
+            }
+
+        def probation_fn():
+            return [
+                r.replica_id for r in router.replicas
+                if r.alive and getattr(
+                    r.engine, "_prev_weights", None
+                ) is not None
+            ]
+
+        deploy_fn = router.deploy_active
+    sensors = Sensors(
+        slo=slo, hints_fn=hints_fn, journal=journal,
+        events_fn=events_fn, pressure_fn=pressure_fn,
+        fleet_fn=fleet_fn, probation_fn=probation_fn,
+        deploy_active_fn=deploy_fn, clock=clock,
+    )
+    acts = []
+    if cluster is not None:
+        acts.append(ClusterActuators(cluster))
+    if router is not None:
+        acts.append(FleetActuators(router))
+    if not acts:
+        actuators = Actuators()  # every verb journals as unsupported
+    elif len(acts) == 1:
+        actuators = acts[0]
+    else:
+        actuators = CombinedActuators(*acts)
+    if policies is None:
+        policies = default_policies(**overrides)
+    elif overrides:
+        raise ValueError(
+            "pass policy overrides OR an explicit policy list, "
+            "not both"
+        )
+    return RemediationEngine(
+        sensors, actuators, policies=policies,
+        guardrails=guardrails, interval=interval, clock=clock,
+    )

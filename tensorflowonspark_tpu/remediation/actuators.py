@@ -1,0 +1,172 @@
+"""Actuator bindings: the verbs the remediation engine may drive.
+
+An actuator object exposes (a subset of) the
+:data:`~tensorflowonspark_tpu.remediation.policy.ACTIONS` vocabulary
+as methods; the engine resolves ``intent.action`` by ``getattr`` and
+journals a failure instead of crashing when a verb is missing or
+raises.  Production wiring composes:
+
+- :class:`FleetActuators` — serving-side verbs over a
+  :class:`~tensorflowonspark_tpu.fleet.router.FleetRouter`:
+  spawn/retire replicas (PR 13's lifecycle verbs as autoscaling),
+  degrade/restore admission, and the SLO-probation rollback
+  (:func:`~tensorflowonspark_tpu.hot_swap.flag_probation_fault` over
+  every probation engine);
+- :class:`ClusterActuators` — training-side elastic shrink/grow over
+  a :class:`~tensorflowonspark_tpu.cluster.cluster.TPUCluster`:
+  ``hold_executor`` quiesces a straggler's compute and
+  re-rendezvouses the survivors at reduced width,
+  ``release_executor`` grows it back in;
+- :class:`CombinedActuators` — first-match dispatch over both.
+
+Tests pass a recording fake instead; the engine cannot tell the
+difference, which is the point — the decision/guardrail/audit layer
+is identical against fakes and against the live fleet.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+class UnsupportedAction(RuntimeError):
+    """This actuator set has no binding for the requested verb —
+    journaled as a failed decision, never a crash."""
+
+
+class Actuators(object):
+    """Base: every verb unsupported.  Subclass and override what the
+    deployment can actually drive."""
+
+    def elastic_shrink(self, executor, **kw):
+        raise UnsupportedAction("elastic_shrink unbound")
+
+    def elastic_grow(self, executor, **kw):
+        raise UnsupportedAction("elastic_grow unbound")
+
+    def spawn_replica(self, **kw):
+        raise UnsupportedAction("spawn_replica unbound")
+
+    def retire_replica(self, replica_id=None, **kw):
+        raise UnsupportedAction("retire_replica unbound")
+
+    def degrade_admission(self, **kw):
+        raise UnsupportedAction("degrade_admission unbound")
+
+    def restore_admission(self, **kw):
+        raise UnsupportedAction("restore_admission unbound")
+
+    def rollback_generation(self, replicas=None, **kw):
+        raise UnsupportedAction("rollback_generation unbound")
+
+
+class FleetActuators(Actuators):
+    """Serving-side verbs over a live FleetRouter."""
+
+    def __init__(self, router):
+        self.router = router
+        self._prior_policy = None
+
+    def spawn_replica(self, **kw):
+        return self.router.scale_up()
+
+    def retire_replica(self, replica_id=None, **kw):
+        rid = self.router.scale_down(replica_id)
+        if rid is None:
+            raise UnsupportedAction(
+                "no retirable replica (last live replica is never "
+                "retired)"
+            )
+        return rid
+
+    def degrade_admission(self, **kw):
+        prior = self.router.set_policy("degrade")
+        if self._prior_policy is None:
+            self._prior_policy = prior
+        return prior
+
+    def restore_admission(self, **kw):
+        prior, self._prior_policy = self._prior_policy, None
+        return self.router.set_policy(prior or "block")
+
+    def rollback_generation(self, replicas=None, **kw):
+        """Flag an SLO-probation fault on every (named) replica
+        engine still holding a rollback snapshot; each engine rolls
+        back between decode chunks on its own scheduling pass."""
+        from tensorflowonspark_tpu import hot_swap
+
+        flagged = []
+        for r in self.router.replicas:
+            if replicas is not None and r.replica_id not in replicas:
+                continue
+            if hot_swap.flag_probation_fault(
+                    r.engine, reason="slo_burn"):
+                flagged.append(r.replica_id)
+        if not flagged:
+            raise UnsupportedAction(
+                "no replica engine on post-swap probation — nothing "
+                "to roll back"
+            )
+        return flagged
+
+
+class ClusterActuators(Actuators):
+    """Training-side elastic shrink/grow over a TPUCluster (driver
+    side).  The supervisor on the held node quiesces its compute and
+    bumps the gang generation so survivors re-rendezvous at reduced
+    width (cluster/supervisor.py); release takes the same path back
+    to full width."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def elastic_shrink(self, executor, **kw):
+        return self.cluster.hold_executor(
+            executor, reason=kw.get("reason", "remediation")
+        )
+
+    def elastic_grow(self, executor, **kw):
+        return self.cluster.release_executor(executor)
+
+
+class CombinedActuators(Actuators):
+    """First-match dispatch over an ordered actuator list — the full
+    self-driving deployment binds ``CombinedActuators(
+    ClusterActuators(cluster), FleetActuators(router))``."""
+
+    def __init__(self, *actuators):
+        self.actuators = list(actuators)
+
+    def _dispatch(self, verb, *a, **kw):
+        last = None
+        for act in self.actuators:
+            try:
+                return getattr(act, verb)(*a, **kw)
+            except UnsupportedAction as e:
+                last = e
+        raise last or UnsupportedAction("%s unbound" % verb)
+
+    def elastic_shrink(self, executor, **kw):
+        return self._dispatch("elastic_shrink", executor, **kw)
+
+    def elastic_grow(self, executor, **kw):
+        return self._dispatch("elastic_grow", executor, **kw)
+
+    def spawn_replica(self, **kw):
+        return self._dispatch("spawn_replica", **kw)
+
+    def retire_replica(self, replica_id=None, **kw):
+        return self._dispatch(
+            "retire_replica", replica_id=replica_id, **kw
+        )
+
+    def degrade_admission(self, **kw):
+        return self._dispatch("degrade_admission", **kw)
+
+    def restore_admission(self, **kw):
+        return self._dispatch("restore_admission", **kw)
+
+    def rollback_generation(self, replicas=None, **kw):
+        return self._dispatch(
+            "rollback_generation", replicas=replicas, **kw
+        )

@@ -1,0 +1,409 @@
+"""Remediation policies: sensor snapshots in, typed intents out.
+
+A policy is a small stateful object scoring ONE failure signature
+against each :class:`~tensorflowonspark_tpu.remediation.engine.
+SensorSnapshot` and emitting :class:`Intent` records when it wants an
+actuator driven.  Policies carry their OWN hysteresis (``sustain``
+consecutive asserting rounds before the first intent — the engine's
+cooldowns then bound how often an intent may EXECUTE), and every
+intent names the evidence that justified it: the alert transition
+(with its ``seq`` cursor), the journal event ids
+``(executor, pid, seq)``, the straggler hint with its phase
+attribution, or the admission-pressure excerpt — whatever the policy
+actually read.  ``forensics explain`` renders that evidence back, so
+"why did the fleet do that?" has a literal answer in the journal.
+
+The default set (:func:`default_policies`) closes the four loops
+ISSUE 16 names:
+
+- :class:`StragglerPolicy` — straggler-flagged executor → elastic
+  shrink (hold + re-rendezvous at reduced width); N clean rounds →
+  elastic grow (release + re-rendezvous at full width);
+- :class:`AutoscalePolicy` — sustained admission pressure → spawn a
+  serving replica; sustained idle slots → retire one;
+- :class:`PageAlertPolicy` — page-severity SLO alert → degrade
+  admission (spill work instead of shedding it); resolve → restore;
+- :class:`SloRollbackPolicy` — SLO burn while a weight generation is
+  on post-swap probation → roll the generation back (extends PR 8's
+  probation from request errors to fleet-level SLOs);
+- :class:`FaultResponsePolicy` — journal fault events the lower
+  planes already handled: a dead replica is re-spawned (capacity
+  restore); automatic recoveries (leader re-election, checkpoint
+  quarantine) get an explicit ``stand_down`` decision so the journal
+  records that remediation saw the fault and deliberately did not
+  pile a second actuator on top of a recovery in progress.
+"""
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+#: the actuator verb vocabulary (attribute names on an Actuators
+#: implementation); ``stand_down`` is virtual — it never reaches an
+#: actuator, it IS the decision
+ACTIONS = (
+    "elastic_shrink", "elastic_grow", "spawn_replica",
+    "retire_replica", "degrade_admission", "restore_admission",
+    "rollback_generation", "stand_down",
+)
+
+
+class Intent(object):
+    """One policy's wish: drive ``action`` against ``target`` because
+    of ``evidence``.  Plain data; the engine turns it into an audited
+    decision (or a suppression)."""
+
+    __slots__ = ("action", "policy", "target", "evidence", "severity",
+                 "reason")
+
+    def __init__(self, action, policy, target=None, evidence=None,
+                 severity="warn", reason=""):
+        if action not in ACTIONS:
+            raise ValueError(
+                "unknown remediation action {0!r}; one of {1}".format(
+                    action, ACTIONS
+                )
+            )
+        self.action = action
+        self.policy = policy
+        self.target = dict(target or {})
+        self.evidence = dict(evidence or {})
+        self.severity = severity
+        self.reason = reason
+
+    def key(self):
+        """Cooldown identity: the action plus its stable target."""
+        return (self.action, tuple(sorted(self.target.items())))
+
+    def to_dict(self):
+        return {
+            "action": self.action, "policy": self.policy,
+            "target": self.target, "evidence": self.evidence,
+            "severity": self.severity, "reason": self.reason,
+        }
+
+    def __repr__(self):
+        return "Intent({0} by {1} on {2})".format(
+            self.action, self.policy, self.target
+        )
+
+
+class Policy(object):
+    """Base policy: subclasses set ``name`` and implement
+    :meth:`evaluate` returning a list of :class:`Intent`.  Policies
+    are single-threaded — only the engine's loop calls them."""
+
+    name = "policy"
+
+    def evaluate(self, snap):
+        raise NotImplementedError
+
+    def _intent(self, action, **kw):
+        return Intent(action, self.name, **kw)
+
+
+class StragglerPolicy(Policy):
+    """Elastic shrink/grow from the health plane's straggler hints.
+
+    An executor flagged for ``sustain`` consecutive rounds is shrunk
+    out of the gang (``elastic_shrink`` — the cluster actuator holds
+    its compute and re-rendezvouses the survivors at reduced width).
+    A held executor absent from the hints for ``grow_after``
+    consecutive rounds is grown back in (``elastic_grow``).  Evidence
+    is the hint itself — it carries the detector's phase attribution
+    (the measured dominant phase, feed/h2d/dispatch/wire/host), so
+    the decision names WHY the executor was slow, not just that it
+    was.
+    """
+
+    name = "straggler-elastic"
+
+    def __init__(self, sustain=2, grow_after=3):
+        self.sustain = max(1, int(sustain))
+        self.grow_after = max(1, int(grow_after))
+        self._rounds = {}        # executor -> consecutive flagged rounds
+        self._clean = {}         # held executor -> consecutive clean rounds
+        self.held = set()
+
+    def evaluate(self, snap):
+        out = []
+        hints = snap.hints or {}
+        for eid, hint in sorted(hints.items()):
+            if eid in self.held:
+                self._clean[eid] = 0
+                continue
+            self._rounds[eid] = self._rounds.get(eid, 0) + 1
+            if self._rounds[eid] >= self.sustain:
+                self.held.add(eid)
+                self._clean[eid] = 0
+                out.append(self._intent(
+                    "elastic_shrink", target={"executor": eid},
+                    evidence={"hint": dict(hint)},
+                    reason="straggler flagged {0} consecutive rounds "
+                           "(phase {1!r})".format(
+                               self._rounds[eid], hint.get("phase")
+                           ),
+                ))
+        for eid in list(self._rounds):
+            if eid not in hints:
+                self._rounds.pop(eid, None)
+        for eid in sorted(self.held):
+            if eid in hints:
+                continue
+            self._clean[eid] = self._clean.get(eid, 0) + 1
+            if self._clean[eid] >= self.grow_after:
+                self.held.discard(eid)
+                self._clean.pop(eid, None)
+                out.append(self._intent(
+                    "elastic_grow", target={"executor": eid},
+                    evidence={"clean_rounds": self.grow_after},
+                    severity="info",
+                    reason="held executor clean for {0} rounds".format(
+                        self.grow_after
+                    ),
+                ))
+        return out
+
+
+class AutoscalePolicy(Policy):
+    """Serving autoscale from the router's windowed admission
+    pressure (PR 13's lifecycle verbs as a closed loop): mean queue
+    occupancy above ``high`` (or any shedding) for ``sustain``
+    consecutive rounds spawns a replica; occupancy below ``low`` with
+    idle slots for ``sustain_down`` rounds retires one.  Bounded by
+    ``min_replicas``/``max_replicas`` so a runaway signal can never
+    scale to zero or to infinity.  Evidence is the pressure excerpt
+    itself — the SAME statistic ``/status`` shows an operator."""
+
+    name = "fleet-autoscale"
+
+    def __init__(self, high=0.75, low=0.10, sustain=3,
+                 sustain_down=6, min_replicas=1, max_replicas=8):
+        self.high = float(high)
+        self.low = float(low)
+        self.sustain = max(1, int(sustain))
+        self.sustain_down = max(1, int(sustain_down))
+        self.min_replicas = max(1, int(min_replicas))
+        self.max_replicas = int(max_replicas)
+        self._hot = 0
+        self._cold = 0
+
+    def evaluate(self, snap):
+        p = snap.pressure
+        fleet = snap.fleet or {}
+        if not p:
+            return []
+        live = int(fleet.get("live", fleet.get("replicas", 0)) or 0)
+        hot = (p.get("occupancy_mean", 0.0) >= self.high
+               or p.get("shed_per_sec", 0.0) > 0.0)
+        cold = (p.get("occupancy_peak", 0.0) <= self.low
+                and p.get("free_slots", 0) > 0
+                and p.get("shed_per_sec", 0.0) == 0.0)
+        self._hot = self._hot + 1 if hot else 0
+        self._cold = self._cold + 1 if cold else 0
+        excerpt = {k: p.get(k) for k in (
+            "window_sec", "occupancy", "occupancy_mean",
+            "occupancy_peak", "shed_per_sec", "spill_per_sec",
+            "free_slots",
+        )}
+        if self._hot >= self.sustain and live < self.max_replicas:
+            self._hot = 0
+            return [self._intent(
+                "spawn_replica", evidence={"pressure": excerpt},
+                reason="admission pressure sustained {0} rounds "
+                       "(occupancy_mean {1}, shed/s {2})".format(
+                           self.sustain, excerpt["occupancy_mean"],
+                           excerpt["shed_per_sec"],
+                       ),
+            )]
+        if self._cold >= self.sustain_down and live > self.min_replicas:
+            self._cold = 0
+            return [self._intent(
+                "retire_replica", evidence={"pressure": excerpt},
+                severity="info",
+                reason="idle slots sustained {0} rounds".format(
+                    self.sustain_down
+                ),
+            )]
+        return []
+
+
+class PageAlertPolicy(Policy):
+    """Degrade admission on any PAGE-severity alert firing; restore
+    when the pages that caused the degrade have all resolved.
+    Evidence is the alert transition (with its ``alerts_since``
+    cursor seq) — the decision and the page that caused it share a
+    journal-visible id."""
+
+    name = "page-degrade"
+
+    def __init__(self):
+        self._paging = {}   # rule -> firing alert dict
+        self.degraded = False
+
+    def evaluate(self, snap):
+        out = []
+        for a in snap.alerts:
+            if a.get("severity") != "page":
+                continue
+            if a.get("state") == "firing":
+                self._paging[a.get("rule")] = dict(a)
+            elif a.get("state") == "resolved":
+                self._paging.pop(a.get("rule"), None)
+        if self._paging and not self.degraded:
+            self.degraded = True
+            worst = sorted(self._paging.values(),
+                           key=lambda d: d.get("seq", 0))[-1]
+            out.append(self._intent(
+                "degrade_admission",
+                evidence={"alert": worst,
+                          "paging_rules": sorted(self._paging)},
+                severity="page",
+                reason="page alert {0!r} firing".format(
+                    worst.get("rule")
+                ),
+            ))
+        elif not self._paging and self.degraded:
+            self.degraded = False
+            out.append(self._intent(
+                "restore_admission",
+                evidence={"resolved": True}, severity="info",
+                reason="all page alerts resolved",
+            ))
+        return out
+
+
+class SloRollbackPolicy(Policy):
+    """Roll a weight generation back when fleet-level SLO burn
+    exceeds budget while the generation is still on post-swap
+    probation — PR 8's probation window, extended from request-level
+    errors to the SLO plane.  Fires on ``burn:`` / ``burn_rate``
+    alerts (any warn+ severity) only when ``snap.probation`` names
+    replicas whose engines hold a rollback snapshot; the rollback
+    itself is the engine's own (applied between decode chunks, via
+    :func:`~tensorflowonspark_tpu.hot_swap.flag_probation_fault`)."""
+
+    name = "slo-rollback"
+
+    def __init__(self, rules=None):
+        #: None = any firing alert whose rule name contains "burn" or
+        #: whose message names a burn_rate breach; else an explicit
+        #: rule-name allowlist
+        self.rules = set(rules) if rules else None
+
+    def _matches(self, a):
+        if self.rules is not None:
+            return a.get("rule") in self.rules
+        rule = a.get("rule") or ""
+        return "burn" in rule or "burn_rate" in (a.get("message") or "")
+
+    def evaluate(self, snap):
+        if not snap.probation:
+            return []
+        for a in snap.alerts:
+            if a.get("state") == "firing" and self._matches(a):
+                return [self._intent(
+                    "rollback_generation",
+                    target={"replicas": sorted(snap.probation)},
+                    evidence={"alert": dict(a),
+                              "probation": sorted(snap.probation)},
+                    severity="page",
+                    reason="SLO burn {0!r} while generation on "
+                           "probation".format(a.get("rule")),
+                )]
+        return []
+
+
+#: journal fault kinds → the policy's response action.  Faults whose
+#: recovery is ALREADY owned by a lower plane get an explicit
+#: ``stand_down`` decision — the audit trail must show remediation
+#: saw the fault and chose not to fight the recovery in progress,
+#: the same philosophy as the deploy-conflict guardrail.
+FAULT_RESPONSES = {
+    "replica_dead": "spawn_replica",
+    "leader_failover": "stand_down",
+    "swap_rollback": "stand_down",
+    "checkpoint_quarantined": "stand_down",
+    "deploy_halted": "stand_down",
+}
+
+
+class FaultResponsePolicy(Policy):
+    """Respond to journal FAULT events (:data:`FAULT_RESPONSES`):
+    re-spawn capacity lost to a replica death, and stand down —
+    explicitly, in the journal — where a lower plane's automatic
+    recovery (leader re-election, probation rollback, checkpoint
+    quarantine, deploy halt) already owns the fault.  Evidence is the
+    triggering event's ``(kind, executor, pid, seq)`` id, the exact
+    coordinates ``forensics explain`` aligns on its timeline."""
+
+    name = "fault-response"
+
+    def __init__(self, responses=None):
+        self.responses = dict(
+            FAULT_RESPONSES if responses is None else responses
+        )
+
+    def evaluate(self, snap):
+        out = []
+        for ev in snap.events:
+            action = self.responses.get(ev.get("kind"))
+            if action is None:
+                continue
+            evid = {"event": {
+                k: ev.get(k)
+                for k in ("kind", "executor", "pid", "seq", "t", "ts")
+                if ev.get(k) is not None
+            }}
+            attrs = ev.get("attrs") or {}
+            for k in ("replica_id", "replica", "rule", "step",
+                      "request_ids"):
+                if k in attrs:
+                    evid["event"][k] = attrs[k]
+            target = {}
+            if action == "stand_down":
+                # cooldowns key on (action, target): standing down for
+                # a leader failover must not suppress the stand-down
+                # for a checkpoint quarantine seconds later — each
+                # fault kind is its own decision
+                target = {"fault": ev.get("kind")}
+            if action == "spawn_replica":
+                # the router's live mark says ``replica``; shipped
+                # exports may say ``replica_id``
+                evid["lost_replica"] = attrs.get(
+                    "replica_id", attrs.get("replica")
+                )
+            out.append(self._intent(
+                action, target=target, evidence=evid,
+                severity="info" if action == "stand_down" else "warn",
+                reason="journal fault {0!r}".format(ev.get("kind")),
+            ))
+        return out
+
+
+def default_policies(**overrides):
+    """The standard policy set.  Keyword overrides replace the knobs
+    of the matching policy, e.g. ``default_policies(
+    autoscale={"high": 0.5}, straggler={"sustain": 3})``; pass
+    ``<name>=None`` to drop one."""
+    specs = {
+        "straggler": (StragglerPolicy, overrides.pop("straggler", {})),
+        "autoscale": (AutoscalePolicy, overrides.pop("autoscale", {})),
+        "page": (PageAlertPolicy, overrides.pop("page", {})),
+        "slo_rollback": (
+            SloRollbackPolicy, overrides.pop("slo_rollback", {})
+        ),
+        "faults": (
+            FaultResponsePolicy, overrides.pop("faults", {})
+        ),
+    }
+    if overrides:
+        raise ValueError(
+            "unknown policy overrides {0}".format(sorted(overrides))
+        )
+    out = []
+    for _key, (cls, kw) in specs.items():
+        if kw is None:
+            continue
+        out.append(cls(**kw))
+    return out

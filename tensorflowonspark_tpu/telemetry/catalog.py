@@ -99,6 +99,9 @@ METRICS = tuple(
          ("fleet.replica_deaths", "replica worker deaths observed"),
          ("fleet.evictions", "slow replicas routed around"),
          ("fleet.readmissions", "probed replicas re-admitted"))
+    + _m(_C, "fleet.FleetRouter remediation verbs",
+         ("fleet.replicas_spawned", "replicas added by scale_up"),
+         ("fleet.replicas_retired", "replicas drained away by scale_down"))
     + _m(_G, "fleet.FleetRouter",
          ("fleet.live_replicas", "replicas currently taking dispatch"))
     # --- radix prefix cache (prefix_cache.py) ---
@@ -176,6 +179,17 @@ METRICS = tuple(
     + _m(_C, "analysis.locksan",
          ("locksan.locks", "instrumented locks created"),
          ("locksan.cycles", "potential-deadlock cycles reported"))
+    # --- remediation engine (remediation/engine.py, ISSUE 16) ---
+    + _m(_C, "remediation.RemediationEngine",
+         ("remediation.decisions", "policy intents that reached the audit log"),
+         ("remediation.actions_executed", "actuator verbs actually invoked"),
+         ("remediation.actions_suppressed",
+          "intents stopped by a cooldown or rate limit"),
+         ("remediation.actions_deferred",
+          "intents parked by the deploy-conflict rule"))
+    + _m(_G, "remediation.RemediationEngine",
+         ("remediation.budget_remaining",
+          "global action budget left before hands-off"))
 )
 
 #: families whose full names are minted at runtime — a literal name

@@ -303,10 +303,11 @@ class Alert(object):
     bench record unchanged."""
 
     __slots__ = ("rule", "state", "value", "threshold", "window",
-                 "severity", "executor", "t", "message")
+                 "severity", "executor", "t", "message", "seq")
 
     def __init__(self, rule, state, value, threshold, window,
-                 severity="warn", executor=None, t=None, message=""):
+                 severity="warn", executor=None, t=None, message="",
+                 seq=0):
         self.rule = rule
         self.state = state            # "firing" | "resolved"
         self.value = value
@@ -316,6 +317,9 @@ class Alert(object):
         self.executor = executor
         self.t = time.time() if t is None else t
         self.message = message
+        # monotonic per-engine transition id, stamped by SloEngine when
+        # the transition enters history; cursor key for alerts_since()
+        self.seq = seq
 
     def to_dict(self):
         return {k: getattr(self, k) for k in self.__slots__}
@@ -607,6 +611,16 @@ class SloEngine(object):
             for r in self.rules
         }
         self.history = collections.deque(maxlen=self.MAX_HISTORY)
+        # monotonic transition counter; every Alert appended to history
+        # carries the next value so cursor readers (alerts_since) can
+        # detect both new transitions AND ones that aged out of the
+        # bounded deque between polls.
+        self._alert_seq = 0
+
+    def _stamp(self, alert):
+        self._alert_seq += 1
+        alert.seq = self._alert_seq
+        return alert
 
     def _evaluate_rule(self, rule):
         """Worst-case breach across the rule's scope (fleet, or each
@@ -647,7 +661,7 @@ class SloEngine(object):
                         ),
                     )
                     transitions.append(a)
-                    self.history.append(a)
+                    self.history.append(self._stamp(a))
                     self._m_fired.inc()
                     # the mark's severity IS the rule's severity — a
                     # page-severity firing is a flight-recorder dump
@@ -677,7 +691,7 @@ class SloEngine(object):
                             message="%s: recovered" % rule.name,
                         )
                         transitions.append(a)
-                        self.history.append(a)
+                        self.history.append(self._stamp(a))
                         self._m_resolved.inc()
                         self._tracer.mark(
                             "alert_resolved", trace="slo", rule=rule.name,
@@ -709,6 +723,28 @@ class SloEngine(object):
         if limit is not None:
             out = out[-int(limit):]
         return out
+
+    @property
+    def last_alert_seq(self):
+        """Seq of the newest transition ever stamped (0 before the
+        first) — NOT the oldest one still in the bounded history."""
+        return self._alert_seq
+
+    def alerts_since(self, seq):
+        """Cursor read over alert transitions (mirrors the journal's
+        shipping cursors): every :class:`Alert` whose ``seq`` is
+        strictly greater than ``seq``, oldest first.
+
+        ``alert_history`` is a bounded deque, so a subscriber attaching
+        late or polling slowly can miss a fired→resolved edge entirely
+        if it diff's the rendered history.  A cursor makes the gap
+        *detectable*: if the first returned alert's seq is not
+        ``seq + 1`` (or, on an empty result, ``last_alert_seq > seq``),
+        transitions aged out before the caller saw them and it should
+        resync from :meth:`active` rather than assume continuity.  The
+        remediation policy engine polls through this API."""
+        seq = int(seq)
+        return [a for a in list(self.history) if a.seq > seq]
 
 
 # ----------------------------------------------------------------------
@@ -991,6 +1027,11 @@ class HealthPlane(object):
         self.hints = {}
         self._hinted = set()  # (executor, phase) already actioned
         self._clean_rounds = {}  # executor → consecutive unflagged rounds
+        #: push subscribers (``fn(alert)`` per SLO transition, called
+        #: from the scrape thread); the remediation engine prefers the
+        #: pull-side ``slo.alerts_since`` cursor, but push consumers
+        #: (bench recorders, paging bridges) hook here
+        self._alert_listeners = []
         self.started_at = time.time()
         self._stop = threading.Event()
         self._thread = None
@@ -1078,7 +1119,23 @@ class HealthPlane(object):
                 self._diagnose()
             except Exception:  # noqa: BLE001 - diagnosis is advisory
                 logger.warning("straggler diagnosis failed", exc_info=True)
+        for a in transitions:
+            for fn in list(self._alert_listeners):
+                try:
+                    fn(a)
+                except Exception:  # noqa: BLE001 - a bad subscriber
+                    logger.warning(  # must not stall the scrape loop
+                        "alert listener failed", exc_info=True
+                    )
         return transitions
+
+    def add_alert_listener(self, fn):
+        """Subscribe ``fn(alert)`` to every SLO transition (firing and
+        resolved), called inline from the scrape thread — keep it
+        cheap and never raise.  For at-most-once edge delivery with
+        gap detection use ``plane.slo.alerts_since(seq)`` instead."""
+        self._alert_listeners.append(fn)
+        return fn
 
     def _diagnose(self):
         try:

@@ -204,6 +204,81 @@ class ChaosPlan(object):
         )
         return self
 
+    @classmethod
+    def combined(cls, slow_executor=None, kill_leader=None,
+                 kill_replica=None, corrupt_checkpoint=None):
+        """The ROADMAP's combined fault storm as ONE plan (ISSUE 16):
+        ``slow_executor + kill_leader + kill_replica +
+        corrupt_checkpoint``, each argument a dict of that builder's
+        kwargs plus an optional ``at_sec`` wall-clock trigger offset
+        (seconds from harness start) the DRIVING harness schedules
+        by — the in-band triggers (``at_window``/``at_chunk``/feed
+        pulls) still gate exactly when each fault lands inside its
+        subsystem.
+
+        ``corrupt_checkpoint`` has no in-band hook (it is the
+        driver-side :func:`corrupt_checkpoint` applied to a published
+        export), so its record only carries ``corrupt_kind`` +
+        ``at_sec`` for the harness; executors ignore it.  Example::
+
+            plan = ChaosPlan.combined(
+                slow_executor={"executor_id": 1,
+                               "per_batch_sec": 0.4, "at_sec": 2},
+                kill_leader={"at_window": 3, "at_sec": 5},
+                kill_replica={"replica_id": 1, "at_chunk": 4,
+                              "at_sec": 8},
+                corrupt_checkpoint={"corrupt_kind": "truncate_array",
+                                    "at_sec": 11},
+            )
+
+        The remediation acceptance e2e drives this plan against a
+        live training cluster + fleet and requires one audited
+        decision per fault (tests/test_remediation.py).
+        """
+        plan = cls()
+
+        def _take(spec, builder):
+            spec = dict(spec)
+            at_sec = spec.pop("at_sec", None)
+            builder(**spec)
+            if at_sec is not None:
+                plan.faults[-1]["at_sec"] = float(at_sec)
+
+        if slow_executor is not None:
+            _take(slow_executor, plan.slow_executor)
+        if kill_leader is not None:
+            _take(kill_leader, plan.kill_leader)
+        if kill_replica is not None:
+            _take(kill_replica, plan.kill_replica)
+        if corrupt_checkpoint is not None:
+            spec = dict(corrupt_checkpoint)
+            kind = spec.pop("corrupt_kind", spec.pop("kind", None))
+            if kind not in CORRUPT_KINDS:
+                raise ValueError(
+                    "corrupt_checkpoint needs corrupt_kind in {0}, "
+                    "got {1!r}".format(CORRUPT_KINDS, kind)
+                )
+            fault = {"kind": "corrupt_checkpoint", "corrupt_kind": kind}
+            if "at_sec" in spec:
+                fault["at_sec"] = float(spec.pop("at_sec"))
+            if spec:
+                raise ValueError(
+                    "unknown corrupt_checkpoint keys {0}".format(
+                        sorted(spec)
+                    )
+                )
+            plan.faults.append(fault)
+        return plan
+
+    def schedule(self):
+        """``(at_sec, fault)`` pairs sorted by trigger time (faults
+        with no ``at_sec`` sort first at 0.0) — the harness-side view
+        of a :meth:`combined` plan."""
+        return sorted(
+            ((float(f.get("at_sec", 0.0)), f) for f in self.faults),
+            key=lambda p: p[0],
+        )
+
     def save(self, path):
         path = os.fspath(path)
         with open(path, "w") as f:
