@@ -11,7 +11,10 @@ One evaluation round (:meth:`RemediationEngine.step`):
    straggler hints, the router's windowed admission pressure, the
    probation set, and the deploy-in-progress flag.
 2. Each policy turns the snapshot into :class:`~tensorflowonspark_tpu.
-   remediation.policy.Intent` records (policies own hysteresis).
+   remediation.policy.Intent` records (policies own hysteresis, and
+   their latches move on the engine's EXECUTION feedback —
+   ``Policy.on_decision`` — never on emission, so a suppressed or
+   failed action stays asserted and is retried).
 3. Guardrails gate execution, in order: the **conflict rule** (an
    in-progress RollingDeploy or hot-swap transaction defers ALL
    remediation — one ``remediation_deferred`` journal event per
@@ -24,7 +27,9 @@ One evaluation round (:meth:`RemediationEngine.step`):
    engine stops acting entirely until :meth:`RemediationEngine.rearm`).
 4. What survives executes through the pluggable
    :class:`~tensorflowonspark_tpu.remediation.actuators.Actuators`
-   (or is only journaled, in **dry-run** mode) and is journaled as a
+   (or is only journaled, in **dry-run** mode — which charges
+   neither the rate limit nor the budget, so a rehearsal previews
+   every intended action) and is journaled as a
    typed ``remediation_decision`` event carrying the policy name, the
    action, the target, and the TRIGGERING EVIDENCE (alert with its
    cursor seq, journal event ids, pressure/hint excerpt) — so
@@ -190,7 +195,12 @@ class Guardrails(object):
       ``remediation_budget_exhausted`` at PAGE severity and the
       engine goes hands-off (a self-driving loop that has acted this
       many times without converging is the incident);
-    - ``dry_run``: journal every intended action, execute none.
+    - ``dry_run``: journal every intended action, execute none, and
+      charge neither the rate limit nor the budget — rehearsals are
+      free, and the preview's audit trail is complete (a dry run
+      that rate-limited intents away would journal a DIFFERENT
+      sequence than the operator asked to preview).  Cooldown dedup
+      still applies, bounding journal spam from a flapping sensor.
 
     ``stand_down`` decisions are exempt from rate limit and budget
     (they ARE the non-action), but still cooldown-deduped.
@@ -352,7 +362,15 @@ class RemediationEngine(object):
         self._conflict_streak = False
         out = []
         for intent in intents:
-            rec = self._consider(intent, snap)
+            try:
+                rec = self._consider(intent, snap)
+            except Exception:  # noqa: BLE001 - one bad intent must not
+                rec = None     # drop the rest of the round
+                self.stats["failed"] += 1
+                logger.warning(
+                    "remediation intent %r failed", intent,
+                    exc_info=True,
+                )
             if rec is not None:
                 out.append(rec)
         return out
@@ -368,8 +386,12 @@ class RemediationEngine(object):
             self._m_suppressed.inc()
             return None
         virtual = intent.action == "stand_down"
-        if not virtual:
-            # rolling rate limit across all actions
+        if not virtual and not g.dry_run:
+            # rolling rate limit across all actions.  Dry-run is
+            # exempt (and charges nothing below): a rehearsal must
+            # journal EVERY intended action — rate-limit/budget
+            # suppression would silence part of the preview's audit
+            # trail without any actuator having moved.
             horizon = now - g.rate_window_sec
             while self._exec_times and self._exec_times[0] < horizon:
                 self._exec_times.popleft()
@@ -395,13 +417,31 @@ class RemediationEngine(object):
                     exc_info=True,
                 )
         self._last_exec[intent.key()] = now
-        if not virtual and (executed or g.dry_run):
+        if executed:
             self._exec_times.append(now)
-            self.stats["budget_spent"] += 0 if g.dry_run else 1
+            self.stats["budget_spent"] += 1
             self._m_budget.set(self.budget_remaining())
-        return self._journal_decision(
+        rec = self._journal_decision(
             intent, snap, executed=executed, error=error
         )
+        self._notify(rec)
+        return rec
+
+    def _notify(self, rec):
+        """Execution feedback: report the journaled decision back to
+        the policy that emitted it, so hysteresis latches move on
+        what actually HAPPENED (executed / dry-run / failed), not on
+        what was wished for."""
+        for p in self.policies:
+            if p.name != rec["policy"]:
+                continue
+            try:
+                p.on_decision(rec)
+            except Exception:  # noqa: BLE001 - feedback must not
+                logger.warning(  # kill the round
+                    "remediation policy %r on_decision failed",
+                    p.name, exc_info=True,
+                )
 
     def _exhaust(self, intent):
         """Budget exhausted: one PAGE event, then hands-off."""

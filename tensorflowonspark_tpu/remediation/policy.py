@@ -13,6 +13,14 @@ attribution, or the admission-pressure excerpt — whatever the policy
 actually read.  ``forensics explain`` renders that evidence back, so
 "why did the fleet do that?" has a literal answer in the journal.
 
+Hysteresis state flips on EXECUTION feedback, not on emission: the
+engine reports every journaled decision back through
+:meth:`Policy.on_decision`, and only an executed (or dry-run
+rehearsed) action moves a policy's latches (``held``, ``degraded``).
+An intent suppressed by the rate limit / budget or failed by the
+actuator leaves the policy asserting, so the action is retried once
+the guardrails allow — a page can never wedge half-applied.
+
 The default set (:func:`default_policies`) closes the four loops
 ISSUE 16 names:
 
@@ -48,6 +56,17 @@ ACTIONS = (
 )
 
 
+def _freeze(v):
+    """Recursively turn ``v`` into a hashable canonical form."""
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, (set, frozenset)):
+        return tuple(sorted(_freeze(x) for x in v))
+    return v
+
+
 class Intent(object):
     """One policy's wish: drive ``action`` against ``target`` because
     of ``evidence``.  Plain data; the engine turns it into an audited
@@ -72,8 +91,11 @@ class Intent(object):
         self.reason = reason
 
     def key(self):
-        """Cooldown identity: the action plus its stable target."""
-        return (self.action, tuple(sorted(self.target.items())))
+        """Cooldown identity: the action plus its stable target.
+        Target values are canonicalized (lists/dicts/sets frozen) so
+        the key is always hashable — ``rollback_generation`` targets
+        a replica LIST."""
+        return (self.action, _freeze(self.target))
 
     def to_dict(self):
         return {
@@ -98,6 +120,21 @@ class Policy(object):
     def evaluate(self, snap):
         raise NotImplementedError
 
+    def on_decision(self, rec):
+        """Execution feedback: the engine calls this with every
+        decision record it journals for this policy (``executed``,
+        ``dry_run``, ``error`` tell the outcome).  Suppressed intents
+        get NO callback — stateful policies flip their hysteresis
+        latches only here, so a suppressed or failed action is
+        re-intended and retried once the guardrails allow."""
+
+    @staticmethod
+    def _acted(rec):
+        """True when the decision took effect (a dry-run rehearsal
+        counts — the preview must walk the same state sequence the
+        armed engine would)."""
+        return bool(rec.get("executed") or rec.get("dry_run"))
+
     def _intent(self, action, **kw):
         return Intent(action, self.name, **kw)
 
@@ -114,6 +151,12 @@ class StragglerPolicy(Policy):
     (the measured dominant phase, feed/h2d/dispatch/wire/host), so
     the decision names WHY the executor was slow, not just that it
     was.
+
+    ``held`` moves on execution feedback (:meth:`on_decision`), never
+    on emission: a shrink suppressed by the rate limit or failed by
+    the actuator leaves the executor un-held and the intent retried,
+    and a grow is never emitted for an executor that was never
+    actually held.
     """
 
     name = "straggler-elastic"
@@ -134,8 +177,6 @@ class StragglerPolicy(Policy):
                 continue
             self._rounds[eid] = self._rounds.get(eid, 0) + 1
             if self._rounds[eid] >= self.sustain:
-                self.held.add(eid)
-                self._clean[eid] = 0
                 out.append(self._intent(
                     "elastic_shrink", target={"executor": eid},
                     evidence={"hint": dict(hint)},
@@ -152,17 +193,29 @@ class StragglerPolicy(Policy):
                 continue
             self._clean[eid] = self._clean.get(eid, 0) + 1
             if self._clean[eid] >= self.grow_after:
-                self.held.discard(eid)
-                self._clean.pop(eid, None)
                 out.append(self._intent(
                     "elastic_grow", target={"executor": eid},
-                    evidence={"clean_rounds": self.grow_after},
+                    evidence={"clean_rounds": self._clean[eid]},
                     severity="info",
                     reason="held executor clean for {0} rounds".format(
-                        self.grow_after
+                        self._clean[eid]
                     ),
                 ))
         return out
+
+    def on_decision(self, rec):
+        if not self._acted(rec):
+            return
+        eid = (rec.get("target") or {}).get("executor")
+        if eid is None:
+            return
+        if rec.get("action") == "elastic_shrink":
+            self.held.add(eid)
+            self._rounds.pop(eid, None)
+            self._clean[eid] = 0
+        elif rec.get("action") == "elastic_grow":
+            self.held.discard(eid)
+            self._clean.pop(eid, None)
 
 
 class AutoscalePolicy(Policy):
@@ -233,7 +286,12 @@ class PageAlertPolicy(Policy):
     when the pages that caused the degrade have all resolved.
     Evidence is the alert transition (with its ``alerts_since``
     cursor seq) — the decision and the page that caused it share a
-    journal-visible id."""
+    journal-visible id.
+
+    ``degraded`` flips on execution feedback (:meth:`on_decision`):
+    a degrade suppressed or failed while the pages still fire is
+    re-intended every round until it actually lands — the latch can
+    never read "degraded" while admission was left untouched."""
 
     name = "page-degrade"
 
@@ -251,7 +309,6 @@ class PageAlertPolicy(Policy):
             elif a.get("state") == "resolved":
                 self._paging.pop(a.get("rule"), None)
         if self._paging and not self.degraded:
-            self.degraded = True
             worst = sorted(self._paging.values(),
                            key=lambda d: d.get("seq", 0))[-1]
             out.append(self._intent(
@@ -264,13 +321,20 @@ class PageAlertPolicy(Policy):
                 ),
             ))
         elif not self._paging and self.degraded:
-            self.degraded = False
             out.append(self._intent(
                 "restore_admission",
                 evidence={"resolved": True}, severity="info",
                 reason="all page alerts resolved",
             ))
         return out
+
+    def on_decision(self, rec):
+        if not self._acted(rec):
+            return
+        if rec.get("action") == "degrade_admission":
+            self.degraded = True
+        elif rec.get("action") == "restore_admission":
+            self.degraded = False
 
 
 class SloRollbackPolicy(Policy):
@@ -370,9 +434,13 @@ class FaultResponsePolicy(Policy):
             if action == "spawn_replica":
                 # the router's live mark says ``replica``; shipped
                 # exports may say ``replica_id``
-                evid["lost_replica"] = attrs.get(
-                    "replica_id", attrs.get("replica")
-                )
+                rid = attrs.get("replica_id", attrs.get("replica"))
+                evid["lost_replica"] = rid
+                # cooldowns key on (action, target): each lost
+                # replica is its own respawn decision, so a
+                # multi-death storm restores EVERY death instead of
+                # collapsing into one cooldown-suppressed spawn
+                target = {"lost_replica": rid}
             out.append(self._intent(
                 action, target=target, evidence=evid,
                 severity="info" if action == "stand_down" else "warn",

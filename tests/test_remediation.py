@@ -309,17 +309,28 @@ class TestPolicies:
         p = StragglerPolicy(sustain=2, grow_after=2)
         hint = {"executor": 3, "phase": "feed", "ratio": 2.4}
         snap = lambda hints: remediation.SensorSnapshot(hints=hints)  # noqa: E731
+        ok = lambda i: p.on_decision(  # noqa: E731 - the engine's
+            dict(i.to_dict(), executed=True))  # execution feedback
         assert p.evaluate(snap({3: hint})) == []       # 1 round
         (shrink,) = p.evaluate(snap({3: hint}))        # sustained
         assert shrink.action == "elastic_shrink"
         assert shrink.target == {"executor": 3}
         assert shrink.evidence["hint"]["phase"] == "feed"
+        # not executed yet (suppressed/failed): the shrink is
+        # re-intended, and the executor is NOT considered held
+        (again,) = p.evaluate(snap({3: hint}))
+        assert again.action == "elastic_shrink"
+        assert p.held == set()
+        ok(shrink)
+        assert p.held == {3}
         # held: further hints do NOT re-intend (policy hysteresis)
         assert p.evaluate(snap({3: hint})) == []
         assert p.evaluate(snap({})) == []              # 1 clean round
         (grow,) = p.evaluate(snap({}))                 # 2nd -> grow
         assert grow.action == "elastic_grow"
         assert grow.target == {"executor": 3}
+        assert p.held == {3}   # still held until the grow EXECUTES
+        ok(grow)
         assert p.held == set()
 
     def test_autoscale_spawns_hot_retires_cold(self):
@@ -355,12 +366,22 @@ class TestPolicies:
         assert deg.action == "degrade_admission"
         assert deg.severity == "page"
         assert deg.evidence["alert"]["seq"] == 7
+        # until the engine reports execution the degrade is
+        # re-intended (a suppressed/failed degrade must be retried
+        # while the pages still fire)
+        (again,) = p.evaluate(remediation.SensorSnapshot())
+        assert again.action == "degrade_admission"
+        assert p.degraded is False
+        p.on_decision(dict(deg.to_dict(), executed=True))
+        assert p.degraded is True
         # still paging: no duplicate intent
         assert p.evaluate(remediation.SensorSnapshot()) == []
         (res,) = p.evaluate(
             remediation.SensorSnapshot(alerts=[resolve])
         )
         assert res.action == "restore_admission"
+        p.on_decision(dict(res.to_dict(), executed=True))
+        assert p.degraded is False
 
     def test_slo_rollback_requires_probation(self):
         p = SloRollbackPolicy()
@@ -385,6 +406,9 @@ class TestPolicies:
         (spawn,) = p.evaluate(remediation.SensorSnapshot(events=[ev]))
         assert spawn.action == "spawn_replica"
         assert spawn.evidence["lost_replica"] == 1
+        # the respawn is keyed per lost replica, so cooldowns never
+        # collapse two distinct deaths into one decision
+        assert spawn.target == {"lost_replica": 1}
         assert spawn.evidence["event"]["seq"] == 41
         assert spawn.evidence["event"]["request_ids"] == [3, 4]
         (sd,) = p.evaluate(remediation.SensorSnapshot(
@@ -395,6 +419,19 @@ class TestPolicies:
     def test_intent_rejects_unknown_action(self):
         with pytest.raises(ValueError, match="unknown remediation"):
             Intent("reboot_datacenter", "p")
+
+    def test_intent_key_is_hashing_safe(self):
+        # regression: rollback_generation targets a replica LIST —
+        # key() must canonicalize unhashable values, recursively
+        a = Intent("rollback_generation", "p",
+                   target={"replicas": [0, 2], "meta": {"x": [1]}})
+        b = Intent("rollback_generation", "p",
+                   target={"meta": {"x": [1]}, "replicas": [0, 2]})
+        assert a.key() == b.key()            # dict-order insensitive
+        assert {a.key(): "cooldown"}[b.key()] == "cooldown"
+        assert Intent("stand_down", "p",
+                      target={"s": {3, 1}}).key() == \
+            ("stand_down", (("s", (1, 3)),))
 
     def test_default_policies_overrides(self):
         ps = default_policies(straggler={"sustain": 5}, faults=None)
@@ -597,6 +634,27 @@ class TestGuardrails:
         assert eng.budget_remaining() == 3
         assert eng.armed
 
+    def test_dry_run_exempt_from_rate_limit_and_budget(self):
+        # dry-run charges NEITHER the rate limit nor the budget: the
+        # rehearsal must journal every intended action — a dry run
+        # that rate-limited intents away (or went hands-off) would
+        # preview a different sequence than the armed engine's
+        # decision logic, with zero actuators moved either way
+        clock = _Clock()
+        eng, acts = _engine(
+            _Feed(), clock, [_AlwaysPolicy(unique_targets=True)],
+            guardrails=Guardrails(cooldown_sec=0.0, rate_limit=1,
+                                  budget=1, dry_run=True),
+        )
+        recs = []
+        for _ in range(5):
+            recs.extend(eng.step())
+            clock.tick(1.0)
+        assert len(recs) == 5             # every intent journaled
+        assert acts.calls == []
+        assert eng.stats["suppressed"] == 0
+        assert eng.budget_remaining() == 1 and eng.armed
+
     def test_deploy_conflict_defers_everything(self):
         j = telemetry.get_journal()
         before = len(j.events(kind="remediation_deferred"))
@@ -621,6 +679,141 @@ class TestGuardrails:
         clock.tick(1.0)
         eng.step()
         assert len(j.events(kind="remediation_deferred")) == before + 2
+
+    def test_rollback_generation_executes_through_the_engine(self):
+        # regression: target={"replicas": [...]} used to make
+        # intent.key() unhashable — the rollback crashed step() and
+        # the SLO-probation loop never closed
+        clock = _Clock()
+        slo = _FakeSlo()
+        feed = _Feed()
+        feed.probation = [0, 2]
+        eng, acts = _engine(
+            feed, clock, [SloRollbackPolicy()],
+            guardrails=Guardrails(cooldown_sec=30.0, budget=10),
+            slo=slo,
+        )
+        slo.fire(rule="serving-burn", severity="page")
+        (d,) = eng.step()
+        assert d["action"] == "rollback_generation"
+        assert d["executed"] is True
+        assert d["target"] == {"replicas": [0, 2]}
+        assert acts.of("rollback_generation") == [
+            ("rollback_generation", {"replicas": [0, 2]})
+        ]
+        # the same burn inside the cooldown window is suppressed
+        # (the cooldown lookup is the line that used to raise)
+        clock.tick(1.0)
+        slo.fire(rule="serving-burn", severity="page")
+        assert eng.step() == []
+        assert eng.stats["suppressed"] == 1
+
+    def test_bad_intent_does_not_drop_the_rest_of_the_round(self):
+        # crash isolation is per intent, not per round: one bad
+        # intent (here a key() that raises) must not swallow the
+        # other policies' decisions
+        class _BadKey(Intent):
+            def key(self):
+                raise TypeError("rigged key")
+
+        class _Bad(Policy):
+            name = "bad"
+
+            def evaluate(self, snap):
+                return [_BadKey("retire_replica", self.name)]
+
+        clock = _Clock()
+        eng, acts = _engine(_Feed(), clock, [_Bad(), _AlwaysPolicy()])
+        (d,) = eng.step()
+        assert d["policy"] == "always" and d["executed"]
+        assert len(acts.of("spawn_replica")) == 1
+        assert eng.stats["failed"] == 1
+
+    def test_multi_death_storm_respawns_each_replica(self):
+        # two DISTINCT replica deaths inside one cooldown window are
+        # two respawns (the cooldown keys per lost replica) ...
+        clock = _Clock()
+        feed = _Feed()
+        eng, acts = _engine(
+            feed, clock, [FaultResponsePolicy()],
+            guardrails=Guardrails(cooldown_sec=30.0, budget=10),
+        )
+        feed.event("replica_dead", replica_id=1)
+        feed.event("replica_dead", replica_id=2)
+        decisions = eng.step()
+        assert [d["target"] for d in decisions] == [
+            {"lost_replica": 1}, {"lost_replica": 2}]
+        assert all(d["executed"] for d in decisions)
+        assert len(acts.of("spawn_replica")) == 2
+        # ... while the SAME replica flapping inside the window IS
+        # cooldown-deduped
+        clock.tick(1.0)
+        feed.event("replica_dead", replica_id=1)
+        assert eng.step() == []
+        assert eng.stats["suppressed"] == 1
+
+    def test_degrade_retried_until_it_actually_lands(self):
+        # hysteresis moves on execution feedback: a failed degrade
+        # leaves the policy asserting, so the action is retried once
+        # the cooldown allows — pages can't fire over a latch that
+        # reads "degraded" while admission was never touched
+        clock = _Clock()
+        slo = _FakeSlo()
+        feed = _Feed()
+        acts = RecordingActuators(fail={"degrade_admission"})
+        eng, acts = _engine(
+            feed, clock, [PageAlertPolicy()],
+            guardrails=Guardrails(cooldown_sec=5.0, budget=10),
+            acts=acts, slo=slo,
+        )
+        slo.fire(rule="p99", severity="page")
+        (d1,) = eng.step()
+        assert d1["executed"] is False and "rigged" in d1["error"]
+        # still intended, only cooldown-suppressed — not given up
+        clock.tick(1.0)
+        assert eng.step() == []
+        assert eng.stats["suppressed"] == 1
+        acts.fail.clear()
+        clock.tick(5.0)
+        (d2,) = eng.step()
+        assert d2["executed"] is True
+        assert len(acts.of("degrade_admission")) == 2
+        # NOW the latch is set: still paging -> no duplicate intent
+        clock.tick(6.0)
+        assert eng.step() == []
+        slo.fire(rule="p99", state="resolved", severity="page")
+        (d3,) = eng.step()
+        assert d3["action"] == "restore_admission" and d3["executed"]
+
+    def test_straggler_held_only_after_shrink_executes(self):
+        # a shrink the actuator failed must not mark the executor
+        # held (the old bug: a later elastic_grow for an executor
+        # that was never actually held)
+        clock = _Clock()
+        feed = _Feed()
+        acts = RecordingActuators(fail={"elastic_shrink"})
+        policy = StragglerPolicy(sustain=1, grow_after=1)
+        eng, acts = _engine(
+            feed, clock, [policy],
+            guardrails=Guardrails(cooldown_sec=5.0, budget=10),
+            acts=acts,
+        )
+        feed.hints = {1: {"executor": 1, "phase": "feed",
+                          "ratio": 3.0}}
+        (d1,) = eng.step()
+        assert d1["executed"] is False
+        assert policy.held == set()
+        # hint clears while the shrink never landed: NO grow intent
+        feed.hints = {}
+        clock.tick(6.0)
+        assert eng.step() == []
+        assert acts.of("elastic_grow") == []
+        # hint returns, actuator healthy: shrink lands, latch moves
+        acts.fail.clear()
+        feed.hints = {1: {"executor": 1, "phase": "feed",
+                          "ratio": 3.0}}
+        (d2,) = eng.step()
+        assert d2["executed"] is True and policy.held == {1}
 
     def test_failed_actuator_is_a_journaled_outcome(self):
         clock = _Clock()
