@@ -1720,6 +1720,138 @@ def serving_fleet_bench(slots=2, max_new=12, chunk=4, queue_depth=2):
     }
 
 
+def serving_disagg_bench(slots=4, max_new=16, chunk=4, n_rows=24):
+    """Disaggregated prefill/decode row (ISSUE 17, docs/serving.md
+    "Disaggregated prefill/decode & TP sharding"): the split serving
+    engine — prefill as its own jitted program handing finished KV to
+    the chunked decode scheduler through a zero-copy paged block-table
+    exchange — vs the unified engine, on a MIXED prompt-length
+    workload (the regime the split exists for: long-prompt admits
+    stall in-flight decode chunks and fatten the TTFT/p99 tail).
+
+    Both engines run the paged+prefix flagship geometry on cold
+    prompts (compile warmed on same-length rows) and are asserted
+    token-identical first.  Reported:
+
+    - ``ttft_p50_ms``/``ttft_p99_ms``: the split engine's
+      submit->first-token latency (the ``serving.ttft_sec`` histogram's
+      source numbers; summary key ``serving_ttft_ms`` = p50).
+    - ``serving_disagg_p99_gain``: unified/split TTFT p99 ratio
+      (summary key).
+    - per-engine request-latency p99 and rows/s for the full story.
+
+    Single-host honesty (the fleet row's rule): in this process the
+    prefill and decode programs share one host's devices, so the split
+    measures protocol overhead (it must be ~free, gain ~1.0), not the
+    deployment win — on a real disaggregated fleet prefill runs on its
+    own chips and decode chunks never queue behind a long admit, which
+    is where the tail gain shows.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu import serving
+    from tensorflowonspark_tpu.models import transformer as tr
+
+    cfg = dict(
+        vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, embed_dim=64, mlp_dim=128, max_seq_len=256,
+        dtype="float32", attention_window=64, cache_dtype="int8",
+    )
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    params = jax.tree.map(np.asarray, jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0)))
+    base = dict(cfg, mode="generate", max_new_tokens=max_new,
+                pad_multiple=16, chunk_size=chunk, kv_layout="paged",
+                prefix_cache=True, prefix_block=16)
+    unified = tr.serving_builder(params, base)
+    disagg = tr.serving_builder(params, dict(base, disaggregate=True))
+    mapping = {"prompt": "tokens"}
+
+    def mixed_rows(seed):
+        # 1/3 long prompts (96..160 tokens) interleaved with short
+        # interactive ones (6..18) — same LENGTH mix per seed, so a
+        # warm pass on seed A compiles every suffix bucket the timed
+        # pass on seed B needs, while its prompts stay radix-cold
+        r = np.random.RandomState(seed)
+        lens = [int(r.randint(96, 160)) if i % 3 == 2
+                else int(r.randint(6, 18)) for i in range(n_rows)]
+        return [
+            {"prompt": r.randint(0, cfg["vocab_size"], (n,)).astype(
+                np.int32
+            )} for n in lens
+        ]
+
+    def run(predict, seed=1):
+        list(serving.predict_rows(  # warm: compile off-clock
+            predict, mixed_rows(0), mapping, batch_size=slots,
+            schedule="continuous",
+        ))
+        dec = predict.make_slot_decoder(slots)
+        if dec.prefix_cache is not None:
+            dec.prefix_cache.clear()  # timed admits stay cold
+        stats = {}
+        t0 = time.perf_counter()
+        out = list(serving.predict_rows(
+            predict, mixed_rows(seed), mapping, batch_size=slots,
+            schedule="continuous", stats=stats,
+        ))
+        wall = time.perf_counter() - t0
+        return out, stats, wall
+
+    def pct(values, q):
+        return 1e3 * float(np.percentile(np.asarray(values), q))
+
+    ref, us, uw = run(unified)
+    got, ds, dw = run(disagg)
+    assert ds["disaggregated"] and not us["disaggregated"]
+    token_exact = len(got) == len(ref) and all(
+        np.array_equal(np.asarray(g["generated"]),
+                       np.asarray(r["generated"]))
+        for g, r in zip(got, ref)
+    )
+    assert token_exact, "disaggregated engine diverged from unified"
+    u_ttft = list(us["ttft_sec"].values())
+    d_ttft = list(ds["ttft_sec"].values())
+    u_lat = list(us["latency_sec"].values())
+    d_lat = list(ds["latency_sec"].values())
+
+    def side(stats, ttft, lat, wall):
+        return {
+            "ttft_p50_ms": round(pct(ttft, 50), 3),
+            "ttft_p99_ms": round(pct(ttft, 99), 3),
+            "latency_p99_ms": round(pct(lat, 99), 3),
+            "rows_per_sec": round(n_rows / wall, 2) if wall else None,
+            "prefill_wall_sec": round(stats["prefill_wall_sec"], 4),
+        }
+
+    return {
+        "slots": slots, "max_new_tokens": max_new, "chunk_size": chunk,
+        "rows": n_rows,
+        "mix": "1/3 long prompts (96-160 tok) among short (6-18)",
+        "config": "paged+prefix flagship: GQA + window + int8-KV, "
+                  "16-token pages",
+        "unified": side(us, u_ttft, u_lat, uw),
+        "disagg": side(ds, d_ttft, d_lat, dw),
+        "ttft_p50_ms": round(pct(d_ttft, 50), 3),
+        "ttft_p99_ms": round(pct(d_ttft, 99), 3),
+        "serving_disagg_p99_gain": round(
+            pct(u_ttft, 99) / pct(d_ttft, 99), 3
+        ) if d_ttft else None,
+        "token_exact": bool(token_exact),
+        "note": (
+            "single host: prefill and decode programs share these "
+            "devices, so this row bounds the split's PROTOCOL overhead "
+            "(gain ~1.0 is the pass); the deployment tail win needs "
+            "prefill on its own chips"
+        ),
+        "platform": __import__("jax").devices()[0].platform,
+    }
+
+
 def factory_of(predict_list):
     """Cycle a prebuilt predictor list into a ReplicaSet factory."""
     it = iter(predict_list)
@@ -3279,6 +3411,18 @@ def bench_summary(record):
         "int4_tok_s": _pluck(
             record, "serving_paged", "int4", "tokens_per_sec"
         ),
+        # disaggregated prefill/decode plane (ISSUE 17,
+        # docs/serving.md "Disaggregated prefill/decode & TP
+        # sharding"): unified/split TTFT p99 ratio on the mixed
+        # prompt-length workload (~1.0 on one host = the split's
+        # protocol is free; the tail win needs dedicated prefill
+        # chips) and the split engine's TTFT p50
+        "serving_disagg_p99_gain": _pluck(
+            record, "serving_disagg", "serving_disagg_p99_gain"
+        ),
+        "serving_ttft_ms": _pluck(
+            record, "serving_disagg", "ttft_p50_ms"
+        ),
         "async_ps_compressed_steps_s": _pluck(
             record, "async_ps_tpu", "async_compressed_steps_per_sec"
         ),
@@ -3379,7 +3523,7 @@ LOWER_IS_BETTER = frozenset({
     "wall_sec", "swap_latency_ms", "swap_dropped",
     "telemetry_overhead_pct", "health_overhead_pct", "alerts_fired",
     "forensics_overhead_pct", "ledger_overhead_pct",
-    "feed_wire_mb_per_step",
+    "feed_wire_mb_per_step", "serving_ttft_ms",
 })
 
 
@@ -3552,6 +3696,10 @@ def main(model_name="resnet50", with_feed=True):
             # paged KV plane: paged-vs-contiguous decode + zero-copy
             # admit latency + int4 weights (ISSUE 12)
             ("serving_paged", serving_paged_bench, 120),
+            # disaggregated prefill/decode split (ISSUE 17): TTFT
+            # p50/p99 split-vs-unified on mixed prompt lengths,
+            # token-exactness asserted
+            ("serving_disagg", serving_disagg_bench, 90),
             ("serving_speculative", serving_speculative_bench, 60),
             ("decode_long", decode_long_bench, 160),
             ("async_ps_tpu", ps_tpu_bench, 100),
@@ -3631,6 +3779,8 @@ if __name__ == "__main__":
         print(json.dumps(with_retry(serving_prefix_bench)))
     elif "serving_paged" in sys.argv:
         print(json.dumps(with_retry(serving_paged_bench)))
+    elif "serving_disagg" in sys.argv:
+        print(json.dumps(with_retry(serving_disagg_bench)))
     elif "serving_speculative" in sys.argv:
         print(json.dumps(with_retry(serving_speculative_bench)))
     elif "telemetry_overhead" in sys.argv:

@@ -88,6 +88,13 @@ class Replica(object):
         self.predict = predict
         self.state = "live"
         self.error = None
+        if device is not None and getattr(predict, "mesh", None) is not None:
+            # a TP-sharded predictor owns its device placement: its
+            # committed mesh shardings (weights, KV pool) span several
+            # devices, and pinning a single default device would fight
+            # GSPMD.  The router above neither knows nor cares — the
+            # replica surface is unchanged.
+            device = None
         self.device = device
         self._poll_sec = float(poll_sec)
         self._completions = completions

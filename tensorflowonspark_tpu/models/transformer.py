@@ -1127,11 +1127,26 @@ class SlotDecoder:
                  seed=0, prefix_cache=None, draft_model=None,
                  draft_params=None, draft_len=4,
                  kv_layout="contiguous", kv_pages=None, page_tokens=None,
-                 paged_impl="kernel"):
+                 paged_impl="kernel", mesh=None):
         import numpy as np
 
         from tensorflowonspark_tpu import quantize as qz
 
+        # TP plane (docs/serving.md "Disaggregated prefill/decode & TP
+        # sharding"): with a mesh, weights shard over the `model` axis
+        # per RULES_TP and the KV banks/pools shard on their kv-head
+        # dim; the jitted programs are unchanged — GSPMD partitions
+        # them from the committed input shardings (the multichip
+        # dryruns prove this token-exact for generate()).
+        self.mesh = mesh
+        if mesh is not None:
+            from tensorflowonspark_tpu.parallel import mesh as pmesh
+
+            self.tp_degree = int(
+                pmesh.mesh_axis_size(mesh, pmesh.AXIS_TENSOR)
+            )
+        else:
+            self.tp_degree = 1
         self.kv_layout = str(kv_layout)
         if self.kv_layout not in ("contiguous", "paged"):
             raise ValueError(
@@ -1167,6 +1182,13 @@ class SlotDecoder:
         self.draft_model = draft_model
         self.draft_len = int(draft_len)
         self._spec = draft_model is not None
+        if mesh is not None and self._spec:
+            raise ValueError(
+                "TP-sharded SlotDecoder does not compose with "
+                "draft-model speculation yet (the draft's contiguous "
+                "banks would need their own sharding story); drop "
+                "draft_model or the mesh"
+            )
         if self._spec:
             if self.temperature > 0:
                 raise ValueError(
@@ -1197,6 +1219,15 @@ class SlotDecoder:
                     "paged_impl must be 'kernel' or 'gather', got "
                     "{0!r}".format(paged_impl)
                 )
+            if mesh is not None and paged_impl == "kernel":
+                raise ValueError(
+                    "paged_impl='kernel' does not compose with a TP "
+                    "mesh: pallas calls are not partitioned by GSPMD, "
+                    "so the kernel would see per-shard pools with "
+                    "global tables; use paged_impl='gather' (the "
+                    "XLA-native path — serving_builder defaults to it "
+                    "under tp/mesh_shape)"
+                )
             self.paged_impl = str(paged_impl)
             self._setup_paged(model, kv_pages, page_tokens, np)
         else:
@@ -1207,6 +1238,13 @@ class SlotDecoder:
         self._rng = jax.random.PRNGKey(int(seed))
         self._n_keys = 0  # admissions + chunks, folds the rng stream
         self._quantized = qz.is_quantized(params)
+        if mesh is not None and self._quantized:
+            raise ValueError(
+                "TP-sharded SlotDecoder needs float weights (the "
+                "quantized trees' packed codes + per-group scales "
+                "have no RULES_TP annotations yet); pass "
+                "weights='float' or drop the mesh"
+            )
         #: weight scheme ("int8" | "int4" | None) — hot-swap ingest
         #: re-quantizes with the SAME scheme the live decoder serves
         self._wq = qz.quantization_of(params)
@@ -1219,6 +1257,9 @@ class SlotDecoder:
                                barrier=False)
             if self._quantized else self._qparams
         )
+        if mesh is not None:
+            self._params = self._shard_params(self._params, mesh)
+            self._qparams = self._params
         # live-swap plane (hot_swap.py / docs/serving.md "Live weight
         # swap & rollback"): params are deliberately NOT donated
         # through the jitted programs (only cache/state are), so the
@@ -1231,6 +1272,8 @@ class SlotDecoder:
         # pool geometry in its config (same params)
         self.cache = init_cache(self.model, self.num_slots,
                                 cache_len=self._bank_len)
+        if mesh is not None:
+            self.cache = self._shard_cache(self.cache, mesh)
         if self._spec:
             # the draft's own slot-table banks, at the SAME canonical
             # per-slot positions as the flagship's (one admit prefills
@@ -1273,6 +1316,13 @@ class SlotDecoder:
             # cached admit is a single fused dispatch
             self._prefill_paged_jit = jax.jit(
                 self._prefill_paged_impl, donate_argnums=(2, 3, 4)
+            )
+            # disaggregated handoff (serving_disagg.PrefillWorker →
+            # :meth:`adopt`): the decode-side half is a pure
+            # [num_slots] state-vector scatter — donated, one
+            # dispatch, never touches a KV bank
+            self._adopt_jit = jax.jit(
+                self._adopt_impl, donate_argnums=(0,)
             )
         elif self._use_prefix:
             self._prefill_canonical_jit = jax.jit(
@@ -1415,6 +1465,51 @@ class SlotDecoder:
 
         return jax.tree.map(_merge, cache, lane)
 
+    def _shard_params(self, params, mesh):
+        """Commit the weights to ``mesh`` under the canonical TP rules
+        (``parallel.sharding.RULES_TP`` through this model's
+        :func:`logical_axes` annotations — attention heads, mlp and
+        vocab dims split over the ``model`` axis; dims the mesh width
+        does not divide stay replicated, ``apply_rules``'s shape-aware
+        dropping).  The committed placements are what GSPMD propagates
+        through the unchanged jitted programs."""
+        from jax.sharding import NamedSharding
+
+        from tensorflowonspark_tpu.parallel import sharding as sh
+
+        specs = sh.param_specs(
+            params, sh.RULES_TP, mesh=mesh,
+            annotations=logical_axes(params),
+        )
+        return jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(mesh, s)),
+            params, specs,
+        )
+
+    def _shard_cache(self, cache, mesh):
+        """Commit the KV banks/pools to ``mesh``: every 4-dim leaf —
+        contiguous ``[B, L, Hkv, D]`` banks and paged ``[P, T, Hkv,
+        Dx]`` pools (scale pools included) — splits its kv-head dim
+        over the ``model`` axis, matching the head sharding of the
+        projections that write it; leaves whose head count the axis
+        does not divide (and the scalar counters) replicate."""
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from tensorflowonspark_tpu.parallel.mesh import AXIS_TENSOR
+
+        size = mesh.shape.get(AXIS_TENSOR, 1)
+
+        def _place(leaf):
+            shape = getattr(leaf, "shape", ())
+            if (len(shape) == 4 and size > 1
+                    and shape[2] % size == 0):
+                spec = PartitionSpec(None, None, AXIS_TENSOR, None)
+            else:
+                spec = PartitionSpec()
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        return jax.tree.map(_place, cache)
+
     def _prefill_impl(self, params, dparams, cache, dcache, state, slot,
                       tokens, pad, key):
         """Slot-scoped prefill: lane ``slot`` of every cache bank gets
@@ -1545,6 +1640,25 @@ class SlotDecoder:
             ),
         }
         return cache, dcache, state, first
+
+    def _adopt_impl(self, state, slot, n, first):
+        """Decode-side half of a disaggregated prefill→decode handoff
+        (:meth:`adopt`): scatter the request's entries into the
+        ``[num_slots]`` state vectors — position ``n``, canonical pad
+        (0), the prefill program's first token, the eos flag.  This
+        program NEVER takes a KV bank operand: the prefill worker
+        already wrote the KV into shared pool pages, and the decode
+        side adopts them as table indices (host bookkeeping), which is
+        what makes the handoff zero-copy across programs."""
+        return {
+            "positions": state["positions"].at[slot].set(n),
+            "pad_start": state["pad_start"].at[slot].set(0),
+            "last_tok": state["last_tok"].at[slot].set(first),
+            "done": state["done"].at[slot].set(
+                first == self.eos_id if self.eos_id is not None
+                else False
+            ),
+        }
 
     def _install_segment_impl(self, cache, slot, segment):
         """Write a cached-prefix segment (per-bank ``[L_seg, H, Dx]``
@@ -1932,6 +2046,53 @@ class SlotDecoder:
                 )
                 pool.retain(committed)
         return first
+
+    def adopt(self, slot, handoff):
+        """Adopt a finished disaggregated prefill into lane ``slot``
+        (the decode half of :class:`tensorflowonspark_tpu.
+        serving_disagg.PrefillWorker`'s handoff protocol).
+
+        ``handoff`` carries the page-index row the prefill program
+        wrote the prompt's KV through (``pages``), the prompt length
+        (``n_tokens``), the cached-prefix depth (``cached_tokens``)
+        and the sampled first token (``first``, an unresolved device
+        scalar).  Adoption is a BLOCK-TABLE EXCHANGE: the table row
+        and page ownership move by host bookkeeping, and the one
+        device dispatch (:meth:`_adopt_impl`) scatters only the
+        ``[num_slots]`` state vectors — ``last_adopt_dispatches`` is
+        pinned at 1 and no program on this path takes a KV bank
+        operand, the zero-copy assertion the disagg tests check."""
+        if not self._paged:
+            raise ValueError(
+                "adopt() needs kv_layout='paged' (the handoff IS a "
+                "block-table exchange; contiguous banks would force "
+                "a physical KV copy between programs)"
+            )
+        if self.active[slot]:
+            raise ValueError("slot {0} is still active".format(slot))
+        row = [int(p) for p in handoff.pages]
+        if len(row) != self._blocks_per_slot:
+            raise ValueError(
+                "handoff row has {0} pages; this decoder's slots span "
+                "{1} blocks".format(len(row), self._blocks_per_slot)
+            )
+        n = int(handoff.n_tokens)
+        self.tables[slot] = self._np.asarray(row, self._np.int32)
+        self._slot_pages[slot] = row
+        self.last_admit_cached_tokens = int(handoff.cached_tokens)
+        #: the admit-side program count for this request is the
+        #: prefill worker's (1); the adopt itself adds exactly one
+        #: state-scatter dispatch and zero KV programs
+        self.last_admit_dispatches = 1
+        self.last_adopt_dispatches = 1
+        self.state = self._adopt_jit(
+            self.state, jnp.int32(slot), jnp.int32(n), handoff.first
+        )
+        end = getattr(self.page_pool, "end_handoff", None)
+        if end is not None:
+            end(row)
+        self.active[slot] = True
+        return handoff.first
 
     def _admit_canonical(self, slot, prompt, n):
         """The cached-prefix admit path (see :meth:`admit`)."""
@@ -2460,6 +2621,51 @@ def serving_builder(params, config):
         kv_layout = str(config.get("kv_layout", "contiguous"))
         chunk_size = int(config.get("chunk_size", 16))
         max_prompt = config.get("max_prompt_len")
+        # TP sharding knobs (docs/serving.md "Disaggregated
+        # prefill/decode & TP sharding"): tp=N shards the slot
+        # decoders' weights and KV pools over an N-wide `model` mesh
+        # (mesh_shape overrides with an explicit {axis: size} dict).
+        # The predictor surface is unchanged — fleet replicas built
+        # through the engine_factory seam inherit the sharding from
+        # the committed placements, zero router changes.
+        smesh = None
+        if config.get("tp") or config.get("mesh_shape"):
+            from tensorflowonspark_tpu.parallel.mesh import serving_mesh
+
+            smesh = serving_mesh(
+                tp=config.get("tp"), mesh_shape=config.get("mesh_shape")
+            )
+        # under a mesh the pallas kernel is off the table (pallas
+        # calls are not partitioned by GSPMD) — default to the
+        # XLA-native gather path; an EXPLICIT paged_impl="kernel"
+        # still reaches SlotDecoder's named error
+        paged_impl = str(config.get(
+            "paged_impl", "gather" if smesh is not None else "kernel"
+        ))
+        if kv_layout == "paged":
+            # build-time Mosaic tile-legality preflight: fail paged
+            # geometries destined for the TPU kernel HERE with a named
+            # TileLegalityError instead of a Mosaic lowering failure
+            # inside the first decode dispatch.  Off-TPU (interpret
+            # mode) or on the gather path any geometry is legal, so
+            # enforcement defaults off there; config["check_tiles"]
+            # forces it either way.
+            from tensorflowonspark_tpu import compat
+            from tensorflowonspark_tpu.ops import paged_attention as pa
+
+            enforce = config.get("check_tiles")
+            if enforce is None:
+                enforce = (
+                    paged_impl == "kernel"
+                    and not compat.pallas_interpret()
+                )
+            if enforce:
+                pa.check_tiles(
+                    int(config.get("kv_page_tokens")
+                        or config.get("prefix_block") or 16),
+                    cfg.head_dim,
+                    "int8" if cfg.cache_dtype == "int8" else cfg.dtype,
+                )
         slot_decoders = {}
         prefix_holder = []
         paged_caches = {}
@@ -2522,7 +2728,8 @@ def serving_builder(params, config):
                 page_tokens=config.get(
                     "kv_page_tokens", config.get("prefix_block")
                 ),
-                paged_impl=str(config.get("paged_impl", "kernel")),
+                paged_impl=paged_impl,
+                mesh=smesh,
             )
             slot_decoders[key] = dec
             return dec
@@ -2530,6 +2737,22 @@ def serving_builder(params, config):
         predict.make_slot_decoder = make_slot_decoder
         predict.max_new_tokens = max_new
         predict.eos_id = eos_id
+        #: the serving mesh (None = unsharded) — fleet/replica.py skips
+        #: its default-device pin for mesh predictors (the committed
+        #: placements own the devices)
+        predict.mesh = smesh
+        # prefill/decode disaggregation: the ServingEngine reads this
+        # attr (overridable per engine) and, when set, admits through a
+        # serving_disagg.PrefillWorker — its own jitted program — with
+        # the zero-copy block-table handoff into the chunked decoder.
+        # Needs the paged layout (the handoff IS a table exchange).
+        disagg = bool(config.get("disaggregate", False))
+        if disagg and kv_layout != "paged":
+            raise ValueError(
+                "disaggregate=true needs kv_layout='paged' (the "
+                "prefill→decode handoff is a block-table exchange)"
+            )
+        predict.disaggregate = disagg
         # fleet serving (docs/serving.md "Fleet routing & rolling
         # deploys"): every replica needs its OWN SlotDecoder (jitted
         # programs + slot state are single-threaded) and its own radix

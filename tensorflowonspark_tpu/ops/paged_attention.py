@@ -53,6 +53,73 @@ from tensorflowonspark_tpu import compat
 
 NEG_INF = -1e30  # finite mask sentinel: exp() underflows to 0, no NaNs
 
+#: Mosaic's minimum tile is (sublane, lane) with lane fixed at 128 and
+#: the sublane minimum set by element width: 4-byte types pack 8
+#: sublanes, 2-byte 16, 1-byte 32.
+LANE = 128
+_SUBLANE_BY_ITEMSIZE = {4: 8, 2: 16, 1: 32}
+
+
+class TileLegalityError(ValueError):
+    """A paged-KV geometry that Mosaic cannot tile on hardware.
+
+    Raised by :func:`check_tiles` at *build* time (``serving_builder``
+    with ``kv_layout="paged"``) so an off-bar ``page_tokens`` /
+    ``head_dim`` choice fails with a named, actionable error instead of
+    a Mosaic lowering failure deep inside the first decode dispatch.
+    """
+
+
+def min_tile(dtype):
+    """Mosaic minimum ``(sublane, lane)`` tile for ``dtype``."""
+    itemsize = jnp.dtype(dtype).itemsize
+    try:
+        return (_SUBLANE_BY_ITEMSIZE[itemsize], LANE)
+    except KeyError:
+        raise TileLegalityError(
+            "no Mosaic tile rule for dtype {0} (itemsize {1})".format(
+                jnp.dtype(dtype).name, itemsize
+            )
+        )
+
+
+def check_tiles(page_tokens, head_dim, dtype):
+    """Validate a paged-KV page geometry against Mosaic tile minimums.
+
+    The kernel's per-page K/V block is ``[page_tokens, kv_heads,
+    head_dim]``; Mosaic tiles the trailing two dims of each 2D slice as
+    (sublane, lane) = (page_tokens, head_dim) after the head dim is
+    folded, so hardware legality requires ``head_dim`` to be a multiple
+    of the 128-wide lane and ``page_tokens`` a multiple of the dtype's
+    sublane minimum (8 for 4-byte, 16 for 2-byte, 32 for 1-byte
+    elements).  CPU interpret mode accepts anything — this preflight
+    exists so builds destined for TPU fail early with a named error.
+
+    Returns ``{"sublane": S, "lane": L}`` (the minimums checked
+    against) when legal; raises :class:`TileLegalityError` otherwise.
+    """
+    sub, lane = min_tile(dtype)
+    page_tokens = int(page_tokens)
+    head_dim = int(head_dim)
+    problems = []
+    if page_tokens <= 0 or page_tokens % sub != 0:
+        problems.append(
+            "page_tokens={0} must be a positive multiple of the "
+            "{1}-dtype sublane minimum {2}".format(
+                page_tokens, jnp.dtype(dtype).name, sub
+            )
+        )
+    if head_dim <= 0 or head_dim % lane != 0:
+        problems.append(
+            "head_dim={0} must be a positive multiple of the lane "
+            "width {1}".format(head_dim, lane)
+        )
+    if problems:
+        raise TileLegalityError(
+            "paged-KV geometry illegal for Mosaic: " + "; ".join(problems)
+        )
+    return {"sublane": sub, "lane": lane}
+
 
 def _grid_spec(num_scalar_prefetch, grid, in_specs, out_specs,
                scratch_shapes=()):

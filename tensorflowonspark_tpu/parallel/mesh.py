@@ -200,6 +200,35 @@ def build_mesh(axes=None, devices=None, allow_split_physical=True):
     return mesh
 
 
+def serving_mesh(tp=None, mesh_shape=None, devices=None):
+    """The SERVING stack's mesh (``serving_builder`` ``tp`` /
+    ``mesh_shape`` knobs, docs/serving.md "Disaggregated
+    prefill/decode & TP sharding").
+
+    ``tp=N`` is the shorthand: a 1-axis ``model=N`` mesh over the
+    first N devices — the tensor-parallel degree the SlotDecoder
+    shards its weights and KV page pools over.  ``mesh_shape`` (a
+    ``{axis: size}`` dict, ``-1`` wildcard allowed) overrides it for
+    explicit topologies (e.g. ``{"data": 2, "model": 2}``).  Returns
+    ``None`` when neither asks for more than one device — the caller
+    then keeps the unsharded single-program path, so the knobs are
+    strictly additive.
+    """
+    if mesh_shape:
+        return build_mesh(dict(mesh_shape), devices=devices)
+    t = int(tp or 0)
+    if t <= 1:
+        return None
+    import jax
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if len(devs) < t:
+        raise ValueError(
+            "tp={0} needs {0} devices, have {1}".format(t, len(devs))
+        )
+    return build_mesh(MeshSpec(**{AXIS_TENSOR: t}), devices=devs[:t])
+
+
 def mesh_axis_size(mesh, *axis_names):
     """Product of the named axes' sizes (1 for absent axes) — the standard
     way strategies ask "how wide is my parallelism" without caring which

@@ -102,6 +102,13 @@ class PagePool(object):
         # LIFO free list: recently-freed pages are re-handed first
         # (their device lines are the warmest)
         self._free = list(range(self.num_pages - 1, self.reserved - 1, -1))
+        # pages mid-flight between the disaggregated prefill and
+        # decode programs (serving_disagg): written by prefill, not
+        # yet adopted by a slot's table.  Pure accounting — the
+        # refcounts above keep the pages alive; this set makes the
+        # in-flight population observable (pool_pages_handoff) and
+        # lets tests assert every handoff drains.
+        self._handoff = set()
 
     def available(self):
         return len(self._free)
@@ -140,6 +147,25 @@ class PagePool(object):
     def refcount(self, page):
         return int(self._refs[page])
 
+    def begin_handoff(self, pages):
+        """Tag ``pages`` as mid-flight between the disaggregated
+        prefill and decode programs (the PrefillWorker wrote their KV;
+        no slot table references them yet).  The pages must be live —
+        the worker holds the allocating references."""
+        for p in pages:
+            if self._refs[p] <= 0:
+                raise ValueError(
+                    "begin_handoff() on free page {0}".format(int(p))
+                )
+            self._handoff.add(int(p))
+
+    def end_handoff(self, pages):
+        """Clear the in-flight tag — the decode side adopted the pages
+        into a slot's block table (or the handoff was abandoned and
+        the references released)."""
+        for p in pages:
+            self._handoff.discard(int(p))
+
     def stats(self):
         used = self.num_pages - self.reserved - len(self._free)
         return {
@@ -150,6 +176,10 @@ class PagePool(object):
             # the paged layout exists for (refcount-asserted in
             # tests/test_paged_decode.py)
             "pool_pages_shared": int((self._refs >= 2).sum()),
+            # pages written by a disaggregated prefill program and not
+            # yet adopted by a decode slot (serving_disagg) — drains
+            # to 0 when no handoff is in flight
+            "pool_pages_handoff": len(self._handoff),
         }
 
 

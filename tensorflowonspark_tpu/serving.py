@@ -807,6 +807,17 @@ def main(argv=None):
     p.add_argument("--fleet_queue_depth", type=int, default=None,
                    help="fleet admission-queue bound (default: the "
                         "summed replica capacity)")
+    p.add_argument("--tp", type=int, default=None,
+                   help="tensor-parallel degree: shard weights and the "
+                        "paged KV pool over a {'model': N} mesh (the "
+                        "whole predictor becomes ONE logical replica "
+                        "spanning N chips; see docs/serving.md "
+                        "'Disaggregated prefill/decode & TP sharding')")
+    p.add_argument("--disaggregate", action="store_true",
+                   help="split prefill and decode into separate jitted "
+                        "programs with a zero-copy paged-KV handoff "
+                        "(needs kv_layout='paged'; bounds TTFT/p99 "
+                        "under mixed prompt lengths)")
     args = p.parse_args(argv)
 
     from tensorflowonspark_tpu.data import interchange
@@ -816,7 +827,14 @@ def main(argv=None):
     )
     logger.info("loaded %d rows (schema: %s)", len(rows),
                 interchange.format_schema(schema))
-    predict = load_predictor(args.export_dir)
+    overrides = {}
+    if args.tp:
+        overrides["tp"] = args.tp
+    if args.disaggregate:
+        overrides["disaggregate"] = True
+    predict = load_predictor(
+        args.export_dir, config_overrides=overrides or None
+    )
     input_mapping = _parse_mapping(args.input_mapping)
     output_mapping = (
         _parse_mapping(args.output_mapping) if args.output_mapping else None

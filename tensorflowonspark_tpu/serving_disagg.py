@@ -1,0 +1,192 @@
+"""Disaggregated prefill: the prefill half of a split serving engine.
+
+Prefill and decode have OPPOSITE resource shapes — prefill is one big
+compute-bound batched matmul pass over the whole prompt, decode is a
+long bandwidth-bound sequence of tiny steps — and interleaving them in
+one program makes every decode chunk behind a long admit pay the
+prompt's latency (the p99/TTFT tail under mixed prompt lengths).  This
+module splits them: :class:`PrefillWorker` runs prefill as ITS OWN
+jitted (and, under a mesh, GSPMD-sharded) program, and the chunked
+:class:`~tensorflowonspark_tpu.models.transformer.SlotDecoder` stays
+the decode scheduler.
+
+The KV handoff between the two programs is a **block-table exchange**
+over the shared paged pool (docs/serving.md "Disaggregated
+prefill/decode & TP sharding"):
+
+1. the worker allocates a page row from the decoder's
+   :class:`~tensorflowonspark_tpu.prefix_cache.PagePool` (cached
+   radix prefix pages install as indices, exactly like a unified
+   paged admit) and tags it in-flight (``begin_handoff``);
+2. its prefill program writes the prompt's KV STRAIGHT INTO the pool
+   pages through a 1-row block table and samples the first token;
+3. :meth:`SlotDecoder.adopt` installs the page indices into the target
+   slot's table row (host bookkeeping) and scatters the slot's state
+   vectors — one dispatch that never takes a KV bank operand.
+
+No program on the path copies KV between banks: the pages the prefill
+wrote ARE the pages decode reads, which is the "zero-copy ACROSS
+programs, not just across slots" property the tests assert via
+``last_adopt_dispatches == 1`` + cache-leaf identity across adopt, and
+the pool's ``pool_pages_handoff`` stat draining to 0.
+
+The worker deliberately shares the decoder's pool, radix cache, rng
+stream and sampling knobs, so a disaggregated engine is token-identical
+to the unified one across the whole feature stack (GQA + window +
+int8-KV + prefix cache + paged layout) — asserted in
+tests/test_serving_disagg.py.
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Handoff", "PrefillWorker"]
+
+
+class Handoff(object):
+    """One finished prefill, ready for :meth:`SlotDecoder.adopt`.
+
+    ``pages`` is the page-index row holding the prompt's KV (the
+    adopting slot's whole table span), ``n_tokens`` the prompt length,
+    ``cached_tokens`` the radix-cached prefix depth (telemetry),
+    ``first`` the sampled first token — an UNRESOLVED device scalar,
+    the same async contract as :meth:`SlotDecoder.admit`'s return.
+    """
+
+    __slots__ = ("pages", "n_tokens", "cached_tokens", "first")
+
+    def __init__(self, pages, n_tokens, cached_tokens, first):
+        self.pages = list(pages)
+        self.n_tokens = int(n_tokens)
+        self.cached_tokens = int(cached_tokens)
+        self.first = first
+
+
+class PrefillWorker(object):
+    """The prefill-side program of a disaggregated engine.
+
+    Owns ONE jitted program — the canonical-position suffix prefill
+    writing through a 1-row block table into the decoder's shared page
+    pool (the paged plane's admit program minus the slot-state
+    scatter, which moved to the decode side's ``adopt``).  One
+    compiled program per suffix bucket, shared by cached hits of every
+    depth; the pool cache is donated (linear handle, reassigned on the
+    shared decoder every dispatch).
+
+    Under a TP mesh nothing changes here: the decoder's committed
+    weight/pool placements make GSPMD shard this program the same way
+    it shards decode.
+    """
+
+    def __init__(self, decoder):
+        if not getattr(decoder, "_paged", False):
+            raise ValueError(
+                "PrefillWorker needs a paged SlotDecoder "
+                "(kv_layout='paged'): the prefill→decode handoff is a "
+                "block-table exchange over the shared page pool"
+            )
+        if getattr(decoder, "_spec", False):
+            raise ValueError(
+                "disaggregated prefill does not compose with "
+                "draft-model speculation (the draft's contiguous banks "
+                "live on the decode side only)"
+            )
+        self.decoder = decoder
+        #: program census of the last prefill() — pinned at 1: the
+        #: suffix prefill IS the only dispatch (cached pages install
+        #: as indices, commits record indices)
+        self.last_prefill_dispatches = 0
+        self._jit = jax.jit(self._prefill_impl, donate_argnums=(1,))
+
+    def _prefill_impl(self, params, cache, suffix, n, kpref, trow, key):
+        """Suffix prefill at canonical positions through a 1-row block
+        table: writes the pool pages in place and samples the first
+        token from the last real suffix row (``n - kpref - 1``).
+        ``n``/``kpref`` are traced — one program per suffix bucket."""
+        dec = self.decoder
+        logits, mut = dec.model.apply(
+            {"params": params, "cache": cache}, suffix, decode=True,
+            mutable=["cache"], slot_positions=kpref[None],
+            block_tables=trow,
+        )
+        row = jax.lax.dynamic_slice_in_dim(
+            logits, n - kpref - 1, 1, axis=1
+        )[:, 0]
+        first = dec._sample(row, key)[0]
+        return mut["cache"], first
+
+    def prefill(self, prompt):
+        """Run one prompt's prefill and return its :class:`Handoff`.
+
+        Mirrors the unified paged admit's pool/radix protocol exactly
+        (same leases, same page refcounts, same commit of the prompt's
+        new full blocks) — only the slot-state scatter is missing,
+        deferred to the adopting decoder.  All dispatches stay async.
+        """
+        dec = self.decoder
+        np = dec._np
+        prompt = np.asarray(prompt, np.int32).ravel()
+        n = int(prompt.shape[0])
+        if n == 0:
+            raise ValueError("cannot prefill an empty prompt")
+        if n + dec.max_new_tokens > dec.cache_len:
+            raise ValueError(
+                "prompt ({0}) + max_new_tokens ({1}) exceeds the "
+                "engine cache_len={2}".format(
+                    n, dec.max_new_tokens, dec.cache_len
+                )
+            )
+        pc, pool = dec.prefix_cache, dec.page_pool
+        blk = dec._page_tokens
+        if pc is not None:
+            # at least one real token must prefill (first-token logits)
+            lease = pc.acquire(prompt, limit_tokens=n - 1)
+            kpref = lease.n_tokens
+            cached_pages = [int(p) for p in lease.payloads()]
+        else:
+            lease, kpref, cached_pages = None, 0, []
+        self.last_prefill_dispatches = 1
+        # the handoff holds its own reference to every shared page
+        # (the radix may evict the block before the decode side
+        # adopts — the refcount keeps the physical page alive)
+        pool.retain(cached_pages)
+        if lease is not None:
+            pc.release(lease)
+        private = dec._alloc_pages(
+            dec._blocks_per_slot - len(cached_pages)
+        )
+        row = cached_pages + private
+        pool.begin_handoff(row)
+        sb = dec._suffix_bucket(n - kpref, kpref)
+        suffix = np.zeros((1, sb), np.int32)
+        suffix[0, :n - kpref] = prompt[kpref:]
+        trow = np.asarray([row], np.int32)
+        dec.cache, first = self._jit(
+            dec._params, dec.cache, jnp.asarray(suffix), jnp.int32(n),
+            jnp.int32(kpref), jnp.asarray(trow), dec._next_key(),
+        )
+        # commit the prompt's NEW full blocks: their pages already
+        # hold the KV (the prefill wrote through the table) —
+        # recording the indices in the radix IS the commit, zero
+        # copies, zero dispatches (the unified paged admit's rule)
+        if pc is not None:
+            total_blocks = n // blk
+            first_new = len(cached_pages)
+            if total_blocks > first_new:
+                committed = []
+                pc.insert(
+                    prompt, row[first_new:total_blocks], first_new,
+                    dec._page_nbytes, on_insert=committed.append,
+                )
+                pool.retain(committed)
+        return Handoff(row, n, kpref, first)
+
+    def abandon(self, handoff):
+        """Release an un-adopted handoff's pages (admit failed or the
+        request expired between prefill and adopt) — the abandon path
+        of the handoff protocol, so a crashed adopt can never leak
+        pool pages."""
+        pool = self.decoder.page_pool
+        pool.end_handoff(handoff.pages)
+        pool.release(handoff.pages)
+        handoff.pages = []

@@ -123,6 +123,41 @@ def latency_summary(since=None):
         ),
     }
 
+
+#: Time-to-first-token histogram name: the continuous engine observes
+#: submit→first-token wall here (stamped when the admit's unresolved
+#: device scalar first resolves).  TTFT is the number the
+#: prefill/decode disaggregation exists to bound — docs/serving.md
+#: "Disaggregated prefill/decode & TP sharding".
+TTFT_METRIC = "serving.ttft_sec"
+
+
+def ttft_histogram():
+    """The process-wide time-to-first-token histogram (see
+    :data:`TTFT_METRIC`)."""
+    return telemetry.get_registry().histogram(TTFT_METRIC)
+
+
+def ttft_summary(since=None):
+    """p50/p99/count of the TTFT histogram, in ms — the
+    :func:`latency_summary` contract (``since`` scopes to a window;
+    zeros when telemetry is off)."""
+    snap = ttft_histogram().snapshot()
+    if since:
+        snap = telemetry.snapshot_delta(
+            {"histograms": {TTFT_METRIC: snap}},
+            {"histograms": {TTFT_METRIC: since}},
+        )["histograms"][TTFT_METRIC]
+    return {
+        "count": int(snap.get("count", 0)),
+        "p50_ms": round(
+            1e3 * telemetry.histogram_percentile(snap, 50), 3
+        ),
+        "p99_ms": round(
+            1e3 * telemetry.histogram_percentile(snap, 99), 3
+        ),
+    }
+
 #: reserved input name: a row column mapped to it carries that
 #: request's token budget — the scheduler evicts the row after
 #: ``min(max_new, budget)`` tokens even when no eos arrives
@@ -388,7 +423,7 @@ class ServingEngine(object):
                  watchdog_timeout=None, on_error="raise", wedge_fn=None,
                  stats=None, clock=None, watcher=None,
                  checkpoint_dir=None, checkpoint_poll_sec=5.0,
-                 rollback_window=8, swap_canary=True):
+                 rollback_window=8, swap_canary=True, disaggregate=None):
         if policy not in POLICIES:
             raise ValueError(
                 "policy must be one of {0}, got {1!r}".format(
@@ -456,6 +491,32 @@ class ServingEngine(object):
             factory(self.num_slots) if chunk is None
             else factory(self.num_slots, chunk)
         )
+        # prefill/decode disaggregation (docs/serving.md "Disaggregated
+        # prefill/decode & TP sharding"): admits run through a
+        # PrefillWorker's OWN jitted program and hand their finished KV
+        # to the chunked decoder as a zero-copy block-table exchange
+        # (SlotDecoder.adopt).  Explicit arg wins; else the predictor's
+        # serving_builder `disaggregate` knob — which is how a fleet
+        # replica built through the engine_factory seam turns it on
+        # with zero router changes.
+        if disaggregate is None:
+            disaggregate = bool(getattr(predict, "disaggregate", False))
+        self.disaggregate = bool(disaggregate)
+        if self.disaggregate:
+            from tensorflowonspark_tpu.serving_disagg import PrefillWorker
+
+            # memoized on the decoder: the predictor caches its
+            # SlotDecoder across engines, and the worker's jit cache
+            # must survive engine rebuilds the same way the decoder's
+            # compiled programs do (watchdog recovery, repeated
+            # predict_rows calls)
+            worker = getattr(self.decoder, "_prefill_worker", None)
+            if worker is None:
+                worker = PrefillWorker(self.decoder)
+                self.decoder._prefill_worker = worker
+            self._prefill_worker = worker
+        else:
+            self._prefill_worker = None
         self.max_new = self.decoder.max_new_tokens
         self.eos_id = self.decoder.eos_id
         self._fill = self.eos_id if self.eos_id is not None else 0
@@ -557,6 +618,15 @@ class ServingEngine(object):
             # chip-second rows must sum back to) and tokens emitted
             # by completed requests
             "decode_wall_sec": 0.0, "tokens_out": 0,
+            # disaggregation plane (docs/serving.md "Disaggregated
+            # prefill/decode & TP sharding"): whether admits run
+            # through a PrefillWorker, summed prefill-dispatch wall
+            # (the ledger's prefill_chip_sec denominator), and
+            # per-request submit→first-token wall — the raw-list
+            # fallback mirroring latency_sec (serving.ttft_sec is the
+            # authoritative percentile source)
+            "disaggregated": self.disaggregate,
+            "prefill_wall_sec": 0.0, "ttft_sec": {},
         })
         self._reuse_base = dict(self._decoder_reuse_stats())
         # telemetry: metrics resolved ONCE (null singletons when
@@ -588,6 +658,7 @@ class ServingEngine(object):
 
         _blackbox.install()
         self._m_lat = reg.histogram(LATENCY_METRIC)
+        self._m_ttft = reg.histogram(TTFT_METRIC)
         self._m_queue_wait = reg.histogram("serving.queue_wait_sec")
         self._m = {
             name: reg.counter("serving." + name)
@@ -701,6 +772,9 @@ class ServingEngine(object):
             # on /status
             "usage": {
                 "chip_sec": round(self.stats["decode_wall_sec"], 6),
+                "prefill_chip_sec": round(
+                    self.stats["prefill_wall_sec"], 6
+                ),
                 "tokens_out": self.stats["tokens_out"],
                 "prefix_tokens_saved": self.stats["prefix_tokens_saved"],
             },
@@ -871,6 +945,7 @@ class ServingEngine(object):
             prefix_tokens_saved=req.pop("prefix_saved_acc", 0),
             queue_wait_sec=req.pop("queue_wait_acc", 0.0),
             chip_sec=req.pop("chip_sec", 0.0),
+            prefill_chip_sec=req.pop("prefill_chip_sec", 0.0),
             page_sec=req.pop("page_sec", 0.0),
             tokens_out=tokens_out, latency_sec=latency_sec,
             close=close,
@@ -1054,16 +1129,56 @@ class ServingEngine(object):
             try:
                 # admit is a single ASYNC dispatch; the first token
                 # comes back as an unsynchronized device scalar,
-                # resolved at the next chunk boundary
-                with self._tracer.span("prefill", trace=rid) as sp:
-                    first = self.decoder.admit(slot, prompt)
-                    cached = int(getattr(
-                        self.decoder, "last_admit_cached_tokens", 0
-                    ))
-                    sp.set("prefix_hit", cached > 0)
-                    if cached:
-                        sp.set("prefix_tokens", cached)
-                        self._m["prefix_hit_admits"].inc()
+                # resolved at the next chunk boundary.  Disaggregated
+                # engines split it: the PrefillWorker's own program
+                # runs the prompt, then adopt() hands the finished KV
+                # to the decoder as a block-table exchange — the
+                # request's trace id crosses both spans, so prefill
+                # and decode merge into one story per request.
+                t_admit0 = time.perf_counter()
+                if self._prefill_worker is not None:
+                    with self._tracer.span("prefill", trace=rid) as sp:
+                        handoff = self._prefill_worker.prefill(prompt)
+                        cached = int(handoff.cached_tokens)
+                        sp.set("prefix_hit", cached > 0)
+                        if cached:
+                            sp.set("prefix_tokens", cached)
+                            self._m["prefix_hit_admits"].inc()
+                        sp.set("disaggregated", True)
+                    try:
+                        with self._tracer.span("handoff", trace=rid):
+                            first = self.decoder.adopt(slot, handoff)
+                    except Exception:
+                        # the abandon path: an un-adopted handoff must
+                        # never leak its pool pages
+                        self._prefill_worker.abandon(handoff)
+                        raise
+                    # zero-copy invariant: adoption is one state
+                    # scatter, never a KV-copy program
+                    assert int(getattr(
+                        self.decoder, "last_adopt_dispatches", 1
+                    )) == 1, "KV copy dispatched on the handoff path"
+                else:
+                    with self._tracer.span("prefill", trace=rid) as sp:
+                        first = self.decoder.admit(slot, prompt)
+                        cached = int(getattr(
+                            self.decoder, "last_admit_cached_tokens", 0
+                        ))
+                        sp.set("prefix_hit", cached > 0)
+                        if cached:
+                            sp.set("prefix_tokens", cached)
+                            self._m["prefix_hit_admits"].inc()
+                # prefill cost component (ledger prefill_chip_sec):
+                # host wall of the prefill dispatch(es) — async, so
+                # this is dispatch wall, not device occupancy; the
+                # split-out field is what lets a disaggregated
+                # engine's two programs attribute separately
+                t_admit = time.perf_counter() - t_admit0
+                self.stats["prefill_wall_sec"] += t_admit
+                if self._ledger.enabled:
+                    req["prefill_chip_sec"] = req.get(
+                        "prefill_chip_sec", 0.0
+                    ) + t_admit
             except Exception as e:  # noqa: BLE001 - per-request capture
                 if self.on_error == "raise":
                     raise RequestError(
@@ -1484,6 +1599,15 @@ class ServingEngine(object):
         if out and not isinstance(out[-1], int):
             last = int(np.asarray(out[-1]))
             out[-1] = last
+            if "ttft" not in req:
+                # first-token latency, stamped where the admit's async
+                # device scalar actually resolves — the number the
+                # prefill/decode split is designed to bound, with the
+                # trace id as the histogram exemplar
+                ttft = self._clock() - req["submit"]
+                req["ttft"] = ttft
+                self.stats["ttft_sec"][req["idx"]] = ttft
+                self._m_ttft.observe(ttft, exemplar=req["rid"])
             if self.eos_id is not None and last == self.eos_id:
                 req["eos_at"] = len(out) - 1
         for t in (() if chunk_row is None else chunk_row):

@@ -83,6 +83,9 @@ METRICS = tuple(
          ("serving.request_latency_sec",
           "submit→emit latency, BOTH schedules (the authoritative "
           "p50/p99 source; carries trace-id exemplars)"),
+         ("serving.ttft_sec",
+          "submit→first-token latency (the number the prefill/decode "
+          "split bounds; trace-id exemplars)"),
          ("serving.queue_wait_sec", "admission-queue wait"))
     + _m(_G, "ServingEngine",
          ("serving.weight_generation", "live weight generation tag"))

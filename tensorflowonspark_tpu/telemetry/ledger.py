@@ -57,9 +57,15 @@ OVERFLOW_TENANT = "__other__"
 
 #: Resource fields carried per row and per tenant.  ``requests`` is
 #: bumped once per CLOSED request; everything else accrues as charged.
+#: ``chip_sec`` is DECODE chip-seconds (per-chunk wall apportioned by
+#: live slot share — the rows sum back to measured decode wall);
+#: ``prefill_chip_sec`` is the request's prefill program wall, split
+#: out so a disaggregated engine's two programs attribute separately
+#: (unified engines charge their admit dispatch wall here too).
 FIELDS = (
     "requests", "tokens_in", "tokens_out", "queue_wait_sec",
-    "chip_sec", "page_sec", "prefix_tokens_saved", "wire_bytes",
+    "chip_sec", "prefill_chip_sec", "page_sec", "prefix_tokens_saved",
+    "wire_bytes",
 )
 
 #: Registry-mirror metric prefix: per-tenant totals publish as
@@ -354,8 +360,8 @@ class UsageLedger(object):
 
     def settle(self, rid, tenant=None, tokens_in=None, wire_bytes=0,
                prefix_tokens_saved=0, queue_wait_sec=0.0, chip_sec=0.0,
-               page_sec=0.0, tokens_out=None, latency_sec=None,
-               close=True):
+               prefill_chip_sec=0.0, page_sec=0.0, tokens_out=None,
+               latency_sec=None, close=True):
         """Open-accrue-close in ONE lock crossing — the serving
         engine's shape: it accumulates a request's admission fields
         and per-chunk decode cost on its own (lock-free) request
@@ -383,6 +389,7 @@ class UsageLedger(object):
                         int(prefix_tokens_saved))
             self._apply(row, "queue_wait_sec", float(queue_wait_sec))
             self._apply(row, "chip_sec", float(chip_sec))
+            self._apply(row, "prefill_chip_sec", float(prefill_chip_sec))
             self._apply(row, "page_sec", float(page_sec))
             if tokens_out is not None:
                 self._apply(row, "tokens_out",

@@ -32,6 +32,23 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite compiles hundreds of
+# near-identical programs (every parity test rebuilds the same
+# predictor/decoder shapes in a fresh jit closure), and the cache keys
+# on HLO so the multi-second compiles dedup even WITHIN one cold run.
+# Stock thresholds ONLY (>=1s compiles): forcing
+# min_compile_time_secs=0 makes jax 0.4.37 segfault round-tripping
+# trivial executables (reproduced on test_checkpoint).  A stable /tmp
+# path keeps local rerun loops warm; JAX_COMPILATION_CACHE_DIR
+# overrides (set empty to disable).
+if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", "/tmp/tfos_jax_cache"
+        )
+    except (AttributeError, ValueError):  # older jax: no such option
+        pass
+
 # ISSUE 15: arm the runtime lock-order sanitizer when TFOS_LOCKSAN=1
 # (the chaos CI lanes run this way).  Installed at conftest import so
 # every lock the suite creates — serving scheduler, watchdog,

@@ -143,6 +143,18 @@ def _full_record():
                      "int4_vs_int8": 0.955, "impl": "gather"},
             "pool": {"pool_pages": 253, "pool_pages_used": 17},
         },
+        "serving_disagg": {
+            "slots": 4, "max_new_tokens": 16, "rows": 24,
+            "mix": "1/3 long prompts (96-160 tok) among short (6-18)",
+            "unified": {"ttft_p50_ms": 20.4, "ttft_p99_ms": 408.2,
+                        "latency_p99_ms": 453.3, "rows_per_sec": 30.3,
+                        "prefill_wall_sec": 0.45},
+            "disagg": {"ttft_p50_ms": 26.2, "ttft_p99_ms": 409.7,
+                       "latency_p99_ms": 454.1, "rows_per_sec": 28.1,
+                       "prefill_wall_sec": 0.47},
+            "ttft_p50_ms": 26.2, "ttft_p99_ms": 409.7,
+            "serving_disagg_p99_gain": 0.996, "token_exact": True,
+        },
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
                         "resnet50": {"rows_per_sec": 51.5,
                                      "wire_mb_per_batch": 38.535},
@@ -217,6 +229,10 @@ def test_summary_is_compact_standalone_json(tmp_path):
     # paged KV plane (ISSUE 12): zero-copy cached admits + int4 decode
     assert parsed["paged_admit_gain"] == 4.637
     assert parsed["int4_tok_s"] == 958.6
+    # disaggregated prefill/decode plane (ISSUE 17): split-vs-unified
+    # TTFT p99 ratio + the split engine's TTFT p50
+    assert parsed["serving_disagg_p99_gain"] == 0.996
+    assert parsed["serving_ttft_ms"] == 26.2
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
     assert parsed["hier_ps_vs_sync"] == 0.92  # two-tier plane (ISSUE 9)
@@ -248,6 +264,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "fleet_goodput_2x", "fleet_affinity_hit_rate",
         "serving_prefix_gain", "spec_accept_rate",
         "paged_admit_gain", "int4_tok_s",
+        "serving_disagg_p99_gain", "serving_ttft_ms",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",
         "serving_u8_vs_f32",

@@ -456,7 +456,11 @@ def test_async_trainer_hierarchical_topology(shards):
         reply_codec="same",
     )
     p = w.init({"w": np.zeros(4, np.float32)})
-    for _ in range(120):
+    # 200 steps (not 120): under full-suite load the async push window
+    # lands fewer effective updates and 120 left one coordinate just
+    # past atol once — the quadratic converges geometrically, so the
+    # extra steps buy margin without changing what's under test
+    for _ in range(200):
         p = w.step(p, None)
     w.drain()
     np.testing.assert_allclose(

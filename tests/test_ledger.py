@@ -119,7 +119,8 @@ class TestUsageLedger:
             assert row[field] == t[field], field
         assert t == {
             "requests": 1, "tokens_in": 10, "tokens_out": 6,
-            "queue_wait_sec": 0.5, "chip_sec": 0.5, "page_sec": 3.0,
+            "queue_wait_sec": 0.5, "chip_sec": 0.5,
+            "prefill_chip_sec": 0.0, "page_sec": 3.0,
             "prefix_tokens_saved": 8, "wire_bytes": 40,
         }
 
