@@ -1235,6 +1235,8 @@ def run(
     recovery_timeout=120.0,
     profile_dir=None,
     profile_steps=None,
+    plan=None,
+    plan_hint=None,
 ):
     """Start a cluster over an executor fleet (reference: TFCluster.py:215-383).
 
@@ -1286,6 +1288,16 @@ def run(
         see tensorboard.start_profile and docs/observability.md).
       profile_steps: stop each capture after this many train steps
         (None = capture until the compute process exits).
+      plan: ``"auto"`` runs the cost-model planner for the training
+        workload (docs/autotune.md) and ships the chosen cadence
+        (``push_every`` / ``max_inflight``) to every node via
+        ``cluster_meta["plan"]`` — ``map_fun`` reads it off
+        ``ctx.cluster_meta`` instead of hand-setting the knobs.  The
+        decision is journaled (``planner_decision``) so ``forensics
+        explain`` answers "why this cadence".
+      plan_hint: workload facts for the planner (``batch``,
+        ``seq_len``, ``dcn_gbs``, model dims — see
+        ``planner.DEFAULT_HINT``).
     """
     from tensorflowonspark_tpu.engine import Engine, LocalEngine, SparkEngine
 
@@ -1401,6 +1413,24 @@ def run(
         "max_restarts": int(max_restarts),
         "heartbeat_interval": heartbeat_interval,
     }
+    if plan == "auto":
+        # cost-model cadence planning (ISSUE 18): the chosen
+        # push_every/max_inflight ride cluster_meta to every node;
+        # map_fun reads ctx.cluster_meta["plan"]["chosen"] instead of
+        # hand-setting the DCN knobs
+        from tensorflowonspark_tpu import planner as _planner
+
+        hint = dict(plan_hint or {})
+        p = _planner.plan(
+            model_config=hint.pop("model_config", None),
+            workload="train", hint=hint,
+        )
+        cluster_meta["plan"] = p.summary()
+        logger.info("planner: train cadence %s", p.summary()["chosen"])
+    elif plan is not None:
+        raise ValueError(
+            "plan must be 'auto' or None, got {0!r}".format(plan)
+        )
 
     # async start job: one blocking task per executor
     # (reference: TFCluster.py:316-334 daemon thread)

@@ -88,6 +88,7 @@ class NodeContext(object):
         manager_addr=None,
         manager_authkey=None,
         generation=0,
+        plan=None,
     ):
         self.executor_id = executor_id
         self.job_name = job_name
@@ -107,6 +108,12 @@ class NodeContext(object):
         #: undefined behavior, so we spawn and reconnect instead).
         self.manager_addr = manager_addr
         self.manager_authkey = manager_authkey
+        #: the driver-side planner's decision record when the cluster
+        #: was started with ``run(plan="auto")`` (docs/autotune.md) —
+        #: ``plan["chosen"]`` carries the DCN cadence (push_every /
+        #: max_inflight) the user fn hands to HierTrainer instead of
+        #: hand-set knobs; None otherwise.
+        self.plan = plan
         #: elastic re-rendezvous generation: 0 on the first launch, N
         #: after the Nth supervised restart — user code can log it or
         #: branch on "am I a restart" (checkpoint auto-resume needs
@@ -700,6 +707,7 @@ def run(fn, args, cluster_meta, input_mode, log_dir=None, tensorboard=False):
             device_info=node_meta["device_info"],
             manager_addr=list(adv_addr),
             manager_authkey=authkey.hex(),
+            plan=cluster_meta.get("plan"),
         )
 
         # 8. launch user fn (reference: TFSparkNode.py:375-431)

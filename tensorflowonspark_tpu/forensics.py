@@ -88,6 +88,18 @@ REMEDIATION_KINDS = (
     "remediation_rearmed",
 )
 
+#: Planner-plane event kinds (ISSUE 18): the cost-model planner's
+#: startup decision, the live re-planner's audited config changes,
+#: and the engine's between-chunk knob retunes.  Rendered as their
+#: own report section so ``explain`` answers "why did the config
+#: change?" with the triggering evidence.
+PLANNER_KINDS = (
+    "planner_decision",
+    "replan",
+    "engine_retune",
+    "push_every_retune",
+)
+
 #: Triggering event kind → the injected/root fault it implies (the
 #: chaos-plan vocabulary, testing/chaos.py — so an ``explain`` over a
 #: chaos run names the injected fault, and a real incident names its
@@ -410,6 +422,11 @@ def explain(paths, offsets=None, request=None):
     remediation = [
         ev for ev in timeline if ev["kind"] in REMEDIATION_KINDS
     ]
+    # the planner plane's audited decisions (ISSUE 18): why the config
+    # is what it is, and why (and on what evidence) it changed live
+    config_changes = [
+        ev for ev in timeline if ev["kind"] in PLANNER_KINDS
+    ]
     return {
         "incident": incident,
         "timeline": timeline,
@@ -418,6 +435,7 @@ def explain(paths, offsets=None, request=None):
         "events_by_kind": counts,
         "faults": faults,
         "remediation": remediation,
+        "config_changes": config_changes,
         "executors": sorted(
             {ev["executor"] for ev in timeline
              if ev["executor"] is not None},
@@ -561,6 +579,46 @@ def render_report(report):
             lines.append(
                 "    +{0:>9.3f}s  [{1:>4}] {2}".format(
                     ev["t"] - t0r, ev["severity"], desc
+                )
+            )
+    cfg = report.get("config_changes") or []
+    if cfg:
+        lines.append("-- config changes (why did the config "
+                     "change?) --")
+        t0c = report["timeline"][0]["t"] if report["timeline"] else 0.0
+        for ev in cfg[:20]:
+            attrs = ev.get("attrs") or {}
+            if ev["kind"] == "planner_decision":
+                desc = (
+                    "planned {0}: {1}  (gap to runner-up {2}%, "
+                    "profile: {3})".format(
+                        attrs.get("workload"),
+                        json.dumps(attrs.get("chosen") or {},
+                                   sort_keys=True)[:160],
+                        attrs.get("gap_pct"),
+                        attrs.get("profile_source"),
+                    )
+                )
+            elif ev["kind"] == "replan":
+                desc = "replan [{0}] {1}: {2} -> {3}{4}".format(
+                    attrs.get("trigger"), attrs.get("knob"),
+                    attrs.get("old"), attrs.get("new"),
+                    "" if attrs.get("applied") else " [not applied]",
+                )
+                evidence = attrs.get("evidence")
+                if evidence:
+                    desc += "  evidence: {0}".format(
+                        json.dumps(evidence, sort_keys=True)[:160]
+                    )
+            else:
+                desc = "{0} {1}".format(
+                    ev["kind"],
+                    json.dumps(attrs, sort_keys=True)[:140]
+                    if attrs else "",
+                ).rstrip()
+            lines.append(
+                "    +{0:>9.3f}s  [{1:>4}] {2}".format(
+                    ev["t"] - t0c, ev["severity"], desc
                 )
             )
     lines.append("-- clock-aligned timeline (fault-class + page "

@@ -2440,6 +2440,26 @@ def serving_builder(params, config):
     # weight quantization rebind them
     _raw_params, _raw_config = params, dict(config)
     cfg_fields = {f.name for f in dataclasses.fields(TransformerConfig)}
+    # unknown-key preflight (ISSUE 18): a typo'd knob (kv_page_token)
+    # used to fall through every config.get below and serve with the
+    # default, no signal — raise the named error listing the valid
+    # knob table instead
+    from tensorflowonspark_tpu.planner import knobs as knob_registry
+
+    knob_registry.validate_keys(config, cfg_fields)
+    plan_summary = None
+    if config.get("auto"):
+        # config={"auto": True, ...}: the cost-model planner fills
+        # every planner-owned knob the caller left unset; explicit
+        # keys win, so each decision is individually overridable
+        from tensorflowonspark_tpu.planner import auto_serving_config
+
+        config, _plan = auto_serving_config(config)
+        plan_summary = _plan.summary()
+        # replicas rebuild from the RESOLVED config: one plan (and one
+        # planner_decision journal event) per deployment, not per
+        # replica
+        _raw_config = dict(config)
     overrides = dict(config, attention_impl="dot", mesh=None)
     cfg = TransformerConfig(
         **{k: v for k, v in overrides.items() if k in cfg_fields}
@@ -2555,6 +2575,7 @@ def serving_builder(params, config):
                 return out
 
             predict_spec.last_spec_stats = {}
+            predict_spec.plan = plan_summary
             return predict_spec
 
         # ragged multi-request batching: predict_rows left-pads each
@@ -2737,6 +2758,10 @@ def serving_builder(params, config):
         predict.make_slot_decoder = make_slot_decoder
         predict.max_new_tokens = max_new
         predict.eos_id = eos_id
+        #: the planner's decision record when config={"auto": ...}
+        #: built this predictor (None otherwise) — predict_rows reads
+        #: engine-side picks (batch_size) off plan["chosen"]
+        predict.plan = plan_summary
         #: the serving mesh (None = unsharded) — fleet/replica.py skips
         #: its default-device pin for mesh predictors (the committed
         #: placements own the devices)
@@ -2773,7 +2798,7 @@ def serving_builder(params, config):
                 "steps": int(config.get("profile_steps", 0)) or None,
             }
         return predict
-    return base.make_serving_predict(
+    out = base.make_serving_predict(
         base.as_variables(params),
         lambda v, tokens: model.apply(v, jnp.asarray(tokens, jnp.int32)),
         config.get("input_name", "tokens"),
@@ -2782,3 +2807,5 @@ def serving_builder(params, config):
             "next_token": np.asarray(jnp.argmax(logits[:, -1], axis=-1)),
         },
     )
+    out.plan = plan_summary
+    return out

@@ -655,6 +655,32 @@ class HierTrainer(object):
         if self.addresses:
             self._open_link()
 
+    # -- live retune ---------------------------------------------------
+
+    def set_push_every(self, push_every):
+        """Retune the ICI-steps-per-DCN-window cadence in place.
+
+        Safe mid-training: ``push_every`` is read at every step's
+        window check, so the new cadence takes effect at the next
+        window boundary — no quiesce, no link rebuild.  This is the
+        actuation seam the live re-planner drives when measured DCN
+        RTT drifts off the planned cadence (push_every x step_time >
+        RTT).  Returns the previous value.
+        """
+        push_every = int(push_every)
+        if push_every < 1:
+            raise ValueError(
+                "push_every must be >= 1, got {0}".format(push_every)
+            )
+        old = self.push_every
+        self.push_every = push_every
+        if push_every != old:
+            self._tracer.mark(
+                "push_every_retune", trace="hier_ps",
+                old=old, new=push_every, pod=self.pod_id,
+            )
+        return old
+
     # -- election ------------------------------------------------------
 
     def leader(self):

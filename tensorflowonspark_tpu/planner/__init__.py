@@ -1,0 +1,69 @@
+"""Cost-model auto-parallelism planner with live re-planning
+(ISSUE 18).
+
+Three layers:
+
+- :mod:`~tensorflowonspark_tpu.planner.cost` — measured calibration
+  probes (cached per host; analytic roofline fallback) feeding a cost
+  model that prices candidate configs as modeled critical paths over
+  :func:`tensorflowonspark_tpu.forensics.critical_path`;
+- :mod:`~tensorflowonspark_tpu.planner.planner` — the search layer:
+  enumerate the legal knob lattice (pruned by the repo's own
+  validators), pick the min-modeled-critical-path point, log every
+  decision (``planner_decision`` journal events; ``python -m
+  tensorflowonspark_tpu.planner explain`` renders the story);
+- :mod:`~tensorflowonspark_tpu.planner.replan` — the live re-planner:
+  DCN-RTT drift retunes ``push_every``, prompt-mix shift regrows the
+  slot buckets, page occupancy resizes ``kv_pages`` — all through the
+  existing safe actuation seams, every change an audited ``replan``
+  journal event.
+
+Entry points: ``config="auto"``/``{"auto": True}`` on
+``serving_builder``/``load_predictor``; ``plan(workload="train")``
+for the hier-PS cadence; the knob registry in
+:mod:`~tensorflowonspark_tpu.planner.knobs` doubles as the builders'
+unknown-key validation surface.
+"""
+
+from tensorflowonspark_tpu.planner.cost import (
+    ROOFLINE,
+    CostModel,
+    DeviceProfile,
+    calibrate,
+    measure_dcn_rtt,
+    probes_enabled,
+)
+from tensorflowonspark_tpu.planner.knobs import (
+    KNOBS,
+    UnknownKnobError,
+    planner_owned,
+    render_table,
+    validate_keys,
+)
+from tensorflowonspark_tpu.planner.planner import (
+    Plan,
+    auto_serving_config,
+    plan,
+    validate_candidate,
+)
+from tensorflowonspark_tpu.planner.replan import LivePlanner, Replan
+
+__all__ = [
+    "ROOFLINE",
+    "CostModel",
+    "DeviceProfile",
+    "KNOBS",
+    "LivePlanner",
+    "Plan",
+    "Replan",
+    "UnknownKnobError",
+    "auto_serving_config",
+    "calibrate",
+    "measure_dcn_rtt",
+    "plan",
+    "planner_owned",
+    "probes_enabled",
+    "render_table",
+    "validate_candidate",
+    "validate_keys",
+]

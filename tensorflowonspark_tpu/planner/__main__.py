@@ -1,0 +1,81 @@
+"""``python -m tensorflowonspark_tpu.planner`` — the planner CLI.
+
+``explain`` plans a workload and prints the chosen point, the
+runner-up, and the modeled gap (ISSUE 18's "why is the config what it
+is" surface); ``knobs`` prints the registry table docs/autotune.md
+embeds.
+"""
+
+import argparse
+import json
+import sys
+
+
+def _parse_json(text, what):
+    if not text:
+        return {}
+    try:
+        got = json.loads(text)
+    except ValueError as e:
+        raise SystemExit("bad {0} JSON: {1}".format(what, e))
+    if not isinstance(got, dict):
+        raise SystemExit("{0} must be a JSON object".format(what))
+    return got
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m tensorflowonspark_tpu.planner",
+        description="cost-model auto-parallelism planner",
+    )
+    sub = ap.add_subparsers(dest="cmd")
+    ex = sub.add_parser(
+        "explain",
+        help="plan a workload and print the decision story",
+    )
+    ex.add_argument("--workload", choices=("serving", "train"),
+                    default="serving")
+    ex.add_argument("--devices", type=int, default=None,
+                    help="device count (default: local jax backend)")
+    ex.add_argument("--config", default="",
+                    help="model config JSON (TransformerConfig fields "
+                         "+ any pinned knobs)")
+    ex.add_argument("--hint", default="",
+                    help="workload hint JSON (prompt_tokens, qps, "
+                         "mixed, shared_prefix_frac, ...)")
+    ex.add_argument("--no-probes", action="store_true",
+                    help="use the analytic roofline instead of "
+                         "calibration probes")
+    ex.add_argument("--json", action="store_true",
+                    help="emit the plan summary as JSON")
+    sub.add_parser("knobs", help="print the knob registry table")
+    args = ap.parse_args(argv)
+
+    from tensorflowonspark_tpu import planner as P
+
+    if args.cmd == "knobs":
+        print(P.render_table())
+        return 0
+    if args.cmd != "explain":
+        ap.print_help()
+        return 2
+
+    config = _parse_json(args.config, "--config")
+    hint = _parse_json(args.hint, "--hint")
+    owned = {k.name for k in P.planner_owned()}
+    overrides = {k: v for k, v in config.items() if k in owned}
+    profile = P.calibrate(probes=False) if args.no_probes else None
+    p = P.plan(
+        model_config=config, workload=args.workload,
+        device_count=args.devices, hint=hint, profile=profile,
+        overrides=overrides,
+    )
+    if args.json:
+        print(json.dumps(p.summary(), indent=2, sort_keys=True))
+    else:
+        print(p.explain())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,422 @@
+"""The planner's cost model: measured probes in, modeled critical
+paths out (ISSUE 18).
+
+Pricing reuses the repo's OWN critical-path engine
+(:func:`tensorflowonspark_tpu.forensics.critical_path`, PR 11): a
+candidate config is rendered as the synthetic span tree its execution
+would record — queue wait, prefill, decode chunks with their HBM /
+collective / dispatch-overhead components, or ICI step windows
+overlapped with DCN pushes — and the walk over that tree gives the
+modeled end-to-end seconds plus the binding phase.  The span
+*self-times* come from a short startup calibration pass
+(:func:`calibrate`): micro-bench matmul, memory-bandwidth, collective
+and DCN-RTT probes, cached per host so repeat runs skip the bench.
+With probes disabled (``TFOS_PLANNER_PROBES=0`` or
+``calibrate(probes=False)``) an analytic roofline table prices the
+same spans — same search, coarser numbers.
+"""
+
+import json
+import logging
+import os
+import socket
+import time
+
+from tensorflowonspark_tpu import forensics, telemetry
+
+logger = logging.getLogger(__name__)
+
+#: analytic roofline fallback per platform: (matmul GFLOP/s per
+#: device, HBM/mem GB/s, collective latency floor sec, DCN RTT sec).
+#: TPU numbers are the v4 datasheet ballpark; CPU numbers a
+#: conservative laptop-class core — the point of the fallback is
+#: RANKING candidates, not absolute seconds.
+ROOFLINE = {
+    "tpu": (137000.0, 1200.0, 15e-6, 1e-3),
+    "gpu": (60000.0, 900.0, 20e-6, 1e-3),
+    "cpu": (40.0, 8.0, 50e-6, 0.5e-3),
+}
+
+def _registry():
+    # call-time lookup (the serving_engine idiom): handles taken at
+    # import time would go stale across test registry resets
+    return telemetry.get_registry()
+
+
+class DeviceProfile(object):
+    """What one host's devices measure: the numbers every span price
+    derives from.  ``source`` records how they were obtained —
+    ``probe`` (micro-bench), ``cache`` (per-host JSON), ``roofline``
+    (analytic fallback)."""
+
+    FIELDS = ("platform", "device_count", "matmul_gflops", "mem_gbs",
+              "collective_lat_sec", "dcn_rtt_sec", "source", "host")
+
+    def __init__(self, platform, device_count, matmul_gflops, mem_gbs,
+                 collective_lat_sec, dcn_rtt_sec, source="roofline",
+                 host=None):
+        self.platform = str(platform)
+        self.device_count = int(device_count)
+        self.matmul_gflops = float(matmul_gflops)
+        self.mem_gbs = float(mem_gbs)
+        self.collective_lat_sec = float(collective_lat_sec)
+        self.dcn_rtt_sec = float(dcn_rtt_sec)
+        self.source = source
+        self.host = host or socket.gethostname()
+
+    def to_dict(self):
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{f: d[f] for f in cls.FIELDS if f in d})
+
+    def __repr__(self):
+        return ("DeviceProfile({0} x{1}, {2:.1f} GFLOP/s, {3:.1f} "
+                "GB/s, dcn {4:.2f}ms, {5})").format(
+                    self.platform, self.device_count,
+                    self.matmul_gflops, self.mem_gbs,
+                    1e3 * self.dcn_rtt_sec, self.source)
+
+
+def probes_enabled():
+    """Probe gate: ``TFOS_PLANNER_PROBES=0`` forces the analytic
+    roofline fallback (CI determinism; air-gapped startup paths)."""
+    return os.environ.get("TFOS_PLANNER_PROBES", "1") not in (
+        "0", "false", "off"
+    )
+
+
+def _cache_path(platform, device_count):
+    base = os.environ.get("TFOS_PLANNER_CACHE")
+    if base is None:
+        base = os.path.join(
+            os.path.expanduser("~"), ".cache", "tfos_planner"
+        )
+    return os.path.join(base, "profile-{0}-{1}-x{2}.json".format(
+        socket.gethostname(), platform, device_count
+    ))
+
+
+def _probe_matmul(n=384, repeats=3):
+    """Best-of-N jitted f32 matmul GFLOP/s on the default backend."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((n, n), jnp.float32)
+    f = jax.jit(lambda a: a @ a)
+    f(x).block_until_ready()  # compile off the clock
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return (2.0 * n ** 3 / best) / 1e9
+
+
+def _probe_mem(mb=32, repeats=3):
+    """Streaming-read GB/s: sum over a buffer too big for L2."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mb * (1 << 20) // 4
+    x = jnp.ones((n,), jnp.float32)
+    f = jax.jit(jnp.sum)
+    f(x).block_until_ready()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return (4.0 * n / best) / 1e9
+
+
+def _probe_collective(repeats=3):
+    """Small all-reduce latency floor across the local devices; None
+    on a single device (the roofline constant fills in)."""
+    import jax
+    import jax.numpy as jnp
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    f = jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")
+    x = jnp.ones((len(devs), 8), jnp.float32)
+    f(x).block_until_ready()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        f(x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_dcn_rtt(addr, samples=3, timeout=5.0, payload=b"tfos-rtt"):
+    """Measured cross-pod RTT: TCP round-trips of a tiny payload to an
+    echo endpoint ``(host, port)``.  This is the live re-planner's
+    drift sensor — in the chaos e2e the endpoint sits behind a
+    ``testing.chaos.TcpGremlin``, so an injected ``delay`` IS a
+    measured drift.  Returns the best (minimum) of ``samples`` — RTT
+    floors, not tail noise, drive the cadence rule."""
+    best = float("inf")
+    for _ in range(max(1, int(samples))):
+        with socket.create_connection(addr, timeout=timeout) as s:
+            t0 = time.perf_counter()
+            s.sendall(payload)
+            got = b""
+            while len(got) < len(payload):
+                chunk = s.recv(len(payload) - len(got))
+                if not chunk:
+                    break
+                got += chunk
+            best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def calibrate(probes=None, cache=True, dcn_addr=None, force=False):
+    """The startup calibration pass -> :class:`DeviceProfile`.
+
+    Probe results are cached per (host, platform, device count) under
+    ``~/.cache/tfos_planner`` (``TFOS_PLANNER_CACHE`` overrides), so
+    only the first run on a host pays the micro-bench.  ``probes=
+    False`` (or ``TFOS_PLANNER_PROBES=0``) returns the
+    :data:`ROOFLINE` row for the platform unmeasured.  ``dcn_addr``
+    optionally replaces the roofline DCN RTT with a measured TCP
+    round-trip (:func:`measure_dcn_rtt`)."""
+    import jax
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    n = len(devs)
+    base = ROOFLINE.get(platform, ROOFLINE["cpu"])
+    if probes is None:
+        probes = probes_enabled()
+    if not probes:
+        return DeviceProfile(platform, n, *base, source="roofline")
+    path = _cache_path(platform, n)
+    if cache and not force and os.path.exists(path):
+        try:
+            with open(path) as f:
+                prof = DeviceProfile.from_dict(json.load(f))
+            prof.source = "cache"
+            if dcn_addr is not None:
+                prof.dcn_rtt_sec = measure_dcn_rtt(dcn_addr)
+            return prof
+        except (OSError, ValueError, KeyError, TypeError):
+            pass  # unreadable cache: re-probe and rewrite
+    t0 = time.perf_counter()
+    _registry().counter("planner.calibrations").inc()
+    coll = _probe_collective()
+    prof = DeviceProfile(
+        platform, n,
+        matmul_gflops=_probe_matmul(),
+        mem_gbs=_probe_mem(),
+        collective_lat_sec=coll if coll is not None else base[2],
+        dcn_rtt_sec=(
+            measure_dcn_rtt(dcn_addr) if dcn_addr is not None
+            else base[3]
+        ),
+        source="probe",
+    )
+    _registry().histogram("planner.calibration_sec").observe(
+        time.perf_counter() - t0
+    )
+    if cache:
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(prof.to_dict(), f)
+        except OSError as e:
+            logger.debug("planner: profile cache not writable: %s", e)
+    return prof
+
+
+# ----------------------------------------------------------------------
+# candidate pricing
+# ----------------------------------------------------------------------
+
+
+def _bytes_per_weight(weights):
+    return {"int8": 1.0, "int4": 0.5}.get(weights, 4.0)
+
+
+def _param_count(mc):
+    """Approximate transformer parameter count from config dims."""
+    E = int(mc.get("embed_dim", 64))
+    L = int(mc.get("num_layers", 2))
+    H = int(mc.get("num_heads", 4))
+    Hkv = int(mc.get("num_kv_heads", H))
+    D = int(mc.get("head_dim", E // max(1, H)))
+    F = int(mc.get("mlp_dim", 4 * E))
+    V = int(mc.get("vocab_size", 256))
+    attn = E * H * D + 2 * E * Hkv * D + H * D * E
+    return V * E + L * (attn + 2 * E * F)
+
+
+def _kv_bytes_per_token(mc):
+    L = int(mc.get("num_layers", 2))
+    H = int(mc.get("num_heads", 4))
+    Hkv = int(mc.get("num_kv_heads", H))
+    D = int(mc.get("head_dim", 16))
+    per = 1.0 if mc.get("cache_dtype") == "int8" else 4.0
+    return 2.0 * L * Hkv * D * per
+
+
+class CostModel(object):
+    """Prices candidate configs as modeled critical paths over the
+    measured :class:`DeviceProfile`.
+
+    Every ``price_*`` method builds the synthetic span tree the
+    candidate would record (ids/parents/t0/dur — the tracer's record
+    shape) and runs :func:`forensics.critical_path` over it; the
+    result carries ``total_sec``, the walked ``path``, and
+    ``bottleneck`` — the component with the largest modeled
+    self-time, the planner's "why this config" answer."""
+
+    #: fixed host-side cost per engine dispatch (queue pop, stack,
+    #: transfer glue) — measured ~0.5-2ms on the CPU substrate; the
+    #: chunk_size knob trades this against admit latency
+    DISPATCH_OVERHEAD_SEC = 1e-3
+
+    def __init__(self, profile):
+        self.profile = profile
+
+    # -- span plumbing --------------------------------------------------
+
+    @staticmethod
+    def _walk(spans, components):
+        cp = forensics.critical_path(spans)
+        bottleneck = None
+        if components:
+            bottleneck = max(components.items(), key=lambda kv: kv[1])[0]
+        return {
+            "total_sec": cp["total_sec"],
+            "path": cp["path"],
+            "dominant_phase": cp["dominant_phase"],
+            "bottleneck": bottleneck,
+            "components": components,
+        }
+
+    # -- serving --------------------------------------------------------
+
+    def price_serving(self, model_config, cand, hint):
+        """Modeled per-request seconds for a continuous-batching
+        serving candidate at the hinted workload.
+
+        Spans: ``request`` > (``queue_wait``, ``prefill``, ``decode``)
+        with ``decode`` > (``decode_hbm``, ``decode_collective``,
+        ``dispatch_overhead``) — decode components start together and
+        the one ending last is the link the walk descends into."""
+        p = self.profile
+        mc = model_config
+        tp = int(cand.get("tp") or 1)
+        slots = int(cand.get("batch_size", 8))
+        chunk = int(cand.get("chunk_size", 16))
+        weights = cand.get("weights") or cand.get("quantize")
+        prompt = float(hint.get("prompt_tokens", 32))
+        max_new = int(
+            cand.get("max_new_tokens")
+            or mc.get("max_new_tokens") or 16
+        )
+        shared = float(hint.get("shared_prefix_frac", 0.0))
+        if cand.get("prefix_cache"):
+            prompt = prompt * (1.0 - 0.9 * shared)
+
+        params = _param_count(mc)
+        wbytes = params * _bytes_per_weight(weights)
+        gflops = p.matmul_gflops * tp
+        # prefill: compute-bound batched matmuls over the prompt
+        prefill = (2.0 * params * prompt * slots) / (gflops * 1e9)
+        if tp > 1:
+            prefill += int(mc.get("num_layers", 2)) * p.collective_lat_sec
+        # decode: bandwidth-bound — every step re-reads the weights
+        # (sharded over tp) and the resident KV of all slots
+        ctx = prompt + 0.5 * max_new
+        kv = _kv_bytes_per_token(mc) * ctx * slots
+        paged_factor = 1.1 if cand.get("kv_layout") == "paged" else 1.0
+        step = ((wbytes / tp + kv * paged_factor)
+                / (p.mem_gbs * 1e9))
+        coll = (int(mc.get("num_layers", 2)) * p.collective_lat_sec
+                if tp > 1 else 0.0)
+        hbm_total = max_new * step
+        coll_total = max_new * coll
+        n_chunks = max(1, (max_new + chunk - 1) // chunk)
+        overhead = n_chunks * self.DISPATCH_OVERHEAD_SEC
+        decode = hbm_total + coll_total + overhead
+        # queue wait under the hinted offered load: rows queue while a
+        # full generation turns over the slots
+        qps = float(hint.get("qps", 0.0))
+        service = max(1e-9, prefill + decode)
+        queue = 0.0
+        if qps > 0:
+            util = qps * service / max(1, slots)
+            queue = service * min(8.0, util ** 2 / max(1e-6, 1 - util)) \
+                if util < 1 else 8.0 * service
+        spans = [
+            {"id": 1, "parent": None, "name": "request", "t0": 0.0,
+             "dur": queue + prefill + decode, "trace": "plan"},
+            {"id": 2, "parent": 1, "name": "queue_wait", "t0": 0.0,
+             "dur": queue, "trace": "plan"},
+            {"id": 3, "parent": 1, "name": "prefill", "t0": queue,
+             "dur": prefill, "trace": "plan"},
+            {"id": 4, "parent": 1, "name": "decode",
+             "t0": queue + prefill, "dur": decode, "trace": "plan"},
+            {"id": 5, "parent": 4, "name": "decode_hbm",
+             "t0": queue + prefill, "dur": hbm_total, "trace": "plan"},
+            {"id": 6, "parent": 4, "name": "decode_collective",
+             "t0": queue + prefill, "dur": coll_total, "trace": "plan"},
+            {"id": 7, "parent": 4, "name": "dispatch_overhead",
+             "t0": queue + prefill, "dur": overhead, "trace": "plan"},
+        ]
+        return self._walk(spans, {
+            "queue_wait": queue, "prefill": prefill,
+            "decode_hbm": hbm_total, "decode_collective": coll_total,
+            "dispatch_overhead": overhead,
+        })
+
+    # -- training (hierarchical data parallel) --------------------------
+
+    def price_train(self, model_config, cand, hint):
+        """Modeled per-step seconds for a hier-PS training candidate.
+
+        Spans: one steady-state DCN ``window`` > (``ici_steps``,
+        ``dcn_push``) — the push overlaps compute across
+        ``max_inflight`` windows, so its effective span is
+        ``dcn_time / max_inflight``; whichever child ends last is the
+        binding constraint (the docs/communication.md cadence rule,
+        priced instead of hand-applied)."""
+        p = self.profile
+        pe = int(cand.get("push_every", 8))
+        inflight = int(cand.get("max_inflight", 2))
+        batch = float(hint.get("batch", 8))
+        seq = float(hint.get("seq_len", 128))
+        params = _param_count(model_config)
+        flops = 6.0 * params * batch * seq  # fwd + bwd
+        step = flops / (p.matmul_gflops * p.device_count * 1e9)
+        step += p.collective_lat_sec  # per-step ICI all-reduce floor
+        grad_bytes = 4.0 * params * float(
+            hint.get("dcn_compression", 1.0)
+        )
+        dcn_bw = float(hint.get("dcn_gbs", 1.0)) * 1e9
+        dcn = p.dcn_rtt_sec + grad_bytes / dcn_bw
+        ici = pe * step
+        dcn_eff = dcn / max(1, inflight)
+        window = max(ici, dcn_eff)
+        spans = [
+            {"id": 1, "parent": None, "name": "window", "t0": 0.0,
+             "dur": window, "trace": "plan"},
+            {"id": 2, "parent": 1, "name": "ici_steps", "t0": 0.0,
+             "dur": ici, "trace": "plan"},
+            {"id": 3, "parent": 1, "name": "dcn_push", "t0": 0.0,
+             "dur": dcn_eff, "trace": "plan"},
+        ]
+        priced = self._walk(spans, {
+            "ici_steps": ici, "dcn_push": dcn_eff,
+        })
+        priced["per_step_sec"] = window / pe
+        priced["step_sec"] = step
+        # the cadence rule as a priced quantity: windows shorter than
+        # the RTT serialize on acks — surfaced so explain() can show
+        # WHY a push_every was rejected, not just that it cost more
+        priced["cadence_ok"] = ici > p.dcn_rtt_sec
+        return priced

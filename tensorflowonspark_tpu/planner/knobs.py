@@ -1,0 +1,181 @@
+"""The knob registry: every tunable the stack exposes, in one table.
+
+Two consumers (ISSUE 18):
+
+- the **planner** reads it as the search-space inventory — which knobs
+  exist, which subsystem owns each, which the search layer may set vs.
+  which are workload facts the caller states (``docs/autotune.md``
+  renders this table);
+- the **builders** read it as the validation surface — an unknown key
+  in ``serving_builder``'s config or ``load_predictor(
+  config_overrides=)`` raises :class:`UnknownKnobError` naming the
+  near-misses and the valid table, instead of silently degrading to
+  defaults (the ``kv_page_token`` typo bug).
+
+Import-light on purpose: no jax, no sibling modules — the transformer
+builder calls into here at build time.
+"""
+
+import collections
+import difflib
+
+#: one registry row.  ``planner`` marks knobs the search layer itself
+#: assigns (vs. workload facts / escape hatches the caller states);
+#: ``subsystem`` groups the docs table and scopes validation.
+Knob = collections.namedtuple(
+    "Knob", ["name", "subsystem", "default", "planner", "desc"]
+)
+
+
+def _k(subsystem, planner, *rows):
+    return [
+        Knob(name, subsystem, default, planner, desc)
+        for name, default, desc in rows
+    ]
+
+
+#: the full inventory.  ``serving`` rows are the non-TransformerConfig
+#: keys ``models/transformer.serving_builder`` reads (its validation
+#: set = these + the TransformerConfig field names); ``engine`` rows
+#: are ``predict_rows``/ServingEngine arguments; ``train`` rows are
+#: the hierarchical data-parallel plane's.
+KNOBS = (
+    _k("serving", False, *[
+        ("mode", None, "builder mode: 'generate' or logits serving"),
+        ("auto", False, "fill every unset planner-owned knob from the "
+                        "cost-model planner (ISSUE 18)"),
+        ("max_new_tokens", None, "decode budget per request (required "
+                                 "in generate mode)"),
+        ("temperature", 0.0, "sampling temperature (0 = greedy)"),
+        ("top_k", 0, "top-k sampling cutoff (0 = off)"),
+        ("top_p", 0.0, "nucleus sampling cutoff (0 = off)"),
+        ("seed", 0, "sampling PRNG seed"),
+        ("speculative", False, "static-path speculative decoding "
+                               "(greedy-only)"),
+        ("ngram", 2, "n-gram order for draft-free speculation"),
+        ("pad_id", 0, "prompt pad token id"),
+        ("eos_id", None, "stop token id (None = run to budget)"),
+        ("input_name", "tokens", "prompt column name"),
+        ("draft_config", None, "draft model TransformerConfig fields "
+                               "(arms draft-model speculation)"),
+        ("draft_params", None, "in-process draft weights"),
+        ("profile_dir", None, "on-demand jax.profiler capture dir"),
+        ("profile_steps", 0, "profiler capture length in decode "
+                             "chunks"),
+        ("check_tiles", None, "force the Mosaic tile-legality "
+                              "preflight on/off"),
+        ("mesh_shape", None, "explicit {axis: size} serving mesh"),
+    ]),
+    _k("serving", True, *[
+        ("weights", None, "weight dtype: 'int8'/'int4'/'float'"),
+        ("quantize", None, "pre-ISSUE-12 alias of weights"),
+        ("int4_group", 64, "int4 group-wise scale width"),
+        ("draft_len", 4, "speculative draft length per round"),
+        ("pad_multiple", 64, "prompt-length bucket width"),
+        ("max_prompt_len", None, "cache sized to bucket(max_prompt_"
+                                 "len) + max_new instead of "
+                                 "max_seq_len"),
+        ("chunk_size", 16, "decode steps between admit/evict points"),
+        ("prefix_cache", False, "cross-request radix KV reuse"),
+        ("prefix_block", 16, "radix block width (tokens)"),
+        ("prefix_mem_mb", 256.0, "prefix-cache HBM budget"),
+        ("kv_layout", "contiguous", "'contiguous' or 'paged' KV"),
+        ("kv_pages", None, "physical page-pool size (paged layout); "
+                           "must hold slots x blocks + 1"),
+        ("kv_page_tokens", None, "page width in tokens (defaults to "
+                                 "prefix_block)"),
+        ("paged_impl", None, "'kernel' (pallas) or 'gather' (XLA)"),
+        ("tp", None, "tensor-parallel degree (model-axis mesh)"),
+        ("disaggregate", False, "split prefill into its own jitted "
+                                "worker (paged layout only)"),
+    ]),
+    _k("engine", True, *[
+        ("batch_size", 32, "static batch / continuous slot count"),
+        ("schedule", "static", "'static' or 'continuous' batching"),
+        ("queue_depth", 64, "bounded admission queue length"),
+        ("policy", "block", "overload policy: block/reject/degrade"),
+        ("watchdog_timeout", None, "per-chunk dispatch watchdog (sec)"),
+        ("default_deadline", None, "per-request deadline default "
+                                   "(sec)"),
+        ("replicas", 1, "fleet replica count"),
+    ]),
+    _k("train", True, *[
+        ("push_every", 8, "ICI steps per DCN window (cadence rule: "
+                          "push_every x step_time > DCN RTT — "
+                          "planner-owned since ISSUE 18)"),
+        ("max_inflight", 2, "unacked DCN windows before the leader "
+                            "blocks"),
+        ("num_ps", 0, "parameter-server task count"),
+    ]),
+)
+KNOBS = tuple(k for group in KNOBS for k in group)
+
+#: name -> Knob
+BY_NAME = {k.name: k for k in KNOBS}
+
+#: the keys ``serving_builder`` accepts beyond TransformerConfig fields
+SERVING_KEYS = frozenset(
+    k.name for k in KNOBS if k.subsystem == "serving"
+)
+
+
+class UnknownKnobError(ValueError):
+    """An unknown config key reached a builder — named error instead
+    of a silent degrade-to-default (ISSUE 18 satellite: a typo'd
+    ``kv_page_token`` used to fall through every ``config.get`` and
+    serve with the default page width, no signal).  Carries the
+    offending keys, per-key suggestions, and the valid table."""
+
+    def __init__(self, unknown, valid, where):
+        self.unknown = tuple(sorted(unknown))
+        self.valid = tuple(sorted(valid))
+        self.where = where
+        parts = []
+        for key in self.unknown:
+            close = difflib.get_close_matches(key, self.valid, n=2)
+            parts.append(
+                "{0!r}{1}".format(
+                    key,
+                    " (did you mean {0}?)".format(
+                        " or ".join(repr(c) for c in close)
+                    ) if close else "",
+                )
+            )
+        super(UnknownKnobError, self).__init__(
+            "unknown config key(s) for {0}: {1}.  Valid keys: {2}".format(
+                where, ", ".join(parts), ", ".join(self.valid)
+            )
+        )
+
+
+def validate_keys(config, extra_valid=(), where="serving_builder"):
+    """Raise :class:`UnknownKnobError` when ``config`` holds keys that
+    are neither registry serving knobs nor ``extra_valid`` (the
+    caller's TransformerConfig field names)."""
+    valid = SERVING_KEYS | frozenset(extra_valid)
+    unknown = [k for k in config if k not in valid]
+    if unknown:
+        raise UnknownKnobError(unknown, valid, where)
+
+
+def planner_owned(subsystem=None):
+    """The knobs the search layer assigns (``docs/autotune.md``'s
+    search-space table rows)."""
+    return [
+        k for k in KNOBS
+        if k.planner and (subsystem is None or k.subsystem == subsystem)
+    ]
+
+
+def render_table(knobs=None):
+    """Markdown table of (a subset of) the registry — the CLI's and
+    docs' rendering."""
+    rows = list(knobs if knobs is not None else KNOBS)
+    out = ["| knob | subsystem | default | planner-set | description |",
+           "|---|---|---|---|---|"]
+    for k in rows:
+        out.append("| `{0}` | {1} | `{2!r}` | {3} | {4} |".format(
+            k.name, k.subsystem, k.default,
+            "yes" if k.planner else "no", k.desc,
+        ))
+    return "\n".join(out)

@@ -1,0 +1,457 @@
+"""The search layer: enumerate the legal knob lattice, price every
+point with the cost model, pick the min-modeled-critical-path point
+(ISSUE 18; PAPERS: "it's the critical path", not greedy per-axis
+choices).
+
+Legality is the EXISTING validators', not a parallel rulebook:
+``ops/paged_attention.check_tiles`` for paged kernel geometries, the
+SlotDecoder pool rule (``kv_pages >= slots x span + 1``), mesh
+divisibility (``parallel/mesh.serving_mesh`` semantics), the
+``pad_cap`` bucketing bound, the quantized-weights x mesh exclusion
+and the greedy-only speculative constraint — a candidate the planner
+emits is a candidate the builders accept (property-tested in
+tests/test_planner.py).
+
+Every decision is logged: the chosen point, the runner-up and the
+modeled gap ride a typed ``planner_decision`` journal event (the
+tracer mark auto-bridges), so ``forensics explain`` can answer "why
+is the config what it is"; :meth:`Plan.explain` renders the same
+story as text (the ``python -m tensorflowonspark_tpu.planner
+explain`` CLI).
+"""
+
+import itertools
+import logging
+import time
+
+from tensorflowonspark_tpu import telemetry
+from tensorflowonspark_tpu.planner import cost as cost_mod
+from tensorflowonspark_tpu.planner import knobs as knobs_mod
+
+logger = logging.getLogger(__name__)
+
+#: default workload facts when the caller gives no hint — a short
+#: interactive generation mix
+DEFAULT_HINT = {
+    "prompt_tokens": 32, "prompt_max": 64, "qps": 0.0,
+    "shared_prefix_frac": 0.0, "mixed": False,
+    "batch": 8, "seq_len": 128, "dcn_gbs": 1.0, "dcn_compression": 1.0,
+}
+
+#: the serving lattice axes the search sweeps (overrides pin axes to
+#: one value); slots/chunk powers of two keep the compiled-program
+#: bucket count bounded
+SERVING_AXES = {
+    "batch_size": (4, 8, 16, 32),
+    "chunk_size": (4, 8, 16, 32),
+    "kv_layout": ("contiguous", "paged"),
+    "kv_page_tokens": (8, 16, 32),
+    "tp": (1, 2, 4, 8),
+}
+TRAIN_AXES = {
+    "push_every": (1, 2, 4, 8, 16, 32, 64),
+    "max_inflight": (1, 2, 4),
+}
+
+
+def _bucket(n, multiple):
+    return ((int(n) + multiple - 1) // multiple) * multiple
+
+
+def _page_span(model_config, cand):
+    """Blocks per slot at this geometry — the SlotDecoder table
+    width the pool rule is stated over."""
+    max_new = int(cand.get("max_new_tokens")
+                  or model_config.get("max_new_tokens") or 16)
+    cache_len = int(model_config.get("max_seq_len", 256))
+    if cand.get("max_prompt_len"):
+        b = _bucket(cand["max_prompt_len"], cand.get("pad_multiple", 64))
+        cache_len = min(cache_len, b + max_new)
+    pt = int(cand.get("kv_page_tokens") or cand.get("prefix_block") or 16)
+    return (cache_len + pt - 1) // pt
+
+
+def validate_candidate(model_config, cand, device_count=1):
+    """``None`` when every legality validator the planner claims to
+    respect accepts ``cand``; else the rejection reason.  The property
+    test sweeps planner OUTPUT through this with randomized shapes —
+    and this function delegates to the real validators, so the claim
+    is checked against the code that enforces it at build time."""
+    mc = model_config
+    tp = int(cand.get("tp") or 1)
+    if tp > 1:
+        if device_count % tp:
+            return "tp={0} does not divide {1} devices".format(
+                tp, device_count
+            )
+        if int(mc.get("num_heads", 4)) % tp \
+                or int(mc.get("num_kv_heads", mc.get("num_heads", 4))) % tp:
+            return "tp={0} does not divide the head counts".format(tp)
+        weights = cand.get("weights") or cand.get("quantize")
+        if weights in ("int8", "int4"):
+            # SlotDecoder's quantized-weights x mesh exclusion
+            return "quantized weights cannot shard over a mesh"
+    if cand.get("disaggregate") and cand.get("kv_layout") != "paged":
+        return "disaggregate needs kv_layout='paged'"
+    if cand.get("speculative") and float(cand.get("temperature", 0.0)):
+        return "speculative serving is greedy-only"
+    if cand.get("kv_layout") == "paged":
+        pt = int(cand.get("kv_page_tokens")
+                 or cand.get("prefix_block") or 16)
+        if cand.get("paged_impl", "kernel") == "kernel" and tp == 1:
+            from tensorflowonspark_tpu.ops import paged_attention as pa
+
+            try:
+                pa.check_tiles(
+                    pt, int(mc.get("head_dim", 16)),
+                    "int8" if mc.get("cache_dtype") == "int8"
+                    else mc.get("dtype", "float32"),
+                )
+            except pa.TileLegalityError as e:
+                return "tile-illegal paged geometry: {0}".format(e)
+        if cand.get("kv_pages") is not None:
+            span = _page_span(mc, cand)
+            slots = int(cand.get("batch_size", 8))
+            need = slots * span + 1
+            if int(cand["kv_pages"]) < need:
+                return ("kv_pages={0} below the pool rule "
+                        "slots x span + 1 = {1}").format(
+                            cand["kv_pages"], need)
+    # pad_cap: bucketing must never push a fitting prompt past the
+    # cache (serving.py honors predict.pad_cap when left-padding)
+    max_new = int(cand.get("max_new_tokens")
+                  or mc.get("max_new_tokens") or 16)
+    cap = int(mc.get("max_seq_len", 256)) - max_new
+    if cap < 1:
+        return "max_new_tokens leaves no cache room for prompts"
+    if cand.get("max_prompt_len") and int(cand["max_prompt_len"]) > cap:
+        return "max_prompt_len {0} beyond pad_cap {1}".format(
+            cand["max_prompt_len"], cap
+        )
+    return None
+
+
+def _serving_candidates(model_config, device_count, hint, overrides):
+    """The pruned serving lattice (generator of candidate dicts)."""
+    axes = {}
+    for name, values in SERVING_AXES.items():
+        if name in overrides:
+            axes[name] = (overrides[name],)
+        else:
+            axes[name] = values
+    shared = float(hint.get("shared_prefix_frac", 0.0))
+    prompt_max = int(hint.get("prompt_max", hint.get("prompt_tokens", 64)))
+    names = sorted(axes)
+    for point in itertools.product(*(axes[n] for n in names)):
+        cand = dict(zip(names, point))
+        if cand["kv_layout"] == "contiguous":
+            if cand.get("kv_page_tokens") != SERVING_AXES[
+                    "kv_page_tokens"][0] and "kv_page_tokens" not in \
+                    overrides:
+                continue  # page width is meaningless off-paged: dedup
+            cand["kv_page_tokens"] = None
+        # decisions computed, not searched: prefix reuse follows the
+        # workload's shared fraction; disaggregation follows the mixed
+        # prompt mix (the regime the split exists for, ISSUE 17); the
+        # pool is sized by the rule with headroom
+        cand["prefix_cache"] = overrides.get(
+            "prefix_cache", shared >= 0.2
+        )
+        cand["disaggregate"] = overrides.get(
+            "disaggregate",
+            bool(hint.get("mixed")) and cand["kv_layout"] == "paged",
+        )
+        cand["max_prompt_len"] = overrides.get(
+            "max_prompt_len",
+            prompt_max if prompt_max and prompt_max < int(
+                model_config.get("max_seq_len", 256)
+            ) else None,
+        )
+        cand["pad_multiple"] = overrides.get("pad_multiple", 16)
+        if cand["kv_layout"] == "paged":
+            span = _page_span(model_config, cand)
+            cand["kv_pages"] = overrides.get(
+                "kv_pages",
+                cand["batch_size"] * span * 2 + 1,
+            )
+            if cand["prefix_cache"] and cand["kv_page_tokens"]:
+                cand["prefix_block"] = cand["kv_page_tokens"]
+        for k, v in overrides.items():
+            cand.setdefault(k, v)
+        yield cand
+
+
+def _train_candidates(hint, overrides):
+    axes = {
+        name: ((overrides[name],) if name in overrides else values)
+        for name, values in TRAIN_AXES.items()
+    }
+    names = sorted(axes)
+    for point in itertools.product(*(axes[n] for n in names)):
+        cand = dict(zip(names, point))
+        for k, v in overrides.items():
+            cand.setdefault(k, v)
+        yield cand
+
+
+class Plan(object):
+    """One planning outcome: the chosen point, the priced runner-up,
+    the modeled gap, and the per-knob decision log."""
+
+    def __init__(self, workload, chosen, priced, runner_up, gap_pct,
+                 decisions, profile, hint, model_config, pruned):
+        self.workload = workload
+        self.chosen = chosen
+        self.priced = priced            # cost dict of the chosen point
+        self.runner_up = runner_up      # (cand, cost) or None
+        self.gap_pct = gap_pct
+        self.decisions = decisions
+        self.profile = profile
+        self.hint = hint
+        self.model_config = dict(model_config or {})
+        self.pruned = pruned            # [(cand, reason)] sample
+
+    def config(self):
+        """The fully-specified config: model fields + every chosen
+        knob (``None``-valued knobs drop out — builder defaults)."""
+        out = dict(self.model_config)
+        out.pop("auto", None)
+        for k, v in self.chosen.items():
+            if v is not None:
+                out[k] = v
+        return out
+
+    def summary(self):
+        return {
+            "workload": self.workload,
+            "chosen": {k: v for k, v in sorted(self.chosen.items())},
+            "modeled_sec": round(self.priced["total_sec"], 6),
+            "bottleneck": self.priced.get("bottleneck"),
+            "runner_up": (
+                {k: v for k, v in sorted(self.runner_up[0].items())}
+                if self.runner_up else None
+            ),
+            "runner_up_sec": (
+                round(self.runner_up[1]["total_sec"], 6)
+                if self.runner_up else None
+            ),
+            "gap_pct": self.gap_pct,
+            "profile": self.profile.to_dict(),
+        }
+
+    def explain(self):
+        """The ``plan explain`` rendering: chosen point, runner-up,
+        modeled gap, per-knob decisions, and the modeled critical
+        path itself."""
+        lines = ["== planner explain ({0}) ==".format(self.workload)]
+        lines.append("profile         : {0!r}".format(self.profile))
+        lines.append("modeled total   : {0:.6f}s (bottleneck: {1})".format(
+            self.priced["total_sec"], self.priced.get("bottleneck"),
+        ))
+        for link in self.priced.get("path", []):
+            lines.append(
+                "    {0:<20} dur {1:>10.6f}s  self {2:>10.6f}s".format(
+                    link["name"], link["dur"], link["self_sec"]
+                )
+            )
+        lines.append("chosen          :")
+        for d in self.decisions:
+            lines.append("    {0:<16} = {1!r:<12} [{2}] {3}".format(
+                d["knob"], d["value"], d["source"], d.get("why", "")
+            ))
+        if self.runner_up is not None:
+            ru, rc = self.runner_up
+            diff = {
+                k: ru.get(k) for k in sorted(set(ru) | set(self.chosen))
+                if ru.get(k) != self.chosen.get(k)
+            }
+            lines.append(
+                "runner-up       : {0!r} at {1:.6f}s "
+                "(modeled gap {2:+.1f}%)".format(
+                    diff, rc["total_sec"], self.gap_pct
+                )
+            )
+        if self.pruned:
+            lines.append("pruned examples :")
+            for cand, why in self.pruned[:5]:
+                lines.append("    {0}".format(why))
+        return "\n".join(lines)
+
+
+def _decision_log(chosen, overrides, computed_keys):
+    out = []
+    for k in sorted(chosen):
+        if chosen[k] is None:
+            continue
+        if k in overrides:
+            source, why = "override", "pinned by the caller"
+        elif k in computed_keys:
+            source, why = "computed", computed_keys[k]
+        else:
+            source, why = "search", "min modeled critical path"
+        out.append({"knob": k, "value": chosen[k], "source": source,
+                    "why": why})
+    return out
+
+
+def plan(model_config=None, workload="serving", device_count=None,
+         hint=None, profile=None, overrides=None, journal=True):
+    """Turn (model config, device inventory, interconnect profile,
+    workload hint) into a fully-specified config.
+
+    Args:
+      model_config: TransformerConfig-style dict (serving) — the model
+        facts the lattice is validated against.
+      workload: ``"serving"`` or ``"train"``.
+      device_count: devices the deployment owns (default: the local
+        jax backend's).
+      hint: workload facts (see :data:`DEFAULT_HINT`).
+      profile: a :class:`~tensorflowonspark_tpu.planner.cost.
+        DeviceProfile`; default: :func:`~tensorflowonspark_tpu.
+        planner.cost.calibrate` (probe cache / roofline fallback).
+      overrides: knobs pinned by the caller — each pinned axis
+        collapses to that value and the decision log says so.
+      journal: emit the typed ``planner_decision`` journal event.
+    """
+    t0 = time.perf_counter()
+    model_config = dict(model_config or {})
+    hint = dict(DEFAULT_HINT, **(hint or {}))
+    overrides = dict(overrides or {})
+    if device_count is None:
+        try:
+            import jax
+
+            device_count = len(jax.devices())
+        except Exception:  # noqa: BLE001 - planning without a backend
+            device_count = 1
+    if profile is None:
+        profile = cost_mod.calibrate()
+    model = cost_mod.CostModel(profile)
+    reg = telemetry.get_registry()
+
+    if workload == "train":
+        cands = _train_candidates(hint, overrides)
+        price = lambda c: model.price_train(model_config, c, hint)  # noqa: E731
+    elif workload == "serving":
+        cands = _serving_candidates(
+            model_config, device_count, hint, overrides
+        )
+        price = lambda c: model.price_serving(model_config, c, hint)  # noqa: E731
+    else:
+        raise ValueError(
+            "workload must be 'serving' or 'train', got {0!r}".format(
+                workload
+            )
+        )
+
+    scored, pruned = [], []
+    for cand in cands:
+        why = validate_candidate(model_config, cand, device_count)
+        if why is not None:
+            if len(pruned) < 32:
+                pruned.append((cand, why))
+            reg.counter("planner.pruned").inc()
+            continue
+        scored.append((cand, price(cand)))
+        reg.counter("planner.candidates").inc()
+    if not scored:
+        raise ValueError(
+            "no legal candidate in the {0} lattice (device_count={1}; "
+            "first rejections: {2})".format(
+                workload, device_count, [w for _, w in pruned[:3]]
+            )
+        )
+    # freshest-first tie-break on training: among near-equal points
+    # prefer the smallest push_every (less staleness for free)
+    if workload == "train":
+        scored.sort(key=lambda cw: (
+            round(cw[1]["total_sec"] / max(1, cw[0]["push_every"]), 9),
+            cw[0]["push_every"], cw[0]["max_inflight"],
+        ))
+    else:
+        scored.sort(key=lambda cw: (
+            cw[1]["total_sec"],
+            repr(sorted(cw[0].items(), key=lambda kv: kv[0])),
+        ))
+    chosen, priced = scored[0]
+    runner_up = scored[1] if len(scored) > 1 else None
+    gap_pct = None
+    if runner_up is not None:
+        base = max(1e-12, priced["total_sec"])
+        if workload == "train":
+            a = priced["total_sec"] / max(1, chosen["push_every"])
+            b = runner_up[1]["total_sec"] / max(
+                1, runner_up[0]["push_every"]
+            )
+            gap_pct = round(100.0 * (b - a) / max(1e-12, a), 2)
+        else:
+            gap_pct = round(
+                100.0 * (runner_up[1]["total_sec"] - base) / base, 2
+            )
+    computed = {
+        "prefix_cache": "shared_prefix_frac {0} in the hint".format(
+            hint.get("shared_prefix_frac")
+        ),
+        "disaggregate": "mixed prompt mix in the hint",
+        "kv_pages": "pool rule slots x span + headroom",
+        "max_prompt_len": "prompt_max in the hint",
+        "prefix_block": "aligned to the page width",
+        "pad_multiple": "bucket width floor",
+    }
+    result = Plan(
+        workload, chosen, priced, runner_up, gap_pct,
+        _decision_log(chosen, overrides, computed),
+        profile, hint, model_config, pruned,
+    )
+    reg.histogram("planner.plan_sec").observe(time.perf_counter() - t0)
+    if journal:
+        telemetry.get_tracer().mark(
+            "planner_decision", trace="planner", severity="info",
+            workload=workload,
+            chosen={k: v for k, v in sorted(chosen.items())
+                    if v is not None},
+            runner_up=(
+                {k: v for k, v in sorted(runner_up[0].items())
+                 if v is not None} if runner_up else None
+            ),
+            gap_pct=gap_pct,
+            modeled_sec=round(priced["total_sec"], 6),
+            bottleneck=priced.get("bottleneck"),
+            candidates=len(scored), pruned_count=len(pruned),
+            profile_source=profile.source,
+            overrides=sorted(overrides),
+        )
+    return result
+
+
+def auto_serving_config(config, device_count=None, profile=None,
+                        hint=None):
+    """The ``config="auto"`` surface behind ``serving_builder`` /
+    ``load_predictor``: plan the workload and fill every planner-owned
+    knob the caller did NOT set — explicit keys always win, so every
+    decision is individually overridable.  Returns ``(merged_config,
+    plan)`` with the ``auto`` key dropped from the merged dict."""
+    config = dict(config)
+    config.pop("auto", None)
+    owned = {k.name for k in knobs_mod.planner_owned("serving")}
+    overrides = {k: config[k] for k in owned if k in config}
+    h = dict(hint or {})
+    if config.get("max_prompt_len") and "prompt_max" not in h:
+        h["prompt_max"] = int(config["max_prompt_len"])
+    if config.get("max_new_tokens") and "max_new_tokens" not in h:
+        h.setdefault("prompt_tokens", h.get("prompt_max", 32))
+    p = plan(
+        model_config=config, workload="serving",
+        device_count=device_count, hint=h, profile=profile,
+        overrides=overrides,
+    )
+    merged = dict(config)
+    serving_keys = {
+        k.name for k in knobs_mod.KNOBS if k.subsystem == "serving"
+    }
+    for k, v in p.chosen.items():
+        # engine-side picks (batch_size...) ride the Plan, not the
+        # builder config — predict_rows reads them off predict.plan
+        if k in serving_keys and k not in merged and v is not None:
+            merged[k] = v
+    return merged, p

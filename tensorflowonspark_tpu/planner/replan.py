@@ -1,0 +1,317 @@
+"""The live re-planner: keep the planned config true as the world
+drifts (ISSUE 18 part 3).
+
+A :class:`LivePlanner` consumes the sensors the fleet already ships —
+the health plane's :class:`~tensorflowonspark_tpu.telemetry.health.
+TimeSeriesStore`, the usage ledger's mirror counters, and a measured
+DCN-RTT probe (:func:`~tensorflowonspark_tpu.planner.cost.
+measure_dcn_rtt`) — and drives three re-plan triggers:
+
+- **DCN-RTT drift** -> retune ``push_every`` (the
+  docs/communication.md cadence rule, ``push_every x step_time >
+  RTT``, re-applied against the measured RTT);
+- **prompt-length-mix shift** -> regrow the slot buckets
+  (``max_prompt_len``/cache geometry — applied through the
+  hot-swap/quiesce seam the actuator wraps);
+- **page-pool occupancy** -> resize ``kv_pages`` (same seam: pool
+  geometry is a decoder rebuild).
+
+Changes go ONLY through the actuator callbacks the integrator binds
+(``set_push_every`` is :meth:`~tensorflowonspark_tpu.parallel.
+hier_ps.HierTrainer.set_push_every`, applied at the window boundary;
+geometry actuators wrap the engine's quiesce/hot-swap machinery;
+scalar engine knobs go through ``ServingEngine.request_retune``,
+applied between decode chunks).  Every applied re-plan is a typed
+``replan`` journal event carrying the triggering evidence — the
+measured values, the threshold, the sustain count — so ``forensics
+explain`` answers "why did the config change?".  Hysteresis
+(``sustain`` consecutive asserting rounds) and per-trigger cooldowns
+bound the churn: one drift episode is ONE re-plan, not a flap storm
+(asserted by the chaos e2e: an injected ``TcpGremlin.delay`` drift
+triggers exactly one audited ``push_every`` re-plan).
+"""
+
+import logging
+import math
+import time
+
+from tensorflowonspark_tpu import telemetry
+
+logger = logging.getLogger(__name__)
+
+
+class Replan(object):
+    """One applied (or attempted) re-plan decision."""
+
+    __slots__ = ("trigger", "knob", "old", "new", "evidence",
+                 "applied", "error")
+
+    def __init__(self, trigger, knob, old, new, evidence,
+                 applied=False, error=None):
+        self.trigger = trigger
+        self.knob = knob
+        self.old = old
+        self.new = new
+        self.evidence = dict(evidence)
+        self.applied = applied
+        self.error = error
+
+    def to_dict(self):
+        return {
+            "trigger": self.trigger, "knob": self.knob,
+            "old": self.old, "new": self.new,
+            "evidence": self.evidence, "applied": self.applied,
+            "error": self.error,
+        }
+
+
+class LivePlanner(object):
+    """Periodic trigger evaluation over live sensors.
+
+    Args:
+      baseline: the startup :class:`~tensorflowonspark_tpu.planner.
+        cost.DeviceProfile` (its ``dcn_rtt_sec`` anchors drift) — or a
+        plain float RTT.
+      actuators: dict binding trigger outputs to safe seams —
+        ``push_every``: fn(new) applied at the window boundary
+        (:meth:`HierTrainer.set_push_every`); ``slot_buckets``:
+        fn(new_max_prompt_len) through hot-swap/quiesce;
+        ``kv_pages``: fn(new_pages) through the same seam.  A missing
+        binding disables that trigger's actuation (the decision is
+        still journaled as unapplied).
+      rtt_probe: fn() -> measured RTT seconds (e.g. ``lambda:
+        measure_dcn_rtt(addr)``); None disables the RTT trigger.
+      store: a TimeSeriesStore for the mix/occupancy sensors; None
+        disables those triggers unless explicit sensor fns are given.
+      prompt_mix_fn: fn() -> mean prompt tokens over the recent
+        window (default: derived from the usage-ledger mirror via
+        ``store``-less callers passing their own).
+      occupancy_fn: fn() -> page-pool occupancy fraction [0, 1].
+      step_time_fn: fn() -> measured seconds per training step (for
+        the cadence rule); default: the planned step time.
+    """
+
+    def __init__(self, baseline, actuators=None, rtt_probe=None,
+                 store=None, prompt_mix_fn=None, occupancy_fn=None,
+                 step_time_fn=None, push_every=8, step_time_sec=None,
+                 planned_prompt_tokens=None, kv_pages=None,
+                 rtt_drift_factor=2.0, mix_drift_factor=1.5,
+                 occupancy_high=0.9, occupancy_low=0.3,
+                 sustain=2, cooldown_sec=60.0, cadence_margin=1.25,
+                 clock=time.monotonic):
+        rtt = getattr(baseline, "dcn_rtt_sec", baseline)
+        self.baseline_rtt = float(rtt)
+        self.actuators = dict(actuators or {})
+        self.rtt_probe = rtt_probe
+        self.store = store
+        self.prompt_mix_fn = prompt_mix_fn
+        self.occupancy_fn = occupancy_fn
+        self.step_time_fn = step_time_fn
+        self.push_every = int(push_every)
+        self.step_time_sec = float(step_time_sec or 1e-2)
+        self.planned_prompt_tokens = planned_prompt_tokens
+        self.kv_pages = kv_pages
+        self.rtt_drift_factor = float(rtt_drift_factor)
+        self.mix_drift_factor = float(mix_drift_factor)
+        self.occupancy_high = float(occupancy_high)
+        self.occupancy_low = float(occupancy_low)
+        self.sustain = max(1, int(sustain))
+        self.cooldown_sec = float(cooldown_sec)
+        self.cadence_margin = float(cadence_margin)
+        self._clock = clock
+        self._asserting = {}     # trigger -> consecutive rounds
+        self._last_applied = {}  # trigger -> clock time
+        self.history = []
+
+    # -- plumbing -------------------------------------------------------
+
+    def _cooled(self, trigger):
+        last = self._last_applied.get(trigger)
+        return last is None or (
+            self._clock() - last >= self.cooldown_sec
+        )
+
+    def _sustained(self, trigger, asserting):
+        if not asserting:
+            self._asserting[trigger] = 0
+            return False
+        self._asserting[trigger] = self._asserting.get(trigger, 0) + 1
+        return self._asserting[trigger] >= self.sustain
+
+    def _apply(self, rec):
+        """Drive the actuator and journal the typed event either way."""
+        fn = self.actuators.get(rec.knob)
+        reg = telemetry.get_registry()
+        if fn is not None:
+            try:
+                fn(rec.new)
+                rec.applied = True
+            except Exception as e:  # noqa: BLE001 - journaled, not fatal
+                rec.error = "{0}: {1}".format(type(e).__name__, e)
+                logger.warning("replan %s -> %s failed: %s",
+                               rec.knob, rec.new, rec.error)
+        if rec.applied:
+            self._asserting[rec.trigger] = 0
+            self._last_applied[rec.trigger] = self._clock()
+            reg.counter("planner.replans").inc()
+        telemetry.get_tracer().mark(
+            "replan", trace="planner",
+            severity="info" if rec.applied else "warn",
+            trigger=rec.trigger, knob=rec.knob,
+            old=rec.old, new=rec.new, applied=rec.applied,
+            error=rec.error, evidence=rec.evidence,
+        )
+        self.history.append(rec)
+        return rec
+
+    def _skip(self, trigger):
+        telemetry.get_registry().counter(
+            "planner.replan_suppressed"
+        ).inc()
+        logger.debug("replan trigger %s suppressed (cooldown)", trigger)
+
+    # -- triggers -------------------------------------------------------
+
+    def _check_rtt(self):
+        if self.rtt_probe is None:
+            return None
+        rtt = float(self.rtt_probe())
+        drifted = rtt >= self.rtt_drift_factor * self.baseline_rtt
+        if not self._sustained("dcn_rtt", drifted):
+            return None
+        if not self._cooled("dcn_rtt"):
+            self._skip("dcn_rtt")
+            return None
+        step = float(
+            self.step_time_fn() if self.step_time_fn
+            else self.step_time_sec
+        )
+        # the cadence rule against the MEASURED rtt: smallest window
+        # that keeps push_every x step_time above margin x RTT
+        new = max(
+            self.push_every,
+            int(math.ceil(self.cadence_margin * rtt / max(1e-9, step))),
+        )
+        if new == self.push_every:
+            return None
+        rec = Replan(
+            "dcn_rtt", "push_every", self.push_every, new,
+            evidence={
+                "measured_rtt_ms": round(1e3 * rtt, 3),
+                "baseline_rtt_ms": round(1e3 * self.baseline_rtt, 3),
+                "drift_factor": round(rtt / self.baseline_rtt, 2),
+                "threshold_factor": self.rtt_drift_factor,
+                "step_time_ms": round(1e3 * step, 3),
+                "cadence_margin": self.cadence_margin,
+                "sustained_rounds": self._asserting.get("dcn_rtt", 0),
+            },
+        )
+        self._apply(rec)
+        if rec.applied:
+            self.push_every = new
+            # the new cadence is the new normal: drift is judged
+            # against what we re-planned FOR, so one episode is one
+            # re-plan (the chaos e2e's exactly-once assertion)
+            self.baseline_rtt = rtt
+        return rec
+
+    def _prompt_mix(self):
+        if self.prompt_mix_fn is not None:
+            return self.prompt_mix_fn()
+        if self.store is not None:
+            mean = self.store.mean_over("serving.prompt_tokens", None)
+            return mean
+        return None
+
+    def _check_mix(self):
+        if self.planned_prompt_tokens is None:
+            return None
+        mean = self._prompt_mix()
+        if mean is None:
+            return None
+        shifted = mean >= self.mix_drift_factor * float(
+            self.planned_prompt_tokens
+        )
+        if not self._sustained("prompt_mix", shifted):
+            return None
+        if not self._cooled("prompt_mix"):
+            self._skip("prompt_mix")
+            return None
+        new = int(2 ** math.ceil(math.log2(max(1.0, mean))))
+        rec = Replan(
+            "prompt_mix", "slot_buckets",
+            self.planned_prompt_tokens, new,
+            evidence={
+                "mean_prompt_tokens": round(float(mean), 1),
+                "planned_prompt_tokens": self.planned_prompt_tokens,
+                "threshold_factor": self.mix_drift_factor,
+                "sustained_rounds": self._asserting.get(
+                    "prompt_mix", 0
+                ),
+            },
+        )
+        self._apply(rec)
+        if rec.applied:
+            self.planned_prompt_tokens = new
+        return rec
+
+    def _occupancy(self):
+        if self.occupancy_fn is not None:
+            return self.occupancy_fn()
+        if self.store is not None:
+            used = self.store.gauge_last("serving.pool_pages_used")
+            total = self.store.gauge_last("serving.pool_pages")
+            if used is not None and total:
+                return float(used) / float(total)
+        return None
+
+    def _check_pages(self):
+        if self.kv_pages is None:
+            return None
+        occ = self._occupancy()
+        if occ is None:
+            return None
+        high = occ >= self.occupancy_high
+        low = occ <= self.occupancy_low
+        if not self._sustained("page_occupancy", high or low):
+            return None
+        if not self._cooled("page_occupancy"):
+            self._skip("page_occupancy")
+            return None
+        new = (
+            int(self.kv_pages * 1.5) + 1 if high
+            else max(2, int(self.kv_pages * 0.75))
+        )
+        if new == self.kv_pages:
+            return None
+        rec = Replan(
+            "page_occupancy", "kv_pages", self.kv_pages, new,
+            evidence={
+                "occupancy": round(float(occ), 3),
+                "high_watermark": self.occupancy_high,
+                "low_watermark": self.occupancy_low,
+                "sustained_rounds": self._asserting.get(
+                    "page_occupancy", 0
+                ),
+            },
+        )
+        self._apply(rec)
+        if rec.applied:
+            self.kv_pages = new
+        return rec
+
+    def step(self):
+        """One evaluation round over every armed trigger; returns the
+        re-plans decided this round (applied or failed — suppressed
+        and non-asserting triggers return nothing)."""
+        out = []
+        for check in (self._check_rtt, self._check_mix,
+                      self._check_pages):
+            try:
+                rec = check()
+            except Exception as e:  # noqa: BLE001 - sensor faults skip a round
+                logger.warning("replan sensor failed: %s", e)
+                continue
+            if rec is not None:
+                out.append(rec)
+        return out

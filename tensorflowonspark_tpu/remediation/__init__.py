@@ -37,7 +37,7 @@ from tensorflowonspark_tpu.remediation.engine import (  # noqa: F401
     Guardrails, RemediationEngine, Sensors, SensorSnapshot,
 )
 from tensorflowonspark_tpu.remediation.policy import (  # noqa: F401
-    ACTIONS, AutoscalePolicy, FaultResponsePolicy, Intent,
+    ACTIONS, AutoscalePolicy, CostPolicy, FaultResponsePolicy, Intent,
     PageAlertPolicy, Policy, SloRollbackPolicy, StragglerPolicy,
     default_policies,
 )
@@ -78,12 +78,16 @@ def wire(plane=None, router=None, cluster=None, policies=None,
         pressure_fn = router.pressure
 
         def fleet_fn():
+            status = router.health_status()
             return {
                 "replicas": len(router.replicas),
                 "live": sum(
                     1 for r in router.replicas
                     if r.alive and r.state == "live"
                 ),
+                # the usage-ledger cost rows (ISSUE 14/18): chip_sec
+                # and tokens_out per replica, CostPolicy's input
+                "costs": status.get("costs", {}),
             }
 
         def probation_fn():

@@ -50,6 +50,9 @@ class Actuators(object):
     def retire_replica(self, replica_id=None, **kw):
         raise UnsupportedAction("retire_replica unbound")
 
+    def probe_replica(self, replica_id=None, **kw):
+        raise UnsupportedAction("probe_replica unbound")
+
     def degrade_admission(self, **kw):
         raise UnsupportedAction("degrade_admission unbound")
 
@@ -77,6 +80,41 @@ class FleetActuators(Actuators):
                 "no retirable replica (last live replica is never "
                 "retired)"
             )
+        return rid
+
+    def probe_replica(self, replica_id=None, **kw):
+        """Route around ``replica_id`` and put it on probe traffic —
+        the REVERSIBLE counterpart of ``retire_replica``: the router
+        keeps sending it one probe request per ``probe_every``
+        dispatches and readmits it after ``readmit_rounds`` clean
+        probes (the straggler-detection machinery, driven here by the
+        cost policy instead of the latency EWMA)."""
+        if replica_id is None:
+            raise UnsupportedAction(
+                "probe_replica needs a replica_id target"
+            )
+        rid = int(replica_id)
+        replica = (
+            self.router.replicas[rid]
+            if 0 <= rid < len(self.router.replicas) else None
+        )
+        if replica is None or not replica.alive:
+            raise UnsupportedAction(
+                "replica {0} not alive".format(replica_id)
+            )
+        if replica.state != "live":
+            raise UnsupportedAction(
+                "replica {0} already {1}".format(rid, replica.state)
+            )
+        live = sum(
+            1 for r in self.router.replicas
+            if r.alive and r.state == "live"
+        )
+        if live <= 1:
+            raise UnsupportedAction(
+                "refusing to probe the last live replica"
+            )
+        self.router.replica_set.evict(rid)
         return rid
 
     def degrade_admission(self, **kw):
@@ -158,6 +196,11 @@ class CombinedActuators(Actuators):
     def retire_replica(self, replica_id=None, **kw):
         return self._dispatch(
             "retire_replica", replica_id=replica_id, **kw
+        )
+
+    def probe_replica(self, replica_id=None, **kw):
+        return self._dispatch(
+            "probe_replica", replica_id=replica_id, **kw
         )
 
     def degrade_admission(self, **kw):

@@ -39,7 +39,11 @@ ISSUE 16 names:
   restore); automatic recoveries (leader re-election, checkpoint
   quarantine) get an explicit ``stand_down`` decision so the journal
   records that remediation saw the fault and deliberately did not
-  pile a second actuator on top of a recovery in progress.
+  pile a second actuator on top of a recovery in progress;
+- :class:`CostPolicy` — the usage ledger's per-replica cost rows
+  (ISSUE 18): probe, then evict, the replica burning the most
+  chip-seconds per emitted token relative to the fleet median — the
+  cost outlier, not merely the slowest.
 """
 
 import logging
@@ -52,7 +56,7 @@ logger = logging.getLogger(__name__)
 ACTIONS = (
     "elastic_shrink", "elastic_grow", "spawn_replica",
     "retire_replica", "degrade_admission", "restore_admission",
-    "rollback_generation", "stand_down",
+    "rollback_generation", "probe_replica", "stand_down",
 )
 
 
@@ -378,6 +382,126 @@ class SloRollbackPolicy(Policy):
         return []
 
 
+class CostPolicy(Policy):
+    """Cost-efficiency policy over the usage ledger's per-replica
+    cost rows (ISSUE 18 satellite): probe — and, if it stays bad,
+    evict — the replica with the worst **chip-seconds per emitted
+    token**, not merely the slowest one.  A replica can be perfectly
+    responsive yet burn 3x the chips per token (quantization fell
+    back to float, a cold pallas path, thermal throttling): latency
+    policies never see it, the ledger does.
+
+    Reads the PR 14 cost rows off ``snap.fleet["costs"]`` (the
+    router's ``health_status()`` mirror of the usage ledger) — or an
+    injected ``ledger_fn`` (the fake-ledger unit test's seam).  A
+    replica whose ratio exceeds ``ratio_factor`` x the fleet median
+    for ``sustain`` consecutive rounds gets a ``probe_replica``
+    intent (the router routes around it and probes it for recovery —
+    reversible); one that STAYS the outlier for ``evict_after``
+    further rounds after the probe executed gets ``retire_replica``
+    (permanent).  Every intent carries the ratio table it was judged
+    on.  Replicas with fewer than ``min_tokens`` emitted are not
+    judged — a cold replica's ratio is all prefill."""
+
+    name = "cost-efficiency"
+
+    def __init__(self, ratio_factor=2.0, min_tokens=256, sustain=3,
+                 evict_after=3, ledger_fn=None):
+        self.ratio_factor = float(ratio_factor)
+        self.min_tokens = int(min_tokens)
+        self.sustain = max(1, int(sustain))
+        self.evict_after = max(1, int(evict_after))
+        self.ledger_fn = ledger_fn
+        self._worst_rounds = {}   # rid -> consecutive outlier rounds
+        self._post_probe = {}     # probed rid -> outlier rounds since
+        self.probed = set()
+
+    def _rows(self, snap):
+        if self.ledger_fn is not None:
+            return self.ledger_fn() or {}
+        return ((snap.fleet or {}).get("costs")
+                if isinstance(snap.fleet, dict) else None) or {}
+
+    def evaluate(self, snap):
+        from tensorflowonspark_tpu.telemetry.ledger import (
+            chip_sec_per_token,
+        )
+
+        rows = self._rows(snap)
+        judged = {
+            rid: row for rid, row in rows.items()
+            if row.get("state") in (None, "live", "routed_around")
+        }
+        ratios = chip_sec_per_token(judged, min_tokens=self.min_tokens)
+        if len(ratios) < 2:
+            self._worst_rounds.clear()
+            return []
+        med = sorted(ratios.values())[len(ratios) // 2]
+        worst = max(sorted(ratios), key=lambda r: ratios[r])
+        outlier = med > 0 and ratios[worst] >= self.ratio_factor * med
+        for rid in list(self._worst_rounds):
+            if rid != worst or not outlier:
+                self._worst_rounds.pop(rid, None)
+        for rid in list(self._post_probe):
+            if rid != worst or not outlier:
+                # recovered (or another replica became the problem):
+                # the router's probe traffic readmits it; a later
+                # regression starts a fresh probe cycle
+                self._post_probe.pop(rid, None)
+                self.probed.discard(rid)
+        if not outlier:
+            return []
+        evidence = {
+            "ratios_chip_sec_per_token": {
+                r: round(v, 6) for r, v in sorted(ratios.items())
+            },
+            "worst": worst,
+            "median": round(med, 6),
+            "threshold_factor": self.ratio_factor,
+            "row": dict(rows.get(worst) or {}),
+        }
+        if worst in self.probed:
+            self._post_probe[worst] = self._post_probe.get(worst, 0) + 1
+            evidence["post_probe_rounds"] = self._post_probe[worst]
+            if self._post_probe[worst] >= self.evict_after:
+                return [self._intent(
+                    "retire_replica",
+                    target={"replica_id": worst}, evidence=evidence,
+                    reason="still {0:.1f}x the median chip_sec/token "
+                           "{1} rounds after probe".format(
+                               ratios[worst] / med,
+                               self._post_probe[worst]),
+                )]
+            return []
+        self._worst_rounds[worst] = self._worst_rounds.get(worst, 0) + 1
+        evidence["sustained_rounds"] = self._worst_rounds[worst]
+        if self._worst_rounds[worst] >= self.sustain:
+            return [self._intent(
+                "probe_replica",
+                target={"replica_id": worst}, evidence=evidence,
+                reason="worst chip_sec/token at {0:.1f}x the fleet "
+                       "median for {1} rounds".format(
+                           ratios[worst] / med,
+                           self._worst_rounds[worst]),
+            )]
+        return []
+
+    def on_decision(self, rec):
+        if not self._acted(rec):
+            return
+        rid = (rec.get("target") or {}).get("replica_id")
+        if rid is None:
+            return
+        if rec.get("action") == "probe_replica":
+            self.probed.add(rid)
+            self._worst_rounds.pop(rid, None)
+            self._post_probe[rid] = 0
+        elif rec.get("action") == "retire_replica":
+            self.probed.discard(rid)
+            self._post_probe.pop(rid, None)
+            self._worst_rounds.pop(rid, None)
+
+
 #: journal fault kinds → the policy's response action.  Faults whose
 #: recovery is ALREADY owned by a lower plane get an explicit
 #: ``stand_down`` decision — the audit trail must show remediation
@@ -464,6 +588,7 @@ def default_policies(**overrides):
         "faults": (
             FaultResponsePolicy, overrides.pop("faults", {})
         ),
+        "cost": (CostPolicy, overrides.pop("cost", {})),
     }
     if overrides:
         raise ValueError(

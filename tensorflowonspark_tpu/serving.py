@@ -292,7 +292,11 @@ def predict_rows(
         defaults to the predictor's own output names.
       batch_size: rows per predict call (reference default 128,
         TFParams.scala:14-18); in continuous mode, the number of
-        in-flight KV-cache SLOTS.
+        in-flight KV-cache SLOTS.  ``"auto"`` reads the planner's
+        chosen slot count off ``predict.plan`` (predictors built with
+        ``config={"auto": ...}`` — docs/autotune.md); ``schedule=
+        "auto"`` likewise picks continuous when the predictor
+        supports it.
       pad_to_batch: zero-pad the final short batch so the jitted
         predict never sees a new shape (outputs are truncated back).
       schedule: ``"static"`` (fixed-size batches — every row in a
@@ -351,6 +355,19 @@ def predict_rows(
         input order; a replica death mid-decode re-dispatches its
         in-flight requests from their committed tokens.
     """
+    # engine-side planner picks (ISSUE 18): a predictor built with
+    # config={"auto": ...} carries predict.plan — "auto" here reads
+    # the chosen slot count / schedule off it instead of a hand-set
+    # number (zero knobs end to end)
+    if batch_size == "auto" or schedule == "auto":
+        chosen = (getattr(predict, "plan", None) or {}).get("chosen", {})
+        if batch_size == "auto":
+            batch_size = int(chosen.get("batch_size") or 128)
+        if schedule == "auto":
+            schedule = (
+                "continuous"
+                if hasattr(predict, "make_slot_decoder") else "static"
+            )
     if schedule not in ("static", "continuous"):
         raise ValueError(
             "schedule must be 'static' or 'continuous', got %r"

@@ -671,6 +671,17 @@ class ServingEngine(object):
         }
         self._m_gen = reg.gauge("serving.weight_generation")
         self._m_gen.set(self.stats["weight_generation"])
+        # live re-planner sensors (ISSUE 18): admitted prompt lengths
+        # feed the prompt-mix trigger; the paged-pool occupancy gauges
+        # feed the kv_pages trigger — both readable fleet-wide through
+        # the health plane's TimeSeriesStore
+        self._m_prompt_tokens = reg.histogram("serving.prompt_tokens")
+        self._m_pool = reg.gauge("serving.pool_pages")
+        self._m_pool_used = reg.gauge("serving.pool_pages_used")
+        # scalar knob retunes, queued by request_retune() and applied
+        # between decode chunks on the scheduling pass (ISSUE 18: the
+        # live re-planner's safe seam for non-geometry knobs)
+        self._retune_request = {}
         # on-demand device profiling: serving_builder config keys
         # profile_dir/profile_steps ride the predictor; decode chunks
         # count as steps (tensorboard.start_profile is a graceful
@@ -804,6 +815,9 @@ class ServingEngine(object):
                     "pool_pages_shared", "pool_pages_free"):
             if key in cur:
                 self.stats[key] = int(cur[key])
+        if "pool_pages" in cur:
+            self._m_pool.set(int(cur["pool_pages"]))
+            self._m_pool_used.set(int(cur.get("pool_pages_used", 0)))
         prop = self.stats.get("spec_proposed", 0)
         self.stats["spec_accept_rate"] = (
             self.stats.get("spec_accepted", 0) / float(prop)
@@ -1202,6 +1216,7 @@ class ServingEngine(object):
             req["admit_len"] = int(len(prompt))
             self.stats["admitted"] += 1
             self._m["admitted"].inc()
+            self._m_prompt_tokens.observe(float(len(prompt)))
             self.stats["request_wire_bytes"] += int(
                 getattr(prompt, "nbytes", 0)
             )
@@ -1388,6 +1403,53 @@ class ServingEngine(object):
         self.stats["weight_generation"] = gen
         self._m_gen.set(gen)
         return gen
+
+    # -- live scalar retunes (ISSUE 18) --------------------------------
+
+    #: the knobs request_retune may change: host-side scalars whose
+    #: swap needs no quiesce — geometry (slots, kv_pages, chunk_size)
+    #: goes through the hot-swap/quiesce seam instead
+    RETUNABLE = ("watchdog_timeout", "default_deadline", "queue_depth")
+
+    def request_retune(self, **knobs):
+        """Queue scalar knob changes; applied between decode chunks
+        at the next scheduling pass (the live re-planner's engine
+        seam).  Unknown knobs raise immediately — a retune must never
+        silently no-op."""
+        bad = sorted(set(knobs) - set(self.RETUNABLE))
+        if bad:
+            raise ValueError(
+                "retunable engine knobs are {0}; got {1}".format(
+                    self.RETUNABLE, bad
+                )
+            )
+        self._retune_request.update(knobs)
+
+    def _maybe_retune(self):
+        """Apply queued scalar retunes between chunks, one journal
+        event per applied batch (forensics: 'why did the config
+        change?' — the re-planner's evidence rides the replan event;
+        this one records the application point)."""
+        if not self._retune_request:
+            return
+        knobs, self._retune_request = self._retune_request, {}
+        applied = {}
+        for name, value in knobs.items():
+            old = getattr(self, name)
+            if name == "queue_depth":
+                value = max(1, int(value))
+            elif value is not None:
+                value = float(value)
+            setattr(self, name, value)
+            if name == "watchdog_timeout":
+                self._watchdog = (
+                    _DispatchWatchdog() if value is not None else None
+                )
+            applied[name] = {"old": old, "new": value}
+        self._tracer.mark(
+            "engine_retune", trace="planner", severity="info",
+            knobs=applied,
+        )
 
     def _quarantine(self, w, kind, message):
         if self.watcher is not None and w.path != "<request_swap>":
@@ -1701,6 +1763,7 @@ class ServingEngine(object):
                 # most one validated swap per pass — both run between
                 # chunks, never concurrently with a dispatch
                 self._maybe_swap()
+                self._maybe_retune()
                 self._refill(it)
                 self._expire_pending()
                 if self._draining:

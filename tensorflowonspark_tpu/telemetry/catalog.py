@@ -193,6 +193,28 @@ METRICS = tuple(
     + _m(_G, "remediation.RemediationEngine",
          ("remediation.budget_remaining",
           "global action budget left before hands-off"))
+    # --- cost-model planner (planner/, ISSUE 18) ---
+    + _m(_C, "planner.cost.calibrate",
+         ("planner.calibrations", "calibration probe passes run"))
+    + _m(_H, "planner.cost.calibrate",
+         ("planner.calibration_sec", "micro-bench probe pass wall time"))
+    + _m(_C, "planner.plan",
+         ("planner.candidates", "lattice points priced by the cost model"),
+         ("planner.pruned", "lattice points rejected by a legality validator"))
+    + _m(_H, "planner.plan",
+         ("planner.plan_sec", "enumerate+price+choose wall time"))
+    + _m(_C, "planner.LivePlanner",
+         ("planner.replans", "live re-plans applied through an actuator"),
+         ("planner.replan_suppressed",
+          "sustained triggers suppressed by a cooldown"))
+    # --- live re-planner sensors (serving_engine.py, ISSUE 18) ---
+    + _m(_H, "ServingEngine admission",
+         ("serving.prompt_tokens",
+          "admitted prompt length (the prompt-mix drift sensor)"))
+    + _m(_G, "ServingEngine paged pool",
+         ("serving.pool_pages", "physical page-pool size"),
+         ("serving.pool_pages_used",
+          "pages currently held (occupancy = used / size)"))
 )
 
 #: families whose full names are minted at runtime — a literal name

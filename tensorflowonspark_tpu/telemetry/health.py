@@ -250,6 +250,20 @@ class TimeSeriesStore(object):
             return None
         return h["sum"] / h["count"]
 
+    def drift(self, name, baseline, window=None, executor=None):
+        """Measured-over-planned drift factor: the exact windowed mean
+        of histogram ``name`` divided by ``baseline`` — the live
+        re-planner's trigger statistic (ISSUE 18: drift >= the
+        trigger's factor for ``sustain`` rounds fires a re-plan).
+        None when nothing was observed or ``baseline`` is not
+        positive."""
+        if baseline is None or float(baseline) <= 0.0:
+            return None
+        mean = self.mean_over(name, window, executor)
+        if mean is None:
+            return None
+        return float(mean) / float(baseline)
+
     def gauge_last(self, name, executor=None):
         """Latest gauge value (max across executors fleet-wide — same
         rule as :func:`~tensorflowonspark_tpu.telemetry.aggregate.

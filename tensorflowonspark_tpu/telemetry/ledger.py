@@ -535,6 +535,24 @@ def tenants_from_snapshot(snapshot):
     return tenants
 
 
+def chip_sec_per_token(rows, min_tokens=1):
+    """Cost-efficiency ratios from cost rows (ISSUE 18): ``{key:
+    chip_sec / tokens_out}`` over any row table shaped like the
+    router's per-replica ``health_status()["costs"]`` or a tenant
+    table from :func:`tenants_from_snapshot`.  Rows with fewer than
+    ``min_tokens`` emitted are skipped — a cold row's ratio is all
+    prefill, not a cost signal.  The remediation plane's
+    :class:`~tensorflowonspark_tpu.remediation.policy.CostPolicy`
+    judges the fleet on exactly these ratios."""
+    out = {}
+    for key, row in (rows or {}).items():
+        toks = int(row.get("tokens_out", 0))
+        if toks < max(1, int(min_tokens)):
+            continue
+        out[key] = float(row.get("chip_sec", 0.0)) / toks
+    return out
+
+
 def usage_openmetrics(tenants):
     """Per-tenant totals → OpenMetrics text with a bounded ``tenant``
     label — the ``/usage`` route body, round-tripping the strict

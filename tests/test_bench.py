@@ -185,6 +185,19 @@ def _full_record():
             "usage_requests": 24,
             "latency_exemplars": 3,
         },
+        "planner": {
+            "planner_gap_pct": 4.2, "replan_events": 1,
+            "replans": [{"trigger": "dcn_rtt", "knob": "push_every",
+                         "old": 8, "new": 25, "applied": True}],
+            "workloads": {
+                "serving_continuous": {"gap_pct": 4.2,
+                                       "identical": False},
+                "serving_disagg_mixed": {"gap_pct": 0.0,
+                                         "identical": False},
+                "train_hier_ps": {"gap_pct": 0.0, "identical": False},
+            },
+            "profile_source": "roofline", "platform": "cpu",
+        },
         "async_ps_tpu": {"async_pipelined_steps_per_sec": 9.4,
                          "async_compressed_steps_per_sec": 61.7,
                          "async_compressed_wire_kb_per_step": 812.4,
@@ -233,6 +246,11 @@ def test_summary_is_compact_standalone_json(tmp_path):
     # TTFT p99 ratio + the split engine's TTFT p50
     assert parsed["serving_disagg_p99_gain"] == 0.996
     assert parsed["serving_ttft_ms"] == 26.2
+    # auto-parallelism planner plane (ISSUE 18): worst-case gap of
+    # config="auto" vs hand-tuned, and the exactly-one-re-plan count
+    # from the injected-drift mini-run
+    assert parsed["planner_gap_pct"] == 4.2
+    assert parsed["replan_events"] == 1
     assert parsed["async_ps_compressed_steps_s"] == 61.7
     assert parsed["async_vs_sync"] == 0.599
     assert parsed["hier_ps_vs_sync"] == 0.92  # two-tier plane (ISSUE 9)
@@ -265,6 +283,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "serving_prefix_gain", "spec_accept_rate",
         "paged_admit_gain", "int4_tok_s",
         "serving_disagg_p99_gain", "serving_ttft_ms",
+        "planner_gap_pct", "replan_events",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",
         "serving_u8_vs_f32",

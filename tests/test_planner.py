@@ -1,0 +1,838 @@
+"""Auto-parallelism planner tests (ISSUE 18).
+
+The three planner layers (`tensorflowonspark_tpu/planner/`): the
+calibrated cost model (roofline fallback, per-host probe cache, the
+measured DCN-RTT probe), the search layer (legality via the REAL
+validators, min-modeled-critical-path selection, the decision journal,
+``plan explain``), and the live re-planner (RTT / prompt-mix /
+page-occupancy triggers with hysteresis, cooldowns and the
+exactly-once-per-episode contract, asserted end-to-end against a
+``TcpGremlin.delay`` drift).  Plus the satellites: the knob-registry
+validation surface (``UnknownKnobError`` on typo'd config keys through
+``serving_builder`` AND ``load_predictor(config_overrides=)``), the
+seeded property sweep (every planner-emitted config passes the
+validators it claims to respect), the CostPolicy probe→evict flow over
+a fake ledger, the engine/trainer actuation seams, and the forensics
+``config_changes`` report section.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from tensorflowonspark_tpu import forensics, planner, serving, telemetry
+from tensorflowonspark_tpu.planner import cost as cost_mod
+from tensorflowonspark_tpu.planner import knobs as knobs_mod
+from tensorflowonspark_tpu.planner.knobs import UnknownKnobError
+from tensorflowonspark_tpu.testing import chaos
+
+ROOFLINE_CPU = cost_mod.DeviceProfile(
+    "cpu", 1, *cost_mod.ROOFLINE["cpu"], source="roofline"
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_probes(monkeypatch, tmp_path):
+    """Deterministic planning in every test: roofline profile unless a
+    test opts back in, probe cache isolated to the test tmpdir."""
+    monkeypatch.setenv("TFOS_PLANNER_PROBES", "0")
+    monkeypatch.setenv("TFOS_PLANNER_CACHE", str(tmp_path / "cache"))
+
+
+def _tiny_cfg(**over):
+    cfg = dict(
+        vocab_size=256, num_layers=2, num_heads=2, head_dim=128,
+        embed_dim=256, mlp_dim=512, max_seq_len=256, dtype="float32",
+    )
+    cfg.update(over)
+    return cfg
+
+
+# ----------------------------------------------------------------------
+# knob registry + UnknownKnobError (the kv_page_token typo satellite)
+# ----------------------------------------------------------------------
+
+
+class TestKnobRegistry:
+    def test_typo_raises_named_error_with_suggestion(self):
+        with pytest.raises(UnknownKnobError) as ei:
+            knobs_mod.validate_keys({"kv_page_token": 8})
+        msg = str(ei.value)
+        assert "kv_page_token" in msg
+        assert "kv_page_tokens" in msg          # the near-miss named
+        assert "did you mean" in msg
+        assert ei.value.unknown == ("kv_page_token",)
+        assert "chunk_size" in ei.value.valid    # the valid table rides
+
+    def test_extra_valid_covers_model_fields(self):
+        knobs_mod.validate_keys(
+            {"embed_dim": 64, "chunk_size": 8}, extra_valid=("embed_dim",)
+        )
+        with pytest.raises(UnknownKnobError):
+            knobs_mod.validate_keys({"embed_dim": 64})
+
+    def test_serving_builder_rejects_typo(self):
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        with pytest.raises(UnknownKnobError, match="kv_page_tokens"):
+            tr.serving_builder(
+                {}, dict(_tiny_cfg(), mode="generate", max_new_tokens=4,
+                         kv_page_token=8),
+            )
+
+    def test_load_predictor_overrides_rejects_typo(self, tmp_path):
+        # the historical silent degrade: a typo'd override used to fall
+        # through every config.get and serve with defaults, no signal
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        def fake_builder(params, config):
+            knobs_mod.validate_keys(
+                config, extra_valid=tuple(_tiny_cfg()),
+                where="load_predictor",
+            )
+            return lambda batch: batch
+
+        from tensorflowonspark_tpu import checkpoint
+
+        export = tmp_path / "export"
+        checkpoint.save_for_serving(
+            str(export), {"w": np.zeros(2, np.float32)},
+            extra_metadata={"model_config": _tiny_cfg()},
+        )
+        serving.load_predictor(
+            str(export), builder=fake_builder, use_cache=False,
+            config_overrides={"chunk_size": 8},
+        )
+        with pytest.raises(UnknownKnobError, match="load_predictor"):
+            serving.load_predictor(
+                str(export), builder=fake_builder, use_cache=False,
+                config_overrides={"kv_page_token": 8},
+            )
+        # and through the REAL transformer builder, end to end
+        with pytest.raises(UnknownKnobError, match="kv_page_tokens"):
+            tr.serving_builder(
+                {}, dict(_tiny_cfg(), mode="generate",
+                         max_new_tokens=4, kv_page_token=8),
+            )
+
+    def test_planner_owned_and_table(self):
+        owned = {k.name for k in knobs_mod.planner_owned("serving")}
+        assert "kv_layout" in owned and "chunk_size" in owned
+        assert "max_new_tokens" not in owned    # a workload fact
+        table = knobs_mod.render_table()
+        assert "| `push_every` | train |" in table
+
+
+# ----------------------------------------------------------------------
+# cost model: calibration + pricing
+# ----------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_roofline_fallback_when_probes_disabled(self):
+        prof = cost_mod.calibrate()
+        assert prof.source == "roofline"
+        assert prof.platform == "cpu"
+        assert prof.matmul_gflops == cost_mod.ROOFLINE["cpu"][0]
+
+    def test_probe_then_cache(self, monkeypatch):
+        monkeypatch.setenv("TFOS_PLANNER_PROBES", "1")
+        first = cost_mod.calibrate()
+        assert first.source == "probe"
+        assert first.matmul_gflops > 0 and first.mem_gbs > 0
+        again = cost_mod.calibrate()
+        assert again.source == "cache"          # per-host JSON reused
+        assert again.matmul_gflops == pytest.approx(
+            first.matmul_gflops
+        )
+        forced = cost_mod.calibrate(force=True)
+        assert forced.source == "probe"
+
+    def test_measure_dcn_rtt_against_echo_server(self):
+        addr, stop = _echo_server()
+        try:
+            rtt = cost_mod.measure_dcn_rtt(addr, samples=2)
+            assert 0.0 < rtt < 1.0
+        finally:
+            stop()
+
+    def test_price_serving_shape_and_ordering(self):
+        cm = cost_mod.CostModel(ROOFLINE_CPU)
+        mc = _tiny_cfg()
+        hint = dict(planner.planner.DEFAULT_HINT, prompt_tokens=64)
+        base = dict(batch_size=8, chunk_size=16,
+                    kv_layout="contiguous", max_new_tokens=16)
+        a = cm.price_serving(mc, base, hint)
+        assert a["total_sec"] > 0 and a["path"]
+        assert a["bottleneck"] in a["components"]
+        # paged adds the indirection factor, all else equal
+        b = cm.price_serving(
+            mc, dict(base, kv_layout="paged", kv_page_tokens=16), hint
+        )
+        assert b["total_sec"] > a["total_sec"]
+        # a smaller chunk means more dispatches: overhead must grow
+        c = cm.price_serving(mc, dict(base, chunk_size=4), hint)
+        assert c["components"]["dispatch_overhead"] > \
+            a["components"]["dispatch_overhead"]
+
+    def test_price_train_cadence_rule_is_priced(self):
+        cm = cost_mod.CostModel(ROOFLINE_CPU)
+        hint = dict(planner.planner.DEFAULT_HINT, batch=64, seq_len=128)
+        fast = cm.price_train({}, {"push_every": 64, "max_inflight": 2},
+                              hint)
+        assert fast["per_step_sec"] > 0
+        assert fast["cadence_ok"] is True       # long window clears RTT
+        assert set(fast["components"]) == {"ici_steps", "dcn_push"}
+
+
+# ----------------------------------------------------------------------
+# search layer: legality, selection, decisions, journal
+# ----------------------------------------------------------------------
+
+
+class TestPlanner:
+    def test_plan_serving_emits_legal_config_and_journal_event(self):
+        j = telemetry.get_journal()
+        before = len(j.events(kind="planner_decision"))
+        p = planner.plan(
+            model_config=_tiny_cfg(), workload="serving",
+            device_count=1, hint={"prompt_tokens": 32, "prompt_max": 64},
+            profile=ROOFLINE_CPU,
+        )
+        assert planner.validate_candidate(
+            _tiny_cfg(), p.chosen, device_count=1
+        ) is None
+        cfg = p.config()
+        # batch_size is an engine knob (rides predict.plan), not a
+        # builder config key -- whitelist it alongside the model fields
+        knobs_mod.validate_keys(
+            cfg, extra_valid=("batch_size",) + tuple(_tiny_cfg()))
+        evs = j.events(kind="planner_decision")
+        assert len(evs) == before + 1
+        attrs = evs[-1].attrs
+        assert attrs["workload"] == "serving"
+        assert attrs["chosen"] and attrs["profile_source"] == "roofline"
+        assert attrs["candidates"] > 1
+
+    def test_overrides_pin_axes_and_are_logged(self):
+        p = planner.plan(
+            model_config=_tiny_cfg(), workload="serving",
+            device_count=1, profile=ROOFLINE_CPU,
+            overrides={"chunk_size": 4, "kv_layout": "contiguous"},
+        )
+        assert p.chosen["chunk_size"] == 4
+        assert p.chosen["kv_layout"] == "contiguous"
+        sources = {d["knob"]: d["source"] for d in p.decisions}
+        assert sources["chunk_size"] == "override"
+        assert sources["batch_size"] == "search"
+
+    def test_explain_renders_the_decision_story(self):
+        p = planner.plan(
+            model_config=_tiny_cfg(), workload="serving",
+            device_count=1, profile=ROOFLINE_CPU,
+        )
+        text = p.explain()
+        assert "planner explain (serving)" in text
+        assert "chosen" in text and "[search]" in text
+        if p.runner_up is not None:
+            assert "runner-up" in text and "modeled gap" in text
+
+    def test_train_plan_prefers_fresh_cadence_on_ties(self):
+        p = planner.plan(
+            workload="train", profile=ROOFLINE_CPU,
+            hint={"batch": 8, "seq_len": 64},
+        )
+        assert p.chosen["push_every"] in planner.planner.TRAIN_AXES[
+            "push_every"
+        ]
+        assert p.priced["per_step_sec"] > 0
+
+    def test_mixed_hint_turns_on_disaggregation_only_when_paged(self):
+        p = planner.plan(
+            model_config=_tiny_cfg(), workload="serving",
+            device_count=1, profile=ROOFLINE_CPU,
+            hint={"mixed": True, "prompt_tokens": 40, "prompt_max": 64},
+            overrides={"kv_layout": "paged", "kv_page_tokens": 16},
+        )
+        assert p.chosen["disaggregate"] is True
+        assert p.chosen["kv_layout"] == "paged"
+        assert planner.validate_candidate(
+            _tiny_cfg(), p.chosen, device_count=1
+        ) is None
+
+    def test_no_legal_candidate_raises_with_reasons(self):
+        # head_dim=8 makes every paged-kernel geometry tile-illegal;
+        # pinning the lattice to paged leaves nothing legal
+        with pytest.raises(ValueError, match="no legal candidate"):
+            planner.plan(
+                model_config=_tiny_cfg(head_dim=8, max_seq_len=16),
+                workload="serving", device_count=1,
+                profile=ROOFLINE_CPU,
+                overrides={"kv_layout": "paged", "paged_impl": "kernel",
+                           "max_new_tokens": 64},
+            )
+
+    def test_auto_serving_config_explicit_keys_win(self):
+        merged, p = planner.auto_serving_config(
+            dict(_tiny_cfg(), mode="generate", max_new_tokens=8,
+                 auto=True, chunk_size=4),
+            device_count=1, profile=ROOFLINE_CPU,
+        )
+        assert "auto" not in merged
+        assert merged["chunk_size"] == 4        # caller's pin survives
+        assert p.chosen["chunk_size"] == 4
+        # engine-side picks ride the Plan, never the builder config
+        assert "batch_size" not in merged
+        assert p.chosen["batch_size"] in planner.planner.SERVING_AXES[
+            "batch_size"
+        ]
+        knobs_mod.validate_keys(merged, extra_valid=tuple(_tiny_cfg()))
+
+    def test_cli_explain_json(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tensorflowonspark_tpu.planner",
+             "explain", "--no-probes", "--json", "--devices", "1",
+             "--config", json.dumps(_tiny_cfg())],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert out.returncode == 0, out.stderr
+        summary = json.loads(out.stdout)
+        assert summary["workload"] == "serving"
+        assert summary["chosen"]
+
+
+# ----------------------------------------------------------------------
+# the property sweep: planner output passes the validators it claims
+# to respect, across seeded-random shapes and device counts
+# ----------------------------------------------------------------------
+
+
+def _random_case(rng):
+    heads = int(rng.choice([2, 4, 8]))
+    mc = _tiny_cfg(
+        num_heads=heads,
+        num_kv_heads=int(rng.choice([h for h in (1, 2, heads)
+                                     if heads % h == 0])),
+        head_dim=int(rng.choice([64, 128, 256])),
+        num_layers=int(rng.choice([1, 2, 4])),
+        max_seq_len=int(rng.choice([128, 256, 512])),
+        cache_dtype=str(rng.choice(["float32", "int8"])),
+    )
+    hint = {
+        "prompt_tokens": int(rng.randint(8, 129)),
+        "prompt_max": int(rng.randint(16, 257)),
+        "shared_prefix_frac": float(rng.choice([0.0, 0.5, 0.9])),
+        "mixed": bool(rng.randint(0, 2)),
+        "qps": float(rng.choice([0.0, 4.0])),
+    }
+    overrides = {}
+    if rng.randint(0, 2):
+        overrides["max_new_tokens"] = int(rng.choice([8, 16, 32]))
+    if rng.randint(0, 3) == 0:
+        overrides["quantize"] = "int8"
+    return mc, hint, overrides, int(rng.choice([1, 2, 4, 8]))
+
+
+def test_property_sweep_every_emitted_config_is_legal():
+    rng = np.random.RandomState(1234)      # seeded: failures reproduce
+    for case in range(25):
+        mc, hint, overrides, devices = _random_case(rng)
+        p = planner.plan(
+            model_config=mc, workload="serving", device_count=devices,
+            hint=hint, profile=ROOFLINE_CPU, overrides=overrides,
+            journal=False,
+        )
+        why = planner.validate_candidate(mc, p.chosen, devices)
+        assert why is None, (case, mc, p.chosen, why)
+        # the emitted config is also key-valid for the builder
+        # (batch_size is an engine knob carried via predict.plan)
+        knobs_mod.validate_keys(
+            p.config(), extra_valid=("batch_size",) + tuple(mc),
+        )
+        # pinned axes survive into the chosen point
+        for k, v in overrides.items():
+            assert p.chosen.get(k) == v, (case, k)
+
+
+@pytest.mark.slow
+def test_auto_config_builds_a_real_predictor_end_to_end():
+    from tensorflowonspark_tpu.models import transformer as tr
+    import jax
+    import jax.numpy as jnp
+
+    cfg = _tiny_cfg()
+    model = tr.Transformer(tr.TransformerConfig(**cfg))
+    params = jax.jit(
+        lambda r: model.init(r, jnp.zeros((1, 8), jnp.int32))["params"]
+    )(jax.random.PRNGKey(0))
+    predict = tr.serving_builder(
+        params, dict(cfg, mode="generate", max_new_tokens=4, auto=True),
+    )
+    assert predict.plan and predict.plan["workload"] == "serving"
+    rows = [{"prompt": np.arange(1, 9, dtype=np.int32)}
+            for _ in range(4)]
+    out = list(serving.predict_rows(
+        predict, rows, {"prompt": "tokens"},
+        batch_size="auto", schedule="auto",
+    ))
+    assert len(out) == 4
+    assert all(r["generated"].shape == (4,) for r in out)
+
+
+# ----------------------------------------------------------------------
+# live re-planner: triggers, hysteresis, exactly-once
+# ----------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+class TestLivePlanner:
+    def _rtt_planner(self, rtts, clock, **kw):
+        seq = iter(rtts)
+        applied = []
+        kw.setdefault("push_every", 8)
+        kw.setdefault("step_time_sec", 1e-3)
+        kw.setdefault("sustain", 2)
+        kw.setdefault("cooldown_sec", 60.0)
+        lp = planner.LivePlanner(
+            1e-3,
+            actuators={"push_every": applied.append},
+            rtt_probe=lambda: next(seq), clock=clock, **kw
+        )
+        return lp, applied
+
+    def test_rtt_drift_sustain_then_one_replan(self):
+        clock = _Clock()
+        lp, applied = self._rtt_planner([0.02] * 6, clock)
+        assert lp.step() == []            # round 1: asserting, not yet
+        (rec,) = lp.step()                # round 2: sustained -> replan
+        assert rec.applied and rec.knob == "push_every"
+        assert rec.new == 25              # ceil(1.25 * 20ms / 1ms)
+        assert applied == [25]
+        assert rec.evidence["sustained_rounds"] == 2
+        # exactly-once: the drift is the new baseline, so the SAME
+        # sustained RTT never re-triggers — one episode, one re-plan
+        for _ in range(4):
+            assert lp.step() == []
+        assert lp.baseline_rtt == pytest.approx(0.02)
+        assert lp.push_every == 25
+
+    def test_rtt_recovery_resets_hysteresis(self):
+        clock = _Clock()
+        lp, applied = self._rtt_planner(
+            [0.02, 0.001, 0.02, 0.001], clock
+        )
+        for _ in range(4):
+            lp.step()
+        assert applied == []              # never 2 consecutive rounds
+
+    def test_cooldown_suppresses_and_counts(self):
+        clock = _Clock()
+        reg = telemetry.get_registry()
+        lp, applied = self._rtt_planner(
+            [0.02] * 2 + [0.2] * 4, clock, cooldown_sec=300.0,
+        )
+        lp.step()
+        lp.step()                         # applied; cooldown starts
+        assert len(applied) == 1
+        before = reg.counter("planner.replan_suppressed").value
+        for _ in range(3):
+            clock.tick(1.0)
+            lp.step()                     # 10x again, but cooling down
+        assert len(applied) == 1
+        assert reg.counter("planner.replan_suppressed").value > before
+
+    def test_actuator_failure_journals_unapplied(self):
+        j = telemetry.get_journal()
+        before = len(j.events(kind="replan"))
+
+        def boom(_):
+            raise RuntimeError("window boundary refused")
+
+        clock = _Clock()
+        seq = iter([0.02] * 2)
+        lp = planner.LivePlanner(
+            1e-3, actuators={"push_every": boom},
+            rtt_probe=lambda: next(seq),
+            push_every=8, step_time_sec=1e-3, sustain=2, clock=clock,
+        )
+        lp.step()
+        (rec,) = lp.step()
+        assert not rec.applied and "window boundary refused" in rec.error
+        evs = j.events(kind="replan")[before:]
+        assert len(evs) == 1
+        assert evs[0].severity == "warn"
+        assert evs[0].attrs["applied"] is False
+        assert lp.push_every == 8         # state unchanged on failure
+
+    def test_prompt_mix_shift_regrows_slot_buckets(self):
+        clock = _Clock()
+        grown = []
+        mean = {"v": 60.0}
+        lp = planner.LivePlanner(
+            1e-3, actuators={"slot_buckets": grown.append},
+            prompt_mix_fn=lambda: mean["v"],
+            planned_prompt_tokens=64, sustain=2, clock=clock,
+        )
+        for _ in range(3):
+            assert lp.step() == []        # under 1.5x: no shift
+        mean["v"] = 200.0
+        lp.step()
+        (rec,) = lp.step()
+        assert rec.knob == "slot_buckets" and rec.applied
+        assert grown == [256]             # next power of two up
+        assert lp.planned_prompt_tokens == 256
+
+    def test_page_occupancy_resizes_pool_both_ways(self):
+        clock = _Clock()
+        sized = []
+        occ = {"v": 0.95}
+        lp = planner.LivePlanner(
+            1e-3, actuators={"kv_pages": sized.append},
+            occupancy_fn=lambda: occ["v"], kv_pages=100,
+            sustain=1, cooldown_sec=0.0, clock=clock,
+        )
+        (rec,) = lp.step()
+        assert rec.new == 151 and sized == [151]   # grow 1.5x + 1
+        occ["v"] = 0.1
+        clock.tick(1.0)
+        (rec,) = lp.step()
+        assert rec.new == 113 and rec.applied      # shrink to 0.75x
+        assert lp.kv_pages == 113
+
+    def test_store_backed_sensors(self):
+        from tensorflowonspark_tpu.telemetry.health import (
+            TimeSeriesStore,
+        )
+
+        from tensorflowonspark_tpu.telemetry.registry import (
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()
+        for v in (120.0, 130.0):
+            reg.histogram("serving.prompt_tokens").observe(v)
+        reg.gauge("serving.pool_pages").set(100.0)
+        reg.gauge("serving.pool_pages_used").set(95.0)
+        store = TimeSeriesStore()
+        store.append(0, reg.snapshot())
+        lp = planner.LivePlanner(
+            1e-3, store=store, planned_prompt_tokens=64, kv_pages=100,
+            sustain=1, cooldown_sec=0.0, clock=_Clock(),
+        )
+        recs = lp.step()
+        assert {r.trigger for r in recs} == {
+            "prompt_mix", "page_occupancy"
+        }
+        # drift() is the generic form the sensors build on
+        assert store.drift("serving.prompt_tokens", 64.0) == \
+            pytest.approx(125.0 / 64.0)
+
+    def test_sensor_exception_skips_round_not_planner(self):
+        clock = _Clock()
+
+        def broken():
+            raise OSError("probe endpoint gone")
+
+        lp = planner.LivePlanner(
+            1e-3, rtt_probe=broken,
+            occupancy_fn=lambda: 0.95, kv_pages=100,
+            actuators={"kv_pages": lambda n: None},
+            sustain=1, cooldown_sec=0.0, clock=clock,
+        )
+        (rec,) = lp.step()                # pages trigger still ran
+        assert rec.trigger == "page_occupancy"
+
+
+# ----------------------------------------------------------------------
+# the chaos e2e: injected DCN-RTT drift -> exactly ONE audited re-plan
+# ----------------------------------------------------------------------
+
+
+def _echo_server():
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(16)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    data = conn.recv(64)
+                    if data:
+                        conn.sendall(data)
+                except OSError:
+                    pass
+
+    threading.Thread(target=loop, daemon=True,
+                     name="planner-echo").start()
+
+    def shutdown():
+        stop.set()
+        srv.close()
+
+    return srv.getsockname(), shutdown
+
+
+def test_dcn_drift_e2e_exactly_one_audited_push_every_replan():
+    j = telemetry.get_journal()
+    before = len(j.events(kind="replan"))
+    addr, shutdown = _echo_server()
+    gremlin = chaos.TcpGremlin(addr)
+    proxied = gremlin.start()
+    clock = _Clock()
+    applied = []
+    try:
+        baseline = cost_mod.measure_dcn_rtt(proxied, samples=2)
+        lp = planner.LivePlanner(
+            baseline,
+            actuators={"push_every": applied.append},
+            rtt_probe=lambda: cost_mod.measure_dcn_rtt(
+                proxied, samples=1
+            ),
+            push_every=8, step_time_sec=1e-3,
+            sustain=2, cooldown_sec=600.0, clock=clock,
+        )
+        for _ in range(3):                # clean link: no re-plans
+            assert lp.step() == []
+            clock.tick(1.0)
+        gremlin.delay(0.05)               # the injected drift
+        for _ in range(6):                # sustained episode
+            lp.step()
+            clock.tick(1.0)
+    finally:
+        gremlin.stop()
+        shutdown()
+    # exactly ONE applied re-plan for the whole drift episode
+    assert len(applied) == 1
+    new = applied[0]
+    assert new > 8                        # cadence re-derived from RTT
+    evs = j.events(kind="replan")[before:]
+    assert len(evs) == 1                  # audited exactly once
+    attrs = evs[0].attrs
+    assert attrs["trigger"] == "dcn_rtt"
+    assert attrs["knob"] == "push_every"
+    assert attrs["applied"] is True
+    assert attrs["evidence"]["measured_rtt_ms"] >= 50.0
+    assert attrs["evidence"]["baseline_rtt_ms"] < 50.0
+
+
+# ----------------------------------------------------------------------
+# actuation seams: HierTrainer.set_push_every, engine request_retune
+# ----------------------------------------------------------------------
+
+
+def test_hier_trainer_set_push_every_is_validated_and_journaled():
+    import jax.numpy as jnp
+
+    from tensorflowonspark_tpu.parallel import hier_ps
+
+    def quad(params, _):
+        return jnp.sum(params["w"] ** 2)
+
+    j = telemetry.get_journal()
+    before = len(j.events(kind="push_every_retune"))
+    tr = hier_ps.HierTrainer(
+        quad, None, optimizer=("sgd", {"learning_rate": 0.1}),
+        push_every=4,
+    )
+    try:
+        tr.init({"w": np.zeros(2, np.float32)})
+        old = tr.set_push_every(16)
+        assert old == 4 and tr.push_every == 16
+        tr.step(None)                     # window math keeps working
+        with pytest.raises(ValueError, match="push_every"):
+            tr.set_push_every(0)
+        assert tr.push_every == 16
+        tr.set_push_every(16)             # no-op: no event
+    finally:
+        tr.stop()
+    evs = j.events(kind="push_every_retune")[before:]
+    assert len(evs) == 1
+    assert evs[0].attrs["old"] == 4 and evs[0].attrs["new"] == 16
+
+
+def test_engine_request_retune_applies_between_chunks():
+    from tensorflowonspark_tpu import serving_engine
+
+    from test_fleet import FakePredict  # noqa: F811 - shared fake
+
+    j = telemetry.get_journal()
+    before = len(j.events(kind="engine_retune"))
+    eng = serving_engine.ServingEngine(
+        FakePredict(), {"prompt": "tokens"}, None, 2, queue_depth=4,
+    )
+    with pytest.raises(ValueError, match="retunable engine knobs"):
+        eng.request_retune(chunk_size=8)  # geometry: not retunable
+    eng.request_retune(queue_depth=16, default_deadline=2.5)
+    rows = [{"prompt": np.arange(1, 4, dtype=np.int32)}
+            for _ in range(3)]
+    out = list(eng.serve(rows))
+    assert len(out) == 3
+    assert eng.queue_depth == 16 and eng.default_deadline == 2.5
+    evs = j.events(kind="engine_retune")[before:]
+    assert len(evs) == 1
+    assert evs[0].attrs["knobs"]["queue_depth"]["new"] == 16
+
+
+# ----------------------------------------------------------------------
+# CostPolicy: probe then evict the chip_sec/token outlier (fake ledger)
+# ----------------------------------------------------------------------
+
+
+class TestCostPolicy:
+    def _rows(self, bad_ratio=3.0):
+        # r1 burns bad_ratio x the chips per emitted token while being
+        # neither slow nor unhealthy — latency policies never see it
+        return {
+            "r0": {"state": "live", "chip_sec": 10.0,
+                   "tokens_out": 10000},
+            "r1": {"state": "live", "chip_sec": 10.0 * bad_ratio,
+                   "tokens_out": 10000},
+            "r2": {"state": "live", "chip_sec": 11.0,
+                   "tokens_out": 10000},
+        }
+
+    def _policy(self, rows_ref, **kw):
+        from tensorflowonspark_tpu.remediation import CostPolicy
+
+        kw.setdefault("sustain", 2)
+        kw.setdefault("evict_after", 2)
+        return CostPolicy(ledger_fn=lambda: rows_ref["rows"], **kw)
+
+    def _snap(self):
+        from tensorflowonspark_tpu.remediation.engine import (
+            SensorSnapshot,
+        )
+
+        return SensorSnapshot(
+            t=0.0, alerts=[], alert_gap=False, hints={}, events=[],
+            pressure=None, fleet=None, probation=[], deploy_active=False,
+        )
+
+    def test_probe_targets_worst_ratio_not_slowest(self):
+        ref = {"rows": self._rows()}
+        pol = self._policy(ref)
+        assert pol.evaluate(self._snap()) == []   # round 1: hysteresis
+        (intent,) = pol.evaluate(self._snap())
+        assert intent.action == "probe_replica"
+        assert intent.target == {"replica_id": "r1"}
+        ev = intent.evidence
+        assert ev["worst"] == "r1"
+        assert ev["ratios_chip_sec_per_token"]["r1"] == \
+            pytest.approx(0.003)
+        assert ev["sustained_rounds"] == 2
+
+    def test_cold_replicas_are_not_judged(self):
+        ref = {"rows": {
+            "r0": {"state": "live", "chip_sec": 10.0,
+                   "tokens_out": 10000},
+            "cold": {"state": "live", "chip_sec": 50.0,
+                     "tokens_out": 3},          # all prefill, no verdict
+        }}
+        pol = self._policy(ref)
+        for _ in range(4):
+            assert pol.evaluate(self._snap()) == []
+
+    def test_probe_then_sustained_outlier_retires(self):
+        ref = {"rows": self._rows()}
+        pol = self._policy(ref)
+        pol.evaluate(self._snap())
+        (probe,) = pol.evaluate(self._snap())
+        # executed decision feedback arms the post-probe watch
+        pol.on_decision({"action": "probe_replica",
+                         "target": {"replica_id": "r1"},
+                         "executed": True, "dry_run": False})
+        assert pol.evaluate(self._snap()) == []   # round 1 after probe
+        (retire,) = pol.evaluate(self._snap())
+        assert retire.action == "retire_replica"
+        assert retire.target == {"replica_id": "r1"}
+        assert retire.evidence["post_probe_rounds"] == 2
+
+    def test_recovery_after_probe_readmits_quietly(self):
+        ref = {"rows": self._rows()}
+        pol = self._policy(ref)
+        pol.evaluate(self._snap())
+        pol.evaluate(self._snap())
+        pol.on_decision({"action": "probe_replica",
+                         "target": {"replica_id": "r1"},
+                         "executed": True, "dry_run": False})
+        ref["rows"] = self._rows(bad_ratio=1.1)   # probe fixed it
+        for _ in range(4):
+            assert pol.evaluate(self._snap()) == []
+        assert "r1" not in pol.probed             # fresh cycle if it
+        assert pol._post_probe == {}              # regresses later
+
+    def test_default_policies_include_cost(self):
+        from tensorflowonspark_tpu.remediation import (
+            CostPolicy, default_policies,
+        )
+
+        pols = default_policies(cost={"ratio_factor": 4.0})
+        (cp,) = [p for p in pols if isinstance(p, CostPolicy)]
+        assert cp.ratio_factor == 4.0
+
+    def test_probe_replica_verb_routes_around_via_router(self):
+        from tensorflowonspark_tpu.remediation import (
+            Actuators, UnsupportedAction,
+        )
+
+        with pytest.raises(UnsupportedAction):
+            Actuators().probe_replica(replica_id="r0")
+
+
+# ----------------------------------------------------------------------
+# forensics: "why did the config change?"
+# ----------------------------------------------------------------------
+
+
+def test_forensics_explain_reports_config_changes(tmp_path):
+    from tensorflowonspark_tpu.telemetry.journal import Event
+
+    export = {"events": [
+        Event("planner_decision", ts=10.0, seq=1, pid=1, executor=0,
+              severity="info",
+              attrs={"workload": "serving",
+                     "chosen": {"chunk_size": 16, "kv_layout": "paged"},
+                     "gap_pct": 3.2, "profile_source": "probe"},
+              ).to_dict(),
+        Event("replan", ts=20.0, seq=2, pid=1, executor=0,
+              severity="info",
+              attrs={"trigger": "dcn_rtt", "knob": "push_every",
+                     "old": 8, "new": 25, "applied": True,
+                     "evidence": {"measured_rtt_ms": 20.0,
+                                  "baseline_rtt_ms": 1.0}},
+              ).to_dict(),
+    ]}
+    p = tmp_path / "journal_export.json"
+    p.write_text(json.dumps(export))
+    report = forensics.explain([str(p)])
+    kinds = [e["kind"] for e in report["config_changes"]]
+    assert kinds == ["planner_decision", "replan"]
+    text = forensics.render_report(report)
+    assert "config changes" in text
+    assert "planned serving" in text
+    assert "replan [dcn_rtt] push_every: 8 -> 25" in text
+    assert "measured_rtt_ms" in text
