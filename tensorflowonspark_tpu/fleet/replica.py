@@ -50,6 +50,47 @@ class ReplicaKilled(RuntimeError):
     process/chip death mid-decode (testing/chaos.py)."""
 
 
+class ReplicaDeviceError(RuntimeError):
+    """A DEVICE error inside this replica's engine (an XLA runtime
+    fault on a mesh-sharded program, or the chaos ``device_error``
+    stand-in).  Unlike :class:`ReplicaKilled` — terminal — the
+    replica's host-side scheduler survived: it posts its wreckage for
+    committed-token-safe re-dispatch, QUARANTINES (the router's evict
+    verb routes around it), rebuilds its engine (the predictor caches
+    the compiled decoder, so this is cheap), and serves probe traffic
+    until clean rounds re-admit it."""
+
+
+#: exception type names treated as device errors when they surface
+#: inside a replica's serve loop (jaxlib's runtime error classes are
+#: matched by NAME so the containment works without importing jaxlib
+#: internals)
+_DEVICE_ERROR_NAMES = ("XlaRuntimeError", "JaxRuntimeError",
+                      "InternalError")
+
+#: engine stats keys that must stay CUMULATIVE across a quarantine
+#: rebuild (a fresh engine resets the shared stats dict in place; the
+#: ledger invariant — per-request rows summing to the fleet's decode
+#: wall — needs the pre-quarantine spend preserved)
+_CUMULATIVE_STATS = (
+    "admitted", "completed", "chunks", "errors", "shed", "expired",
+    "degraded", "watchdog_fires", "recovered", "request_wire_bytes",
+    "prefix_hits", "prefix_tokens_saved", "evictions",
+    "pressure_evictions", "swaps", "swap_requeued", "drained",
+    "decode_wall_sec", "tokens_out", "prefill_wall_sec",
+    "prefill_watchdog_fires", "prefill_worker_deaths",
+    "prefill_restarts", "leases_reaped",
+)
+
+
+def _is_device_error(exc):
+    """Does ``exc`` look like a device/runtime fault (quarantinable)
+    rather than a scheduler bug or chaos kill (terminal)?"""
+    if isinstance(exc, ReplicaDeviceError):
+        return True
+    return type(exc).__name__ in _DEVICE_ERROR_NAMES
+
+
 class Replica(object):
     """One routable serving engine (see module docstring).
 
@@ -111,29 +152,73 @@ class Replica(object):
             opts["wedge_fn"] = fault_fn
         if engine_factory is None:
             engine_factory = serving_engine.ServingEngine
-
-        def build():
-            return engine_factory(
-                predict, input_mapping, None, num_slots, chunk=chunk,
-                queue_depth=queue_depth, policy="block",
-                on_error="record", stats=self.stats, **opts
-            )
-
-        if device is not None:
-            # decoder state (slot caches, weights) must live on the
-            # replica's device: build under the same default-device
-            # context the worker serves under (thread-local, so both
-            # threads enter it explicitly)
-            import jax
-
-            with jax.default_device(device):
-                self.engine = build()
-        else:
-            self.engine = build()
+        # construction knobs kept so a quarantined replica can rebuild
+        # its engine in place (_rebuild_engine)
+        self._engine_factory = engine_factory
+        self._input_mapping = input_mapping
+        self._num_slots = num_slots
+        self._chunk = chunk
+        self._queue_depth = queue_depth
+        self._opts = opts
+        self.engine = self._build_engine()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name="fleet-replica-%d" % self.replica_id,
         )
+
+    def _build_engine(self):
+        """Build this replica's engine, under its default-device
+        context when pinned (decoder state — slot caches, weights —
+        must live on the replica's device; the context is thread-local
+        so construction and serving both enter it explicitly)."""
+        def build():
+            return self._engine_factory(
+                self.predict, self._input_mapping, None,
+                self._num_slots, chunk=self._chunk,
+                queue_depth=self._queue_depth, policy="block",
+                on_error="record", stats=self.stats, **self._opts
+            )
+
+        if self.device is not None:
+            import jax
+
+            with jax.default_device(self.device):
+                return build()
+        return build()
+
+    def _rebuild_engine(self):
+        """Rebuild the engine after a quarantined device error.  The
+        predictor caches its SlotDecoder, so the rebuilt engine reuses
+        the compiled programs; the decoder's slots reset (freeing the
+        quarantined incarnation's pages), the submit/emit pairing
+        restarts with the fresh engine's input numbering, and the
+        counters a fresh engine zeroes in the shared stats dict are
+        restored cumulatively (the fleet ledger invariant — rows
+        summing to decode wall — spans incarnations)."""
+        prior = {
+            k: v for k, v in self.stats.items()
+            if k in _CUMULATIVE_STATS
+            and isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+        dec = getattr(self.engine, "decoder", None)
+        reset = getattr(dec, "reset", None)
+        if reset is not None:
+            try:
+                reset()
+            except Exception:  # noqa: BLE001 - a broken decoder must
+                logger.warning(  # not stop the quarantine rebuild
+                    "replica %d: decoder reset failed during "
+                    "quarantine rebuild", self.replica_id,
+                    exc_info=True,
+                )
+        self._submitted = []
+        self._emitted = 0
+        self.engine = self._build_engine()
+        for k, v in prior.items():
+            cur = self.stats.get(k)
+            if isinstance(cur, (int, float)) and not isinstance(
+                    cur, bool):
+                self.stats[k] = cur + v
 
     # -- lifecycle ------------------------------------------------------
 
@@ -203,14 +288,34 @@ class Replica(object):
             yield row
 
     def _run(self):
-        serve = self.engine.serve(self._source())
-        if self.device is not None:
-            import jax
+        while True:
+            serve = self.engine.serve(self._source())
+            if self.device is not None:
+                import jax
 
-            with jax.default_device(self.device):
-                self._drive(serve)
-        else:
-            self._drive(serve)
+                with jax.default_device(self.device):
+                    status = self._drive(serve)
+            else:
+                status = self._drive(serve)
+            if status != "quarantine":
+                return
+            # contained device error: rebuild the engine in place and
+            # keep serving (probe traffic while routed around; full
+            # traffic again once clean rounds re-admit the replica)
+            try:
+                self._rebuild_engine()
+            except BaseException as e:  # noqa: BLE001 - rebuild
+                self.state = "dead"   # failure IS a death
+                self.error = e
+                logger.warning(
+                    "fleet replica %d: quarantine rebuild failed, "
+                    "replica is dead: %s", self.replica_id, e,
+                )
+                self._completions.put((
+                    "dead", self.replica_id,
+                    {"finished": {}, "committed": {}, "queued": []},
+                ))
+                return
 
     def _drive(self, serve):
         try:
@@ -221,6 +326,21 @@ class Replica(object):
                     ("done", self.replica_id, fid, out)
                 )
         except BaseException as e:  # noqa: BLE001 - death is a message
+            if _is_device_error(e):
+                # the host-side scheduler survived a device fault:
+                # quarantine instead of dying — wreckage still posts
+                # (the router re-dispatches it on a survivor), but the
+                # replica will rebuild and serve probe traffic
+                self.state = "routed_around"
+                self.error = e
+                logger.warning(
+                    "fleet replica %d quarantined on device error: %s",
+                    self.replica_id, e,
+                )
+                self._completions.put(
+                    ("quarantine", self.replica_id, self._wreckage())
+                )
+                return "quarantine"
             self.state = "dead"
             self.error = e
             logger.warning(
@@ -229,8 +349,9 @@ class Replica(object):
             self._completions.put(
                 ("dead", self.replica_id, self._wreckage())
             )
-            return
+            return "dead"
         self._completions.put(("stopped", self.replica_id))
+        return "stopped"
 
     def _wreckage(self):
         """Post-mortem accounting a dead replica owes the router
@@ -282,13 +403,20 @@ class Replica(object):
                     "wreckage ledger flush failed for %r: %s",
                     req.get("rid"), e,
                 )
+        saw_stop = False
         while True:
             try:
                 item = self._q.get_nowait()
             except queue_mod.Empty:
                 break
-            if item is not _STOP:
+            if item is _STOP:
+                saw_stop = True
+            else:
                 queued.append(item[0])
+        if saw_stop:
+            # a close() raced the fault: keep the stop order so a
+            # quarantined replica's rebuilt loop still honors it
+            self._q.put(_STOP)
         # engine indices consumed but accounted nowhere (lost between
         # pull and admit) re-dispatch from scratch
         for idx in range(self._emitted, len(self._submitted)):

@@ -45,6 +45,7 @@ import collections
 import itertools
 import logging
 import queue as queue_mod
+import threading
 import time
 
 import numpy as np
@@ -165,7 +166,8 @@ def _prefix_affinity(router, req, candidates):
         router._m["affinity_hits"].inc()
         return target
     router.stats["affinity_spills"] += 1
-    router._spill_times.append(router._clock())
+    with router._pressure_lock:
+        router._spill_times.append(router._clock())
     return _least_loaded(router, req, candidates)
 
 
@@ -239,8 +241,9 @@ class FleetRouter(object):
                  replica_weights=None, imbalance=None,
                  affinity_width=None, slow_factor=4.0,
                  min_slow_sec=0.05, suspect_rounds=2, probe_every=8,
-                 readmit_rounds=3, stats=None, clock=None, seed=0,
-                 poll_sec=0.05, pressure_window=30.0):
+                 readmit_rounds=3, readmit_gate=None, stats=None,
+                 clock=None, seed=0, poll_sec=0.05,
+                 pressure_window=30.0):
         if policy not in serving_engine.POLICIES:
             raise ValueError(
                 "fleet policy must be one of {0}, got {1!r}".format(
@@ -331,6 +334,14 @@ class FleetRouter(object):
         self.suspect_rounds = max(1, int(suspect_rounds))
         self.probe_every = max(1, int(probe_every))
         self.readmit_rounds = max(1, int(readmit_rounds))
+        #: optional quality gate on re-admission (a
+        #: :class:`~tensorflowonspark_tpu.telemetry.health.
+        #: CleanRoundsSensor`): a replica with enough clean probe
+        #: rounds still waits until the HEALTH PLANE has seen N
+        #: consecutive clean rounds fleet-wide — quality-gated, not
+        #: timer-gated (ROADMAP 3 residual)
+        self.readmit_gate = readmit_gate
+        self._gate_blocked = {}   # rid -> True while gate holds it
         self._weights = dict(replica_weights or {})
         self._rr_current = {}
         self._rng = np.random.RandomState(int(seed))
@@ -355,7 +366,7 @@ class FleetRouter(object):
             "latency_sec": {}, "done_at": {}, "dispatched": 0,
             "completed": 0, "errors": 0, "shed": 0, "expired": 0,
             "degraded": 0, "drained": 0, "redispatched": 0,
-            "replica_deaths": 0, "affinity_hits": 0,
+            "replica_deaths": 0, "quarantined": 0, "affinity_hits": 0,
             "affinity_spills": 0, "evicted": 0, "readmitted": 0,
             "scaled_up": 0, "scaled_down": 0,
             "replicas": len(self.replicas),
@@ -389,6 +400,9 @@ class FleetRouter(object):
         self._occupancy_samples = collections.deque()  # (t, occupancy)
         self._shed_times = collections.deque()
         self._spill_times = collections.deque()
+        # pressure() is read off-thread (remediation sensors, /status
+        # scrapes) while the serve pass appends — guard the deques
+        self._pressure_lock = threading.Lock()
         # /status provider (weakref-bound like the engine's: a
         # finished router must never pin its replicas' decoders)
         import weakref
@@ -426,15 +440,17 @@ class FleetRouter(object):
         """One admission-pressure sample per serve pass (bounded by
         the window — trimmed on both sample and read)."""
         now = self._clock()
-        self._occupancy_samples.append(
-            (now, len(self._queue) / float(self.queue_depth))
-        )
-        horizon = now - self.pressure_window
-        for dq in (self._occupancy_samples, self._shed_times,
-                   self._spill_times):
-            while dq and (dq[0][0] if dq is self._occupancy_samples
-                          else dq[0]) < horizon:
-                dq.popleft()
+        with self._pressure_lock:
+            self._occupancy_samples.append(
+                (now, len(self._queue) / float(self.queue_depth))
+            )
+            horizon = now - self.pressure_window
+            for dq in (self._occupancy_samples, self._shed_times,
+                       self._spill_times):
+                while dq and (dq[0][0]
+                              if dq is self._occupancy_samples
+                              else dq[0]) < horizon:
+                    dq.popleft()
 
     def pressure(self):
         """The windowed admission-pressure statistic (ISSUE 16
@@ -446,9 +462,11 @@ class FleetRouter(object):
         spawn/retire decisions."""
         now = self._clock()
         horizon = now - self.pressure_window
-        occ = [v for (t, v) in self._occupancy_samples if t >= horizon]
-        sheds = sum(1 for t in self._shed_times if t >= horizon)
-        spills = sum(1 for t in self._spill_times if t >= horizon)
+        with self._pressure_lock:
+            occ = [v for (t, v) in self._occupancy_samples
+                   if t >= horizon]
+            sheds = sum(1 for t in self._shed_times if t >= horizon)
+            spills = sum(1 for t in self._spill_times if t >= horizon)
         occ_now = len(self._queue) / float(self.queue_depth)
         return {
             "window_sec": self.pressure_window,
@@ -549,7 +567,8 @@ class FleetRouter(object):
     def _shed(self, fid, rid, why):
         self.stats["shed"] += 1
         self._m["shed"].inc()
-        self._shed_times.append(self._clock())
+        with self._pressure_lock:
+            self._shed_times.append(self._clock())
         # the mark rides the REQUEST's trace and names it in attrs
         # (ISSUE 14 satellite: fleet actions connect to the requests
         # they touched, not just a generic trace="fleet")
@@ -831,6 +850,9 @@ class FleetRouter(object):
         elif kind == "dead":
             _, rid, wreck = ev
             self._on_death(rid, wreck)
+        elif kind == "quarantine":
+            _, rid, wreck = ev
+            self._on_quarantine(rid, wreck)
         # "stopped" needs no action (clean close)
 
     def _on_death(self, rid, wreck):
@@ -862,6 +884,48 @@ class FleetRouter(object):
             "row(s), re-dispatching %d request(s)", rid,
             replica.error, len(wreck["finished"]), n_redisp,
         )
+        self._requeue_wreckage(rid, wreck)
+
+    def _on_quarantine(self, rid, wreck):
+        """A replica contained a DEVICE error: quarantine it via the
+        evict verb (probe traffic only while it rebuilds and proves
+        itself) and continue its in-flight requests
+        committed-token-safe on a survivor — each request's merged
+        trace carries straight on, the same re-dispatch invariant the
+        death path pins."""
+        replica = self.replicas[rid]
+        self.replica_set.evict(rid)
+        self._suspect[rid] = 0
+        self._clean[rid] = 0
+        self.stats["quarantined"] += 1
+        self.stats["evicted"] += 1
+        self._m["evictions"].inc()
+        n_redisp = len(wreck["committed"]) + len(wreck["queued"])
+        touched = sorted(
+            set(wreck["committed"]) | set(wreck["queued"])
+            | set(wreck["finished"])
+        )
+        self._tracer.mark(
+            "replica_quarantined", trace="fleet", severity="page",
+            replica=rid, error=str(replica.error),
+            finished=len(wreck["finished"]), redispatching=n_redisp,
+            request_ids=touched,
+            trace_ids=[
+                self.stats["trace_ids"].get(f) for f in touched
+            ],
+        )
+        logger.warning(
+            "fleet: replica %d quarantined on device error (%s); "
+            "delivering %d finished row(s), re-dispatching %d "
+            "request(s) on survivors", rid, replica.error,
+            len(wreck["finished"]), n_redisp,
+        )
+        self._requeue_wreckage(rid, wreck)
+
+    def _requeue_wreckage(self, rid, wreck):
+        """Deliver a wrecked replica's finished rows and re-dispatch
+        the rest (committed-token-safe) — shared by the death and
+        quarantine paths."""
         # finished-but-unemitted rows are real results — deliver
         for fid, out in sorted(wreck["finished"].items()):
             self._assigned[rid].discard(fid)
@@ -869,7 +933,7 @@ class FleetRouter(object):
             if req is not None:
                 self._finalize(fid, req, out, rid)
         # in-flight work re-dispatches from its committed tokens,
-        # queued work from scratch — dead replica excluded
+        # queued work from scratch — wrecked replica excluded
         resumed = []
         for fid, committed in wreck["committed"].items():
             req = self._reqs.get(fid)
@@ -956,6 +1020,41 @@ class FleetRouter(object):
             if lat <= threshold:
                 self._clean[rid] += 1
                 if self._clean[rid] >= self.readmit_rounds:
+                    gate = self.readmit_gate
+                    if gate is not None:
+                        gate.poll()
+                        if not gate.ready():
+                            # quality gate holds the re-admission:
+                            # enough clean PROBE rounds, but the
+                            # health plane has not yet seen N clean
+                            # rounds fleet-wide.  Journal once per
+                            # blocked streak; keep probing.
+                            if not self._gate_blocked.get(rid):
+                                self._gate_blocked[rid] = True
+                                self._tracer.mark(
+                                    "readmit_gated", trace="fleet",
+                                    severity="warn", replica=rid,
+                                    clean_probe_rounds=self._clean[
+                                        rid],
+                                    clean_health_rounds=gate.streak,
+                                    required_rounds=gate.rounds,
+                                )
+                                logger.info(
+                                    "fleet: re-admission of replica "
+                                    "%d gated on health plane (%d/%d "
+                                    "clean rounds)", rid, gate.streak,
+                                    gate.rounds,
+                                )
+                            return
+                    if self._gate_blocked.pop(rid, None):
+                        self._tracer.mark(
+                            "readmit_cleared", trace="fleet",
+                            replica=rid,
+                            clean_health_rounds=(
+                                gate.streak if gate is not None
+                                else None
+                            ),
+                        )
                     self.replica_set.readmit(rid)
                     self._clean[rid] = 0
                     self._lat_ewma[rid] = lat
@@ -1188,8 +1287,13 @@ class FleetRouter(object):
         """Route ``rows`` over the fleet; yields output rows / typed
         records in fleet input order.  Replicas keep running after the
         stream ends (warm caches, pending deploys) — close them via
-        :meth:`close` / the :func:`predict_rows_fleet` wrapper."""
+        :meth:`close` / the :func:`predict_rows_fleet` wrapper.
+
+        ``serve`` is re-entrant: each call opens a fresh stream over
+        the same warm fleet (the soak harness serves load in waves,
+        probing invariants between streams)."""
         it = iter(rows)
+        self._exhausted = False
         while True:
             self._deploy_step()
             self._pull(it)

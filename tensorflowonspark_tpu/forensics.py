@@ -72,6 +72,10 @@ FAULT_KINDS = (
     "executor_restart",
     "swap_rollback",
     "replica_dead",
+    "replica_quarantined",
+    "prefill_worker_dead",
+    "prefill_watchdog_fire",
+    "lease_reaped",
     "remediation_budget_exhausted",
     "straggler_flagged",
     "alert_firing",
@@ -117,6 +121,10 @@ FAULT_MAP = {
     "alert_firing": "slo_burn",
     "straggler_flagged": "slow_executor",
     "replica_dead": "kill_replica",
+    "replica_quarantined": "device_error",
+    "prefill_worker_dead": "kill_prefill",
+    "prefill_watchdog_fire": "wedge_prefill",
+    "lease_reaped": "leak_lease",
     "remediation_budget_exhausted": "remediation_runaway",
 }
 

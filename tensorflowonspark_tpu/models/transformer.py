@@ -1973,10 +1973,13 @@ class SlotDecoder:
         pool, pc = self.page_pool, self.prefix_cache
         while pool.available() < need:
             if pc is None or not pc.evict_blocks(1):
+                lease_fn = getattr(pool, "lease_table", None)
                 raise RuntimeError(
                     "page pool exhausted: need {0} pages, {1} free and "
-                    "nothing left to evict (pool {2})".format(
-                        need, pool.available(), pool.stats()
+                    "nothing left to evict (pool {2}; {3})".format(
+                        need, pool.available(), pool.stats(),
+                        lease_fn() if lease_fn is not None
+                        else "no lease table",
                     )
                 )
         return pool.alloc(need)

@@ -60,6 +60,7 @@ page serves every slot whose table references it.
 
 import itertools
 import logging
+import time
 
 import numpy as np
 
@@ -90,7 +91,7 @@ class PagePool(object):
     decode writes land in a trash page instead of a live one.
     """
 
-    def __init__(self, num_pages, reserved=1):
+    def __init__(self, num_pages, reserved=1, clock=None):
         if int(num_pages) <= int(reserved):
             raise ValueError(
                 "num_pages ({0}) must exceed the {1} reserved "
@@ -98,6 +99,7 @@ class PagePool(object):
             )
         self.num_pages = int(num_pages)
         self.reserved = int(reserved)
+        self._clock = clock if clock is not None else time.monotonic
         self._refs = np.zeros((self.num_pages,), np.int64)
         # LIFO free list: recently-freed pages are re-handed first
         # (their device lines are the warmest)
@@ -109,6 +111,13 @@ class PagePool(object):
         # in-flight population observable (pool_pages_handoff) and
         # lets tests assert every handoff drains.
         self._handoff = set()
+        # lease id -> {"owner", "pages" (set), "t0", "deadline_sec"}.
+        # A lease names WHO holds a handoff in flight and since when,
+        # so an orphaned handoff (its PrefillWorker died/wedged before
+        # adopt or abandon) is attributable and reclaimable
+        # (:meth:`reap_orphans`) instead of leaking pages forever.
+        self._leases = {}
+        self._lease_seq = itertools.count(1)
 
     def available(self):
         return len(self._free)
@@ -119,7 +128,9 @@ class PagePool(object):
         if n > len(self._free):
             raise PoolExhausted(
                 "page pool exhausted: need {0} pages, {1} free of "
-                "{2}".format(n, len(self._free), self.num_pages)
+                "{2} ({3})".format(
+                    n, len(self._free), self.num_pages, self.lease_table()
+                )
             )
         out = [self._free.pop() for _ in range(n)]
         for p in out:
@@ -147,24 +158,146 @@ class PagePool(object):
     def refcount(self, page):
         return int(self._refs[page])
 
-    def begin_handoff(self, pages):
+    def refcount_census(self):
+        """``{page: refcount}`` over every LIVE page — the balance
+        probe's view.  A quiesced paged decoder (no in-flight slots,
+        no handoffs) must census to exactly its radix cache's
+        committed pages at refcount 1 each, with the reserved trash
+        page(s) never appearing (tests/test_chaos_serving.py property
+        sweep; testing/soak.py probes this continuously)."""
+        return {
+            int(p): int(self._refs[p])
+            for p in np.nonzero(self._refs)[0]
+        }
+
+    def begin_handoff(self, pages, owner=None, deadline_sec=None):
         """Tag ``pages`` as mid-flight between the disaggregated
         prefill and decode programs (the PrefillWorker wrote their KV;
         no slot table references them yet).  The pages must be live —
-        the worker holds the allocating references."""
+        the worker holds the allocating references.
+
+        Returns a lease id.  ``owner`` names the holder (the request
+        id, conventionally) and ``deadline_sec`` bounds how long the
+        handoff may stay in flight before :meth:`reap_orphans` treats
+        it as orphaned; both optional, so pre-lease callers that
+        ignore the return value are unchanged."""
+        pages = [int(p) for p in pages]
         for p in pages:
             if self._refs[p] <= 0:
                 raise ValueError(
-                    "begin_handoff() on free page {0}".format(int(p))
+                    "begin_handoff() on free page {0} ({1})".format(
+                        p, self.lease_table()
+                    )
                 )
-            self._handoff.add(int(p))
+        for p in pages:
+            self._handoff.add(p)
+        lease = next(self._lease_seq)
+        self._leases[lease] = {
+            "owner": owner,
+            "pages": set(pages),
+            "t0": self._clock(),
+            # tfoslint: disable=TFOS004(lease deadline, not request column)
+            "deadline_sec": (
+                None if deadline_sec is None else float(deadline_sec)
+            ),
+        }
+        return lease
 
     def end_handoff(self, pages):
         """Clear the in-flight tag — the decode side adopted the pages
         into a slot's block table (or the handoff was abandoned and
-        the references released)."""
+        the references released).  Leases drain automatically: a lease
+        whose pages all ended is settled and removed."""
+        pages = {int(p) for p in pages}
         for p in pages:
-            self._handoff.discard(int(p))
+            self._handoff.discard(p)
+        for lease in [
+            k for k, rec in self._leases.items() if rec["pages"] & pages
+        ]:
+            rec = self._leases[lease]
+            rec["pages"] -= pages
+            if not rec["pages"]:
+                del self._leases[lease]
+
+    def handoff_leases(self, now=None):
+        """The live lease table as dicts (owner, age_sec, pages,
+        deadline_sec, expired), oldest first — the observable face of
+        the handoff protocol, rendered by :meth:`lease_table` and
+        swept by :meth:`reap_orphans`."""
+        now = self._clock() if now is None else float(now)
+        out = []
+        for lease, rec in sorted(
+            self._leases.items(), key=lambda kv: kv[1]["t0"]
+        ):
+            age = max(0.0, now - rec["t0"])
+            # tfoslint: disable=TFOS004(lease deadline, not request column)
+            dl = rec["deadline_sec"]
+            out.append({
+                "lease": lease,
+                "owner": rec["owner"],
+                "age_sec": age,
+                "pages": len(rec["pages"]),
+                # tfoslint: disable=TFOS004(lease deadline, not request column)
+                "deadline_sec": dl,
+                "expired": dl is not None and age > dl,
+            })
+        return out
+
+    def lease_table(self, now=None):
+        """One-line human summary of live handoff leases, embedded in
+        :class:`PoolExhausted` and handoff-path errors so post-mortems
+        name the owning request instead of a bare count."""
+        rows = self.handoff_leases(now=now)
+        if not rows:
+            return "no handoff leases"
+        return "leases: " + "; ".join(
+            "#{0} owner={1} pages={2} age={3:.1f}s{4}".format(
+                r["lease"], r["owner"] or "?", r["pages"], r["age_sec"],
+                " EXPIRED" if r["expired"] else "",
+            )
+            for r in rows
+        )
+
+    def reap_orphans(self, owner=None, now=None):
+        """Reclaim orphaned handoff leases: with ``owner`` given,
+        every lease held by that owner; otherwise every lease past its
+        deadline.  For each reaped lease the in-flight tag is cleared
+        and exactly one reference per page released — the mirror image
+        of ``PrefillWorker.abandon`` — so refcounts stay balanced:
+        cached-prefix pages were retained once for the handoff and
+        private pages were allocated at refcount 1, and a dead worker
+        can never have handed either reference to a decode slot.
+        Returns the reaped lease summaries (empty when nothing was
+        orphaned)."""
+        now = self._clock() if now is None else float(now)
+        reaped = []
+        for lease in list(self._leases):
+            rec = self._leases[lease]
+            age = max(0.0, now - rec["t0"])
+            # tfoslint: disable=TFOS004(lease deadline, not request column)
+            dl = rec["deadline_sec"]
+            if owner is not None:
+                if rec["owner"] != owner:
+                    continue
+            elif dl is None or age <= dl:
+                continue
+            pages = sorted(rec["pages"])
+            del self._leases[lease]
+            for p in pages:
+                self._handoff.discard(p)
+            self.release(pages)
+            reaped.append({
+                "lease": lease,
+                "owner": rec["owner"],
+                "age_sec": age,
+                "pages": len(pages),
+            })
+            logger.warning(
+                "page pool reaped orphaned handoff lease #%d "
+                "(owner=%s, %d pages, age %.1fs)",
+                lease, rec["owner"], len(pages), age,
+            )
+        return reaped
 
     def stats(self):
         used = self.num_pages - self.reserved - len(self._free)
@@ -180,6 +313,10 @@ class PagePool(object):
             # yet adopted by a decode slot (serving_disagg) — drains
             # to 0 when no handoff is in flight
             "pool_pages_handoff": len(self._handoff),
+            # live handoff leases (serving_disagg); drains with the
+            # handoff set unless a worker orphaned one, in which case
+            # reap_orphans() settles it
+            "pool_leases": len(self._leases),
         }
 
 
@@ -498,6 +635,23 @@ class PrefixCache(object):
         return fingerprint(
             tokens, FINGERPRINT_TOKENS if width is None else width
         )
+
+    def page_census(self):
+        """Sorted payloads of every committed radix block.  Under the
+        paged layout payloads are :class:`PagePool` indices, so this
+        is the set of pool pages the radix holds one reference to —
+        the soak/property-sweep balance probe compares it against
+        :meth:`PagePool.refcount_census` on a quiesced decoder."""
+        out, stack = [], [self._root]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node is not self._root and node.payload is not None:
+                out.append(node.payload)
+        try:
+            return sorted(int(p) for p in out)
+        except (TypeError, ValueError):
+            return out  # contiguous layout: payloads are device arrays
 
     def stats(self):
         return {

@@ -62,6 +62,9 @@ class Actuators(object):
     def rollback_generation(self, replicas=None, **kw):
         raise UnsupportedAction("rollback_generation unbound")
 
+    def restart_prefill(self, replica_id=None, **kw):
+        raise UnsupportedAction("restart_prefill unbound")
+
 
 class FleetActuators(Actuators):
     """Serving-side verbs over a live FleetRouter."""
@@ -147,6 +150,33 @@ class FleetActuators(Actuators):
             )
         return flagged
 
+    def restart_prefill(self, replica_id=None, **kw):
+        """Rebuild the PrefillWorker of every (named) disaggregated
+        replica engine — the remediation response to
+        ``prefill_worker_dead`` / ``prefill_watchdog_fire`` page
+        events.  Idempotent with the engine's own in-line containment
+        (which already rebuilt the worker it fell over on): a rebuild
+        of a healthy worker is cheap (compiled program carries over)
+        and re-arms the watchdog."""
+        restarted = []
+        for r in self.router.replicas:
+            eng = r.engine
+            if getattr(eng, "_prefill_worker", None) is None:
+                continue
+            if replica_id is not None and \
+                    r.replica_id != int(replica_id):
+                continue
+            if not r.alive:
+                continue
+            eng.restart_prefill_worker(reason="remediation")
+            restarted.append(r.replica_id)
+        if not restarted:
+            raise UnsupportedAction(
+                "no live disaggregated replica engine to restart a "
+                "prefill worker on"
+            )
+        return restarted
+
 
 class ClusterActuators(Actuators):
     """Training-side elastic shrink/grow over a TPUCluster (driver
@@ -155,8 +185,15 @@ class ClusterActuators(Actuators):
     width (cluster/supervisor.py); release takes the same path back
     to full width."""
 
-    def __init__(self, cluster):
+    def __init__(self, cluster, release_gate=None):
         self.cluster = cluster
+        #: optional :class:`~tensorflowonspark_tpu.telemetry.health.
+        #: CleanRoundsSensor`: ``elastic_grow`` (releasing a held
+        #: executor back into the gang) requires N consecutive clean
+        #: health rounds, not a timer — the same quality gate as fleet
+        #: re-admission (ROADMAP 3 residual)
+        self.release_gate = release_gate
+        self._gate_blocked = False
 
     def elastic_shrink(self, executor, **kw):
         return self.cluster.hold_executor(
@@ -164,6 +201,33 @@ class ClusterActuators(Actuators):
         )
 
     def elastic_grow(self, executor, **kw):
+        gate = self.release_gate
+        if gate is not None:
+            gate.poll()
+            if not gate.ready():
+                if not self._gate_blocked:
+                    self._gate_blocked = True
+                    from tensorflowonspark_tpu import telemetry
+
+                    telemetry.get_tracer().mark(
+                        "readmit_gated", trace="remediation",
+                        severity="warn", executor=int(executor),
+                        clean_health_rounds=gate.streak,
+                        required_rounds=gate.rounds,
+                    )
+                raise UnsupportedAction(
+                    "elastic_grow gated: health plane has {0}/{1} "
+                    "clean rounds".format(gate.streak, gate.rounds)
+                )
+            if self._gate_blocked:
+                self._gate_blocked = False
+                from tensorflowonspark_tpu import telemetry
+
+                telemetry.get_tracer().mark(
+                    "readmit_cleared", trace="remediation",
+                    executor=int(executor),
+                    clean_health_rounds=gate.streak,
+                )
         return self.cluster.release_executor(executor)
 
 
@@ -212,4 +276,9 @@ class CombinedActuators(Actuators):
     def rollback_generation(self, replicas=None, **kw):
         return self._dispatch(
             "rollback_generation", replicas=replicas, **kw
+        )
+
+    def restart_prefill(self, replica_id=None, **kw):
+        return self._dispatch(
+            "restart_prefill", replica_id=replica_id, **kw
         )

@@ -56,7 +56,8 @@ logger = logging.getLogger(__name__)
 ACTIONS = (
     "elastic_shrink", "elastic_grow", "spawn_replica",
     "retire_replica", "degrade_admission", "restore_admission",
-    "rollback_generation", "probe_replica", "stand_down",
+    "rollback_generation", "probe_replica", "restart_prefill",
+    "stand_down",
 )
 
 
@@ -513,6 +514,16 @@ FAULT_RESPONSES = {
     "swap_rollback": "stand_down",
     "checkpoint_quarantined": "stand_down",
     "deploy_halted": "stand_down",
+    # disaggregated-serving containment (ISSUE 19): the engine's
+    # in-line containment already rebuilt the worker it fell over
+    # on — the remediation restart re-arms supervision fleet-wide
+    # (idempotent); a quarantined replica keeps serving probe
+    # traffic, so lost capacity is restored by spawning; a reaped
+    # lease was fully recovered by the pool (stand down, audited)
+    "prefill_worker_dead": "restart_prefill",
+    "prefill_watchdog_fire": "restart_prefill",
+    "replica_quarantined": "spawn_replica",
+    "lease_reaped": "stand_down",
 }
 
 
@@ -565,6 +576,13 @@ class FaultResponsePolicy(Policy):
                 # multi-death storm restores EVERY death instead of
                 # collapsing into one cooldown-suppressed spawn
                 target = {"lost_replica": rid}
+            if action == "restart_prefill":
+                # fault marks ride the faulted request's trace, not a
+                # replica id — the actuator rebuilds every (or the
+                # named) disaggregated worker; cooldown per fault kind
+                # so a dead worker and a wedged one stay separate
+                # decisions
+                target = {"fault": ev.get("kind")}
             out.append(self._intent(
                 action, target=target, evidence=evid,
                 severity="info" if action == "stand_down" else "warn",

@@ -40,7 +40,24 @@ tests/test_serving_disagg.py.
 import jax
 import jax.numpy as jnp
 
-__all__ = ["Handoff", "PrefillWorker"]
+__all__ = [
+    "Handoff", "PrefillAbandoned", "PrefillWorker", "PrefillWorkerDead",
+]
+
+
+class PrefillWorkerDead(RuntimeError):
+    """The PrefillWorker's program is gone (its device errored or a
+    chaos ``kill_prefill`` fault fired).  The engine contains it:
+    orphaned handoff leases are reaped, the stranded request re-prefills
+    through the unified path, and the worker is rebuilt
+    (``ServingEngine.restart_prefill_worker``)."""
+
+
+class PrefillAbandoned(RuntimeError):
+    """Raised INSIDE a wedged prefill dispatch when it finally wakes
+    and finds its watchdog already abandoned it — the dispatch must not
+    touch the donated cache or its (already reaped) lease pages, so it
+    aborts before the program call instead of racing the recovery."""
 
 
 class Handoff(object):
@@ -51,15 +68,22 @@ class Handoff(object):
     ``cached_tokens`` the radix-cached prefix depth (telemetry),
     ``first`` the sampled first token — an UNRESOLVED device scalar,
     the same async contract as :meth:`SlotDecoder.admit`'s return.
+    ``owner``/``lease`` name the pool handoff lease holding the pages
+    in flight, so handoff-path errors are attributable.
     """
 
-    __slots__ = ("pages", "n_tokens", "cached_tokens", "first")
+    __slots__ = (
+        "pages", "n_tokens", "cached_tokens", "first", "owner", "lease",
+    )
 
-    def __init__(self, pages, n_tokens, cached_tokens, first):
+    def __init__(self, pages, n_tokens, cached_tokens, first,
+                 owner=None, lease=None):
         self.pages = list(pages)
         self.n_tokens = int(n_tokens)
         self.cached_tokens = int(cached_tokens)
         self.first = first
+        self.owner = owner
+        self.lease = lease
 
 
 class PrefillWorker(object):
@@ -78,7 +102,7 @@ class PrefillWorker(object):
     it shards decode.
     """
 
-    def __init__(self, decoder):
+    def __init__(self, decoder, fault_fn=None, lease_deadline_sec=None):
         if not getattr(decoder, "_paged", False):
             raise ValueError(
                 "PrefillWorker needs a paged SlotDecoder "
@@ -96,6 +120,23 @@ class PrefillWorker(object):
         #: suffix prefill IS the only dispatch (cached pages install
         #: as indices, commits record indices)
         self.last_prefill_dispatches = 0
+        #: set by a ``kill_prefill`` chaos fault (or a supervisor that
+        #: observed the worker's device die): every subsequent
+        #: prefill() refuses with :class:`PrefillWorkerDead` until the
+        #: engine rebuilds the worker
+        self.dead = False
+        #: deadline stamped on this worker's handoff leases (the
+        #: engine derives it from its watchdog timeout); None = leases
+        #: only reaped by owner, never by age
+        self.lease_deadline_sec = lease_deadline_sec
+        #: count of prefill() entries — the chaos fault index (same
+        #: role as the engine's chunk index for wedge_dispatch)
+        self._prefills = 0
+        if fault_fn is None:
+            from tensorflowonspark_tpu.testing import chaos
+
+            fault_fn = chaos.prefill_fault_fn()
+        self._fault = fault_fn
         self._jit = jax.jit(self._prefill_impl, donate_argnums=(1,))
 
     def _prefill_impl(self, params, cache, suffix, n, kpref, trow, key):
@@ -115,14 +156,29 @@ class PrefillWorker(object):
         first = dec._sample(row, key)[0]
         return mut["cache"], first
 
-    def prefill(self, prompt):
+    def prefill(self, prompt, owner=None, abandoned_fn=None):
         """Run one prompt's prefill and return its :class:`Handoff`.
 
         Mirrors the unified paged admit's pool/radix protocol exactly
         (same leases, same page refcounts, same commit of the prompt's
         new full blocks) — only the slot-state scatter is missing,
         deferred to the adopting decoder.  All dispatches stay async.
+
+        ``owner`` (the request id, conventionally) is stamped on the
+        pool handoff lease so a fault mid-handoff is attributable and
+        reapable by owner.  ``abandoned_fn`` is the supervised-dispatch
+        escape hatch: a wedged prefill that wakes after its watchdog
+        abandoned it checks the flag and aborts with
+        :class:`PrefillAbandoned` BEFORE drawing an rng key or touching
+        the donated cache — the recovery path already owns both, and
+        the untouched rng stream is what keeps the unified-path
+        re-prefill token-identical to a fault-free run.
         """
+        if self.dead:
+            raise PrefillWorkerDead(
+                "prefill worker is dead; the engine must rebuild it "
+                "(restart_prefill_worker) before serving prefills"
+            )
         dec = self.decoder
         np = dec._np
         prompt = np.asarray(prompt, np.int32).ravel()
@@ -152,19 +208,58 @@ class PrefillWorker(object):
         pool.retain(cached_pages)
         if lease is not None:
             pc.release(lease)
-        private = dec._alloc_pages(
-            dec._blocks_per_slot - len(cached_pages)
-        )
+        try:
+            private = dec._alloc_pages(
+                dec._blocks_per_slot - len(cached_pages)
+            )
+        except Exception:
+            # give back the handoff's cached-prefix references — an
+            # exhausted pool must not also leak the shared pages
+            pool.release(cached_pages)
+            raise
         row = cached_pages + private
-        pool.begin_handoff(row)
+        pool_lease = pool.begin_handoff(
+            row, owner=owner, deadline_sec=self.lease_deadline_sec
+        )
+        self._prefills += 1
+        if self._fault is not None:
+            # chaos gate (kill_prefill / wedge_prefill / leak_lease):
+            # fires with the lease already open and the rng stream and
+            # donated cache still untouched, so a fault here orphans
+            # the lease exactly the way a real mid-handoff death does
+            # — and the reaper + unified re-prefill recover
+            # token-identically
+            self._fault(self._prefills - 1, self)
+        if self.dead:
+            raise PrefillWorkerDead(
+                "prefill worker died mid-handoff (owner={0}, lease "
+                "#{1}, {2} pages in flight)".format(
+                    owner, pool_lease, len(row)
+                )
+            )
+        if abandoned_fn is not None and abandoned_fn():
+            raise PrefillAbandoned(
+                "prefill dispatch abandoned by its watchdog "
+                "(owner={0}, lease #{1})".format(owner, pool_lease)
+            )
         sb = dec._suffix_bucket(n - kpref, kpref)
         suffix = np.zeros((1, sb), np.int32)
         suffix[0, :n - kpref] = prompt[kpref:]
         trow = np.asarray([row], np.int32)
-        dec.cache, first = self._jit(
+        new_cache, first = self._jit(
             dec._params, dec.cache, jnp.asarray(suffix), jnp.int32(n),
             jnp.int32(kpref), jnp.asarray(trow), dec._next_key(),
         )
+        if abandoned_fn is not None and abandoned_fn():
+            # abandoned DURING the program call (a genuinely slow
+            # dispatch, not a pre-jit wedge): the reaper already owns
+            # this lease's pages — never publish the stale cache handle
+            # or commit freed pages into the radix from this thread
+            raise PrefillAbandoned(
+                "prefill dispatch abandoned mid-program "
+                "(owner={0}, lease #{1})".format(owner, pool_lease)
+            )
+        dec.cache = new_cache
         # commit the prompt's NEW full blocks: their pages already
         # hold the KV (the prefill wrote through the table) —
         # recording the indices in the radix IS the commit, zero
@@ -179,7 +274,8 @@ class PrefillWorker(object):
                     dec._page_nbytes, on_insert=committed.append,
                 )
                 pool.retain(committed)
-        return Handoff(row, n, kpref, first)
+        return Handoff(row, n, kpref, first, owner=owner,
+                       lease=pool_lease)
 
     def abandon(self, handoff):
         """Release an un-adopted handoff's pages (admit failed or the

@@ -503,7 +503,9 @@ class ServingEngine(object):
             disaggregate = bool(getattr(predict, "disaggregate", False))
         self.disaggregate = bool(disaggregate)
         if self.disaggregate:
-            from tensorflowonspark_tpu.serving_disagg import PrefillWorker
+            from tensorflowonspark_tpu.serving_disagg import (
+                PrefillWorker, PrefillWorkerDead,
+            )
 
             # memoized on the decoder: the predictor caches its
             # SlotDecoder across engines, and the worker's jit cache
@@ -514,9 +516,44 @@ class ServingEngine(object):
             if worker is None:
                 worker = PrefillWorker(self.decoder)
                 self.decoder._prefill_worker = worker
+            else:
+                # the chaos plan env is read per ENGINE (like wedge_fn
+                # just below), not per memoized worker — a plan
+                # advertised between predict_rows calls must reach the
+                # cached worker.  Only arm an UNARMED worker: its
+                # prefill counter is monotonic across restarts, so
+                # re-resolving an armed hook (fresh spent-set, `>=`
+                # matching) would re-fire every already-spent fault on
+                # the next engine rebuild (quarantine recovery).
+                from tensorflowonspark_tpu.testing import chaos
+
+                if chaos.load_plan() is None:
+                    worker._fault = None
+                elif worker._fault is None:
+                    worker._fault = chaos.prefill_fault_fn()
             self._prefill_worker = worker
+            # the CONTAINED prefill faults (_admit_free falls back to
+            # the unified path): a dead worker, or a supervised
+            # dispatch the watchdog abandoned
+            self._prefill_fault_exc = (WatchdogTimeout, PrefillWorkerDead)
         else:
             self._prefill_worker = None
+            self._prefill_fault_exc = ()
+        if (self._prefill_worker is not None
+                and self.watchdog_timeout is not None):
+            # supervise the prefill dispatch with its own abandonable
+            # watchdog (the PR 4 pattern extended to the prefill side)
+            # and bound how long its handoff leases may stay in
+            # flight: generous vs the dispatch timeout so the serve
+            # loop's deadline reaper only ever fires on leases whose
+            # supervised owner ALSO vanished (e.g. chaos leak_lease)
+            self._prefill_watchdog = _DispatchWatchdog()
+            if self._prefill_worker.lease_deadline_sec is None:
+                self._prefill_worker.lease_deadline_sec = (
+                    4.0 * self.watchdog_timeout
+                )
+        else:
+            self._prefill_watchdog = None
         self.max_new = self.decoder.max_new_tokens
         self.eos_id = self.decoder.eos_id
         self._fill = self.eos_id if self.eos_id is not None else 0
@@ -627,6 +664,14 @@ class ServingEngine(object):
             # authoritative percentile source)
             "disaggregated": self.disaggregate,
             "prefill_wall_sec": 0.0, "ttft_sec": {},
+            # prefill fault containment (docs/fault_tolerance.md
+            # "Disaggregated serving failure modes"): supervised
+            # prefill dispatches abandoned / worker deaths contained /
+            # worker rebuilds, and orphaned handoff leases the pool
+            # reaper reclaimed (by owner after a fault, by deadline
+            # from the serve loop)
+            "prefill_watchdog_fires": 0, "prefill_worker_deaths": 0,
+            "prefill_restarts": 0, "leases_reaped": 0,
         })
         self._reuse_base = dict(self._decoder_reuse_stats())
         # telemetry: metrics resolved ONCE (null singletons when
@@ -1151,27 +1196,50 @@ class ServingEngine(object):
                 # and decode merge into one story per request.
                 t_admit0 = time.perf_counter()
                 if self._prefill_worker is not None:
+                    handoff = None
                     with self._tracer.span("prefill", trace=rid) as sp:
-                        handoff = self._prefill_worker.prefill(prompt)
-                        cached = int(handoff.cached_tokens)
+                        sp.set("disaggregated", True)
+                        try:
+                            handoff = self._prefill_dispatch(
+                                prompt, rid
+                            )
+                        except self._prefill_fault_exc as e:
+                            # contained prefill fault (worker died or
+                            # its dispatch wedged past the watchdog):
+                            # reap the orphaned lease, rebuild the
+                            # worker, and re-prefill through the
+                            # UNIFIED path — inside the same span, so
+                            # the request's original trace id carries
+                            # the whole recovery, and token-identical
+                            # (the faulted prefill never drew an rng
+                            # key or touched the donated cache)
+                            self._contain_prefill_fault(e, rid)
+                            first = self.decoder.admit(slot, prompt)
+                            cached = int(getattr(
+                                self.decoder,
+                                "last_admit_cached_tokens", 0,
+                            ))
+                            sp.set("prefill_recovered", True)
+                        else:
+                            cached = int(handoff.cached_tokens)
                         sp.set("prefix_hit", cached > 0)
                         if cached:
                             sp.set("prefix_tokens", cached)
                             self._m["prefix_hit_admits"].inc()
-                        sp.set("disaggregated", True)
-                    try:
-                        with self._tracer.span("handoff", trace=rid):
-                            first = self.decoder.adopt(slot, handoff)
-                    except Exception:
-                        # the abandon path: an un-adopted handoff must
-                        # never leak its pool pages
-                        self._prefill_worker.abandon(handoff)
-                        raise
-                    # zero-copy invariant: adoption is one state
-                    # scatter, never a KV-copy program
-                    assert int(getattr(
-                        self.decoder, "last_adopt_dispatches", 1
-                    )) == 1, "KV copy dispatched on the handoff path"
+                    if handoff is not None:
+                        try:
+                            with self._tracer.span("handoff", trace=rid):
+                                first = self.decoder.adopt(slot, handoff)
+                        except Exception:
+                            # the abandon path: an un-adopted handoff
+                            # must never leak its pool pages
+                            self._prefill_worker.abandon(handoff)
+                            raise
+                        # zero-copy invariant: adoption is one state
+                        # scatter, never a KV-copy program
+                        assert int(getattr(
+                            self.decoder, "last_adopt_dispatches", 1
+                        )) == 1, "KV copy dispatched on the handoff path"
                 else:
                     with self._tracer.span("prefill", trace=rid) as sp:
                         first = self.decoder.admit(slot, prompt)
@@ -1239,6 +1307,113 @@ class ServingEngine(object):
                     ) + wait
             self._slot_req[slot] = req
         return progressed
+
+    # -- prefill supervision / containment (docs/fault_tolerance.md
+    # "Disaggregated serving failure modes") -------------------------
+
+    def _prefill_dispatch(self, prompt, rid):
+        """Run the disaggregated prefill, supervised by the prefill
+        watchdog when one is armed.  ``rid`` stamps the pool handoff
+        lease owner, so a fault mid-handoff is attributable and the
+        lease reapable by owner.  A wedged dispatch that wakes after
+        abandonment aborts itself (``abandoned_fn``) before touching
+        the rng stream or the donated cache."""
+        worker = self._prefill_worker
+        wd = self._prefill_watchdog
+        if wd is None:
+            return worker.prefill(prompt, owner=rid)
+        return wd.call(
+            lambda: worker.prefill(
+                prompt, owner=rid, abandoned_fn=lambda: wd.abandoned
+            ),
+            self.watchdog_timeout,
+        )
+
+    def _contain_prefill_fault(self, exc, rid):
+        """A prefill died or wedged mid-handoff: reap its orphaned
+        pool lease (refcounts balanced — the lease held exactly one
+        reference per page), journal the fault at page severity (the
+        flight recorder dumps), and rebuild the worker.  The caller
+        re-prefills the stranded request through the unified path
+        under its original trace id."""
+        dead = not isinstance(exc, WatchdogTimeout)
+        kind = (
+            "prefill_worker_dead" if dead else "prefill_watchdog_fire"
+        )
+        if dead:
+            self.stats["prefill_worker_deaths"] += 1
+        else:
+            self.stats["prefill_watchdog_fires"] += 1
+        pool = getattr(self.decoder, "page_pool", None)
+        reaped = []
+        if pool is not None:
+            reaped = pool.reap_orphans(owner=rid)
+            self.stats["leases_reaped"] += len(reaped)
+        pages = sum(r["pages"] for r in reaped)
+        logger.warning(
+            "prefill containment (%s) for request %s: %s — reaped %d "
+            "lease(s) / %d page(s); re-prefilling through the "
+            "unified path", kind, rid, exc, len(reaped), pages,
+        )
+        self._tracer.mark(
+            kind, trace=rid, severity="page", error=str(exc),
+            leases_reaped=len(reaped), pages_reclaimed=pages,
+        )
+        self.restart_prefill_worker(reason=kind)
+
+    def restart_prefill_worker(self, reason="operator"):
+        """Rebuild the PrefillWorker (and its watchdog) in place —
+        the containment path's actuator, also exposed to the
+        remediation engine's ``restart_prefill`` verb.  The compiled
+        prefill program carries over (the fault fired before the
+        dispatch, never inside it: an abandoned thread aborts at the
+        fault gate), as do the chaos fault hook and its fired-entry
+        state, so spent faults don't re-fire on the rebuilt worker."""
+        old = self._prefill_worker
+        if old is None:
+            return None
+        from tensorflowonspark_tpu.serving_disagg import PrefillWorker
+
+        worker = PrefillWorker(
+            self.decoder, fault_fn=old._fault,
+            lease_deadline_sec=old.lease_deadline_sec,
+        )
+        worker._jit = old._jit
+        worker._prefills = old._prefills
+        self.decoder._prefill_worker = worker
+        self._prefill_worker = worker
+        if self.watchdog_timeout is not None:
+            # never reuse a possibly-abandoned watchdog: its wedged
+            # thread may still post a stale result
+            if self._prefill_watchdog is not None:
+                self._prefill_watchdog.close()  # no-op when abandoned
+            self._prefill_watchdog = _DispatchWatchdog()
+        self.stats["prefill_restarts"] += 1
+        self._tracer.mark(
+            "prefill_restart", trace="serve", severity="warn",
+            reason=reason,
+        )
+        return worker
+
+    def _maybe_reap(self):
+        """Deadline sweep of the page pool's handoff leases, once per
+        scheduling pass: a lease past its deadline has an owner that
+        vanished without the supervised path noticing (chaos
+        ``leak_lease``, a crashed caller) — reclaim it and journal at
+        page severity, one ``lease_reaped`` event per lease."""
+        pool = getattr(self.decoder, "page_pool", None)
+        if pool is None:
+            return
+        reap = getattr(pool, "reap_orphans", None)
+        if reap is None:
+            return
+        for r in reap():
+            self.stats["leases_reaped"] += 1
+            self._tracer.mark(
+                "lease_reaped", trace="serve", severity="page",
+                owner=r["owner"], lease=r["lease"], pages=r["pages"],
+                age_sec=round(r["age_sec"], 3),
+            )
 
     # -- decode + recovery ---------------------------------------------
 
@@ -1445,6 +1620,11 @@ class ServingEngine(object):
                 self._watchdog = (
                     _DispatchWatchdog() if value is not None else None
                 )
+                if self._prefill_worker is not None:
+                    self._prefill_watchdog = (
+                        _DispatchWatchdog() if value is not None
+                        else None
+                    )
             applied[name] = {"old": old, "new": value}
         self._tracer.mark(
             "engine_retune", trace="planner", severity="info",
@@ -1764,6 +1944,7 @@ class ServingEngine(object):
                 # chunks, never concurrently with a dispatch
                 self._maybe_swap()
                 self._maybe_retune()
+                self._maybe_reap()
                 self._refill(it)
                 self._expire_pending()
                 if self._draining:
@@ -1832,5 +2013,7 @@ class ServingEngine(object):
                 self._profile.stop()
             if self._watchdog is not None:
                 self._watchdog.close()
+            if self._prefill_watchdog is not None:
+                self._prefill_watchdog.close()
             if self._own_watcher and self.watcher is not None:
                 self.watcher.close()

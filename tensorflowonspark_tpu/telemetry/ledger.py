@@ -178,6 +178,12 @@ class UsageLedger(object):
             sketch_capacity or 2 * self.max_tenants
         )
         self.rows_evicted = 0
+        #: conservation remainder: the resource fields of every row
+        #: that left the bounded table (LRU eviction, or a closed rid
+        #: re-opened fresh) fold in here, so ``sum(rows()) + evicted_
+        #: totals`` stays exact at any traffic volume — the soak
+        #: harness's ledger-exactness probe depends on this
+        self.evicted_totals = _zero_row()
         self.tenants_folded = 0
         self._mirror = {}  # (field, tenant) -> registry Counter
         #: tri-state override: None follows the registry's enabled
@@ -276,10 +282,17 @@ class UsageLedger(object):
         row = dict(_zero_row(), rid=str(rid), tenant=DEFAULT_TENANT,
                    closed=False, latency_sec=0.0, redispatches=0)
         if rid in self._rows:
-            del self._rows[rid]
+            # a closed rid re-opening fresh: its prior incarnation's
+            # charges leave the table — fold them into the remainder
+            # so the conservation law (rows + evicted_totals) holds
+            self._fold_evicted(self._rows.pop(rid))
         self._rows[rid] = row
         self._evict_rows()
         return row
+
+    def _fold_evicted(self, row):
+        for f in FIELDS:
+            self.evicted_totals[f] += row.get(f, 0)
 
     def _evict_rows(self):
         while len(self._rows) > self.max_rows:
@@ -288,7 +301,7 @@ class UsageLedger(object):
             )
             if victim is None:
                 return  # everything open: never drop a live request
-            del self._rows[victim]
+            self._fold_evicted(self._rows.pop(victim))
             self.rows_evicted += 1
 
     def open(self, rid, tenant=None, tokens_in=None, wire_bytes=0,
@@ -444,6 +457,7 @@ class UsageLedger(object):
                 "tenants": {t: dict(v) for t, v in self._tenants.items()},
                 "requests_tracked": len(self._rows),
                 "rows_evicted": self.rows_evicted,
+                "evicted_totals": dict(self.evicted_totals),
                 "tenants_folded": self.tenants_folded,
                 "top": [
                     [k, round(c, 6), round(e, 6)]
@@ -461,6 +475,7 @@ class UsageLedger(object):
             self._tenants.clear()
             self.sketch = SpaceSaving(self.sketch.capacity)
             self.rows_evicted = 0
+            self.evicted_totals = _zero_row()
             self.tenants_folded = 0
             self._mirror.clear()
 

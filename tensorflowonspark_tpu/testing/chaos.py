@@ -204,6 +204,63 @@ class ChaosPlan(object):
         )
         return self
 
+    def kill_prefill(self, at_admit):
+        """Kill the disaggregated PrefillWorker the first time its
+        prefill counter reaches ``at_admit``: the fault surfaces as
+        :class:`~tensorflowonspark_tpu.serving_disagg.
+        PrefillWorkerDead` mid-handoff, with the pool lease already
+        open — what a prefill-side chip death looks like.  The engine
+        must reap the orphaned lease, re-prefill the stranded request
+        through the unified path token-identically, and rebuild the
+        worker (tests/test_chaos_serving.py).  Fires once per entry,
+        in plan order."""
+        self.faults.append(
+            {"kind": "kill_prefill", "at_admit": int(at_admit)}
+        )
+        return self
+
+    def wedge_prefill(self, at_admit, hang_sec=30.0):
+        """Wedge the disaggregated prefill dispatch: the prefill whose
+        counter reaches ``at_admit`` stalls ``hang_sec`` with its pool
+        lease open — a hung prefill program.  The engine's prefill
+        watchdog must abandon it, reap the lease, and recover through
+        the unified path; the wedged thread aborts when it wakes
+        (``PrefillAbandoned``).  Fires once per entry."""
+        self.faults.append(
+            {"kind": "wedge_prefill", "at_admit": int(at_admit),
+             "hang_sec": float(hang_sec)}
+        )
+        return self
+
+    def leak_lease(self, at_admit, deadline_sec=0.5):
+        """Leak a page-pool handoff lease: at prefill ``at_admit`` the
+        worker opens an EXTRA one-page lease (owner
+        ``chaos:leak_lease``, deadline ``deadline_sec``) and drops the
+        handle — a worker that lost track of an in-flight handoff.
+        The engine's deadline reaper must reclaim it
+        (``lease_reaped`` journal event) with refcounts balanced."""
+        self.faults.append(
+            {"kind": "leak_lease", "at_admit": int(at_admit),
+             # tfoslint: disable=TFOS004(lease deadline, not request column)
+             "deadline_sec": float(deadline_sec)}
+        )
+        return self
+
+    def device_error(self, replica_id, at_chunk):
+        """Raise a DEVICE error (:class:`~tensorflowonspark_tpu.fleet.
+        replica.ReplicaDeviceError`) inside replica ``replica_id``'s
+        chunk dispatch at ``at_chunk`` — what an XLA runtime fault on
+        a mesh-sharded engine looks like.  Unlike ``kill_replica``
+        (terminal), the replica QUARANTINES: it posts its wreckage,
+        rebuilds its engine, and serves probe traffic while routed
+        around; the router re-dispatches committed-token-safe onto a
+        survivor (tests/test_fleet.py).  Fires once per entry."""
+        self.faults.append(
+            {"kind": "device_error", "replica_id": int(replica_id),
+             "at_chunk": int(at_chunk)}
+        )
+        return self
+
     @classmethod
     def combined(cls, slow_executor=None, kill_leader=None,
                  kill_replica=None, corrupt_checkpoint=None):
@@ -489,6 +546,87 @@ def serving_wedge_fn():
     return maybe_wedge
 
 
+def prefill_fault_fn():
+    """Build the :class:`PrefillWorker` fault hook from the plan, or
+    None when no plan orders prefill faults (the common case — one
+    None check of production overhead, like the other plan hooks).
+
+    Returns ``fault(prefill_index, worker)``, called once per
+    prefill with the handoff lease already open and the rng stream
+    untouched (the containment point the faults exist to probe):
+
+    - ``leak_lease`` opens an extra one-page lease with owner
+      ``chaos:leak_lease`` and the plan's deadline, drops the handle,
+      and lets the prefill continue;
+    - ``wedge_prefill`` sleeps ``hang_sec`` (the prefill watchdog's
+      territory);
+    - ``kill_prefill`` marks the worker dead and raises
+      :class:`~tensorflowonspark_tpu.serving_disagg.
+      PrefillWorkerDead`.
+
+    Each entry fires once, in plan order; leak runs before wedge
+    before kill when several are due at the same index."""
+    plan = load_plan()
+    if plan is None:
+        return None
+    kills = [f for f in plan.faults if f["kind"] == "kill_prefill"]
+    wedges = [f for f in plan.faults if f["kind"] == "wedge_prefill"]
+    leaks = [f for f in plan.faults if f["kind"] == "leak_lease"]
+    if not kills and not wedges and not leaks:
+        return None
+    import time as _time
+
+    spent = set()
+
+    def fault(prefill_index, worker):
+        for i, f in enumerate(leaks):
+            if ("leak", i) not in spent and \
+                    prefill_index >= f["at_admit"]:
+                spent.add(("leak", i))
+                pool = worker.decoder.page_pool
+                page = worker.decoder._alloc_pages(1)
+                pool.begin_handoff(
+                    page, owner="chaos:leak_lease",
+                    # tfoslint: disable=TFOS004(lease deadline, not request column)
+                    deadline_sec=f["deadline_sec"],
+                )
+                logger.warning(
+                    "chaos: leaked handoff lease (page %s, deadline "
+                    "%.2fs) at prefill %d per plan",
+                    # tfoslint: disable=TFOS004(lease deadline, not request column)
+                    page, f["deadline_sec"], prefill_index,
+                )
+        for i, f in enumerate(wedges):
+            if ("wedge", i) not in spent and \
+                    prefill_index >= f["at_admit"]:
+                spent.add(("wedge", i))
+                logger.warning(
+                    "chaos: wedging prefill dispatch at prefill %d "
+                    "for %.1fs per plan", prefill_index, f["hang_sec"],
+                )
+                _time.sleep(f["hang_sec"])
+        for i, f in enumerate(kills):
+            if ("kill", i) not in spent and \
+                    prefill_index >= f["at_admit"]:
+                spent.add(("kill", i))
+                from tensorflowonspark_tpu.serving_disagg import (
+                    PrefillWorkerDead,
+                )
+
+                worker.dead = True
+                logger.warning(
+                    "chaos: killing prefill worker at prefill %d "
+                    "per plan", prefill_index,
+                )
+                raise PrefillWorkerDead(
+                    "chaos kill_prefill at prefill {0}".format(
+                        prefill_index
+                    )
+                )
+
+    return fault
+
+
 def replica_fault_fn(replica_id):
     """Build the fleet replica's chunk-dispatch fault hook from the
     plan, or None when no ``kill_replica`` / ``slow_replica`` fault
@@ -499,8 +637,10 @@ def replica_fault_fn(replica_id):
     ``wedge_fn`` (it runs right before every chunk dispatch): a due
     ``kill_replica`` raises
     :class:`~tensorflowonspark_tpu.fleet.replica.ReplicaKilled` (each
-    entry fires once, in plan order); a ``slow_replica`` sleeps
-    ``per_chunk_sec`` while its chunk budget lasts."""
+    entry fires once, in plan order); a ``device_error`` raises
+    :class:`~tensorflowonspark_tpu.fleet.replica.ReplicaDeviceError`
+    (the replica quarantines instead of dying); a ``slow_replica``
+    sleeps ``per_chunk_sec`` while its chunk budget lasts."""
     plan = load_plan()
     if plan is None:
         return None
@@ -509,11 +649,15 @@ def replica_fault_fn(replica_id):
         f for f in plan.faults
         if f["kind"] == "kill_replica" and f["replica_id"] == rid
     ]
+    devs = [
+        f for f in plan.faults
+        if f["kind"] == "device_error" and f["replica_id"] == rid
+    ]
     slows = [
         f for f in plan.faults
         if f["kind"] == "slow_replica" and f["replica_id"] == rid
     ]
-    if not kills and not slows:
+    if not kills and not devs and not slows:
         return None
     import time as _time
 
@@ -536,6 +680,21 @@ def replica_fault_fn(replica_id):
                     "chaos kill_replica {0} at chunk {1}".format(
                         rid, chunk_index
                     )
+                )
+        for i, f in enumerate(devs):
+            if ("dev", i) not in spent and chunk_index >= f["at_chunk"]:
+                spent.add(("dev", i))
+                from tensorflowonspark_tpu.fleet.replica import (
+                    ReplicaDeviceError,
+                )
+
+                logger.warning(
+                    "chaos: device error on serving replica %d at "
+                    "chunk %d per plan", rid, chunk_index,
+                )
+                raise ReplicaDeviceError(
+                    "chaos device_error on replica {0} at chunk "
+                    "{1}".format(rid, chunk_index)
                 )
         for f in slows:
             if f["chunks"] and slowed["chunks"] >= f["chunks"]:

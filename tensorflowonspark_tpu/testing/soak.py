@@ -1,0 +1,802 @@
+"""All-faults soak harness: one live stack, every chaos family,
+continuous invariants.
+
+The point of the fault-containment work (lease reaping, prefill
+supervision, replica quarantine, remediation verbs) is that the FLEET
+keeps its books balanced no matter which fault lands or when.  A
+single-fault test proves one containment path; this harness proves the
+conjunction: a :class:`SoakRunner` drives ONE live serving fleet
+(including one disaggregated prefill/decode engine) — and, in full
+mode, a live hier-training cluster with its health plane and
+remediation engine — through a SEEDED schedule covering every chaos
+family at once, while probing invariants between every load wave:
+
+- **pool balance** — after each wave quiesces, every paged replica's
+  :class:`~tensorflowonspark_tpu.prefix_cache.PagePool` refcount
+  census equals exactly its radix cache's committed pages at one
+  reference each, no handoff pages or leases in flight, the reserved
+  trash page untouched (pages provably never leak, whatever died);
+- **ledger exactness** — the usage ledger's per-request ``chip_sec``
+  rows (plus its ``evicted_totals`` remainder, once traffic outgrows
+  the bounded row table) sum to the fleet's measured decode wall to
+  1e-6 relative, ACROSS kills, quarantine rebuilds, re-dispatches
+  and row eviction;
+- **zero silent drops** — every submitted request comes back exactly
+  once, as either tokens or a named error record (poison rows must
+  surface as error records naming their request, never vanish);
+- **forensics naming** — every injected journal-visible fault family
+  is named by ``forensics explain`` while its evidence is live
+  (sampled each wave — the journal's bounded severity rings evict
+  minute-one evidence before a long run ends), checked against the
+  chaos-plan vocabulary (testing/chaos.py), so the soak's story is
+  reconstructible from the black box alone.
+
+Fault families (testing/chaos.py): ``wedge_dispatch``,
+``kill_prefill``, ``wedge_prefill``, ``leak_lease``, ``kill_replica``,
+``device_error`` and ``poison_rows`` on the serving plane;
+``slow_executor``, ``kill_executor`` (chaos ``kill``), ``kill_leader``
+and ``corrupt_checkpoint`` on the training plane (full mode only —
+they need the live cluster).
+
+CLI::
+
+    python -m tensorflowonspark_tpu.testing.soak --minutes 5 --seed 7
+    python -m tensorflowonspark_tpu.testing.soak --fast  # serving-only
+
+``--fast`` skips the training cluster (the tier-1 CI lane: seeded,
+deterministic schedule, well under a minute); the full run is the
+acceptance soak (CI runs it behind ``-m slow`` via
+tests/test_chaos_serving.py).  The JSON report lands at ``--report``
+(default ``soak_report.json``) and is the CI artifact.
+"""
+
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: serving-plane families every soak injects (fast + full)
+SERVING_FAMILIES = (
+    "wedge_dispatch", "kill_prefill", "wedge_prefill", "leak_lease",
+    "kill_replica", "device_error", "poison_rows",
+)
+
+#: training-plane families the FULL soak adds (they need the live
+#: cluster + health plane + remediation engine)
+TRAINING_FAMILIES = (
+    "slow_executor", "kill", "kill_leader", "corrupt_checkpoint",
+)
+
+#: the tiny real transformer the soak serves (compiles in seconds on
+#: CPU; the containment machinery under test is model-size-agnostic)
+MODEL = {
+    "vocab_size": 64, "num_layers": 2, "num_heads": 2, "head_dim": 8,
+    "embed_dim": 16, "mlp_dim": 32, "max_seq_len": 128,
+    "dtype": "float32",
+}
+PAGED = {"kv_layout": "paged", "prefix_cache": True, "prefix_block": 8}
+
+
+class InvariantViolation(AssertionError):
+    """A soak invariant probe failed — the report names which, when,
+    and with what evidence."""
+
+
+def pool_balance_probe(decoder, grace_sec=5.0, clock=None):
+    """Assert ``decoder``'s page pool has settled back to exactly its
+    radix cache's committed pages: no handoff pages or leases in
+    flight, refcount census == radix census at one reference per page,
+    reserved trash page(s) unreferenced.  Polls up to ``grace_sec``
+    (slot releases lag the last emit by a scheduling pass).  Returns
+    the settled census dict; raises :class:`InvariantViolation`."""
+    clock = clock or time.monotonic
+    pool = getattr(decoder, "page_pool", None)
+    pc = getattr(decoder, "prefix_cache", None)
+    if pool is None:
+        return {"skipped": "not a paged decoder"}
+    deadline = clock() + grace_sec
+    last = None
+    while True:
+        stats = pool.stats()
+        census = pool.refcount_census()
+        radix = pc.page_census() if pc is not None else []
+        want = {int(p): 1 for p in radix}
+        trash = [p for p in census if p < pool.reserved]
+        ok = (
+            stats["pool_pages_handoff"] == 0
+            and stats["pool_leases"] == 0
+            and not trash
+            and census == want
+        )
+        last = {
+            "stats": stats, "refcounts": len(census),
+            "radix_pages": len(radix), "trash_referenced": trash,
+            "balanced": ok,
+        }
+        if ok:
+            return last
+        if clock() >= deadline:
+            raise InvariantViolation(
+                "page pool never rebalanced within {0:.1f}s: {1} "
+                "(census {2} vs radix {3}; {4})".format(
+                    grace_sec, stats, census, want, pool.lease_table()
+                )
+            )
+        time.sleep(0.05)
+
+
+def ledger_probe(router, ledger, rel=1e-6):
+    """Assert the ledger's ``chip_sec`` rows sum to the fleet's decode
+    wall — the cost-attribution exactness that must survive every
+    kill/quarantine/re-dispatch (docs/observability.md).  The row
+    table is BOUNDED (closed rows LRU-evict past ``max_rows``), so the
+    conserved quantity is rows + the ledger's ``evicted_totals``
+    remainder — a long soak pushes thousands of requests through a
+    4096-row table and the law must keep holding."""
+    chip = sum(r["chip_sec"] for r in ledger.rows())
+    chip += ledger.evicted_totals["chip_sec"]
+    wall = float(router.stats["decode_wall_sec"])
+    if wall == 0.0 and chip == 0.0:
+        return {"chip_sec": chip, "decode_wall_sec": wall}
+    if abs(chip - wall) > rel * max(abs(chip), abs(wall)):
+        raise InvariantViolation(
+            "ledger chip-seconds ({0!r}) != fleet decode wall "
+            "({1!r})".format(chip, wall)
+        )
+    return {"chip_sec": chip, "decode_wall_sec": wall}
+
+
+class SoakRunner(object):
+    """Drive the all-faults soak (module docstring).  ``run()``
+    returns the JSON-able report and raises
+    :class:`InvariantViolation` on the first broken invariant.
+
+    Args:
+      minutes: wall-clock load budget (waves stop at the deadline;
+        every scheduled fault fires regardless because in-band
+        triggers are index-based).
+      seed: seeds the fault schedule, the prompts and the poison
+        placement — same seed, same soak.
+      include_training: full mode (live cluster + health plane +
+        remediation + training faults); False is the fast serving-only
+        lane.
+      replicas: fleet width (>= 3 in full chaos so a kill plus a
+        quarantine still leave a live replica).
+      report_path: where ``run()`` writes the JSON report (None skips
+        the write; the dict is returned either way).
+    """
+
+    def __init__(self, minutes=5.0, seed=0, include_training=True,
+                 replicas=3, report_path=None, workdir=None):
+        self.minutes = float(minutes)
+        self.seed = int(seed)
+        self.include_training = bool(include_training)
+        self.replicas = max(2, int(replicas))
+        self.report_path = report_path
+        self.workdir = workdir or tempfile.mkdtemp(prefix="tfos_soak_")
+        self.rng = np.random.RandomState(self.seed)
+        #: run-long union of journal-visible fault families (sampled
+        #: every wave — the bounded journal rings evict early
+        #: evidence long before a 5-minute run ends)
+        self._families_seen = set()
+        self.report = {
+            "seed": self.seed, "minutes": self.minutes,
+            "mode": "full" if include_training else "serving_only",
+            "families": list(SERVING_FAMILIES) + (
+                list(TRAINING_FAMILIES) if include_training else []
+            ),
+            "faults": [], "waves": [], "invariants": {}, "passed": False,
+        }
+
+    # -- schedule -------------------------------------------------------
+
+    def _serving_plan(self):
+        """The seeded in-band serving fault schedule as ONE chaos plan
+        (index-triggered: the counters are cumulative across the soak,
+        so each fault lands in an early wave and later waves prove
+        recovery held).  ``kill_replica`` targets the LAST replica and
+        ``device_error`` the disaggregated replica 0, so the fleet
+        always keeps a live survivor."""
+        from tensorflowonspark_tpu.testing import chaos
+
+        r = self.rng
+        plan = chaos.ChaosPlan()
+        plan.kill_prefill(at_admit=int(r.randint(1, 4)))
+        plan.wedge_prefill(at_admit=int(r.randint(5, 8)), hang_sec=3.0)
+        plan.leak_lease(at_admit=int(r.randint(9, 12)),
+                        deadline_sec=0.3)
+        plan.wedge_dispatch(at_chunk=int(r.randint(2, 6)), hang_sec=3.0)
+        plan.device_error(0, at_chunk=int(r.randint(2, 8)))
+        plan.kill_replica(self.replicas - 1,
+                          at_chunk=int(r.randint(4, 10)))
+        for f in plan.faults:
+            self.report["faults"].append(dict(f, plane="serving"))
+        return plan
+
+    def _training_spec(self):
+        """Seeded training-plane schedule: the in-band faults ride the
+        cluster plan env; ``kill_leader`` / ``corrupt_checkpoint``
+        fire driver-side at their offsets (the remediation acceptance
+        e2e's protocol — tests/test_remediation.py)."""
+        r = self.rng
+        spec = {
+            "slow_executor": {
+                "executor_id": 1,
+                "per_batch_sec": 0.06, "batches": 40,
+            },
+            "kill": {
+                "executor_id": 1, "at_step": int(r.randint(30, 60)),
+            },
+            "kill_leader": {
+                "at_window": 3,
+                "at_sec": float(r.uniform(3.0, 6.0)),
+            },
+            "corrupt_checkpoint": {
+                "corrupt_kind": "bad_manifest",
+                "at_sec": float(r.uniform(6.0, 10.0)),
+            },
+        }
+        for kind, f in spec.items():
+            self.report["faults"].append(
+                dict(f, kind=kind, plane="training")
+            )
+        return spec
+
+    # -- stack ----------------------------------------------------------
+
+    def _build_fleet(self, plan_path, readmit_gate=None):
+        """The live fleet: replica 0 disaggregated (paged + prefix +
+        PrefillWorker), the rest unified paged engines over the same
+        weights — mixed on purpose, both shapes must stay
+        token-identical through the storm.  Warms every compiled
+        program, then advertises ``plan_path`` so the chaos hooks arm
+        exactly when the replica engines construct."""
+        import jax
+        import jax.numpy as jnp
+
+        from tensorflowonspark_tpu.fleet.router import FleetRouter
+        from tensorflowonspark_tpu.models import transformer as tr
+
+        model = tr.Transformer(tr.TransformerConfig(**MODEL))
+        params = jax.tree.map(np.asarray, model.init(
+            jax.random.PRNGKey(self.seed),
+            jnp.zeros((1, 8), jnp.int32),
+        )["params"])
+        base = dict(MODEL, mode="generate", max_new_tokens=6,
+                    pad_multiple=16, chunk_size=2, **PAGED)
+        predicts = [tr.serving_builder(
+            params, dict(base, disaggregate=True)
+        )]
+        for _ in range(self.replicas - 1):
+            predicts.append(tr.serving_builder(params, dict(base)))
+
+        def factory():
+            # inexhaustible: remediation spawn_replica builds spares
+            if predicts:
+                return predicts.pop(0)
+            return tr.serving_builder(params, dict(base))
+
+        # warm every compiled program BEFORE the watchdogs go live
+        # (repo convention — a watchdog timeout assumes compiled
+        # programs; a cold compile would fire it spuriously).  The
+        # chaos plan env is not yet advertised, so nothing faults.
+        from tensorflowonspark_tpu import serving as _serving
+
+        warm = [
+            {"prompt": np.arange(1, 9, dtype=np.int32)},
+            {"prompt": np.arange(1, 21, dtype=np.int32)},
+        ]
+        from tensorflowonspark_tpu.testing import chaos as _chaos
+
+        os.environ.pop(_chaos.TFOS_CHAOS_PLAN, None)
+        for p in predicts:
+            list(_serving.predict_rows(
+                p, [dict(r) for r in warm], {"prompt": "tokens"},
+                batch_size=2, schedule="continuous",
+            ))
+        os.environ[_chaos.TFOS_CHAOS_PLAN] = plan_path
+        router = FleetRouter(
+            None, {"prompt": "tokens"}, replicas=self.replicas,
+            num_slots=2, predict_factory=factory, on_error="record",
+            poll_sec=0.01, probe_every=4, readmit_rounds=2,
+            readmit_gate=readmit_gate,
+            engine_opts={"watchdog_timeout": 2.0},
+        )
+        return router
+
+    def _build_cluster(self, plan_path):
+        """Full mode's training side: a 2-executor LocalEngine cluster
+        running the telemetry-publishing feed loop, with the health
+        plane scraping and the remediation engine closing the loop
+        over BOTH planes."""
+        from tensorflowonspark_tpu.cluster import cluster as tpu_cluster
+        from tensorflowonspark_tpu.cluster.cluster import InputMode
+        from tensorflowonspark_tpu.engine import LocalEngine
+        from tensorflowonspark_tpu.remediation import Guardrails
+
+        env = {
+            "TFOS_CHAOS_PLAN": plan_path,
+            "TFOS_TELEMETRY": "1",
+            "TFOS_TELEMETRY_PUBLISH_INTERVAL": "0.2",
+        }
+        engine = LocalEngine(2, env=env, deterministic=True)
+        cluster = tpu_cluster.run(
+            engine, _soak_train_fn, args={}, num_executors=2,
+            input_mode=InputMode.SPARK, elastic=True,
+            heartbeat_interval=0.5, max_restarts=2,
+        )
+        plane = cluster.start_health_plane(
+            interval=0.5,
+            straggler_opts={
+                "window": 20.0, "min_samples": 5, "ratio": 2.0,
+            },
+        )
+        return engine, cluster, plane, Guardrails(
+            cooldown_sec=30.0, budget=25
+        )
+
+    # -- load -----------------------------------------------------------
+
+    def _wave_rows(self, wave):
+        """One wave's request mix: shared prefix heads (radix traffic)
+        plus fresh tails, with poison rows injected at seeded waves."""
+        from tensorflowonspark_tpu.testing import chaos
+
+        r = self.rng
+        if not hasattr(self, "_heads"):
+            self._heads = [
+                r.randint(1, 64, (16,)).astype(np.int32)
+                for _ in range(3)
+            ]
+        rows, poisons = [], []
+        for i in range(8):
+            if i % 3 == 0:
+                head = self._heads[i % len(self._heads)]
+                tail = r.randint(1, 64, (int(r.randint(2, 6)),))
+                rows.append({"prompt": np.concatenate(
+                    [head, tail]
+                ).astype(np.int32)})
+            else:
+                rows.append({"prompt": r.randint(
+                    1, 64, (int(r.randint(4, 20)),)
+                ).astype(np.int32)})
+        if wave in self._poison_waves:
+            kind = self._poison_waves[wave]
+            pos = int(r.randint(0, len(rows)))
+            rows.insert(pos, chaos.poison_row(kind))
+            poisons.append({"wave": wave, "kind": kind, "pos": pos})
+            self.report["faults"].append(
+                {"kind": "poison_rows", "poison_kind": kind,
+                 "wave": wave, "plane": "serving"}
+            )
+        return rows, poisons
+
+    def _snapshot_named_families(self, extra_events=None):
+        """Fold the fault families currently visible in the journal
+        into the run-long accumulator.  The journal's severity rings
+        are BOUNDED: a straggler flagged in minute one is evicted by
+        minute four's serving-fault traffic, so the naming invariant
+        must be sampled while the evidence is live, not only at the
+        end."""
+        from tensorflowonspark_tpu import forensics
+        from tensorflowonspark_tpu.telemetry import journal as jm
+
+        for e in jm.get_journal().events():
+            fam = forensics.FAULT_MAP.get(e.kind)
+            if fam is not None:
+                self._families_seen.add(fam)
+        for e in extra_events or []:
+            fam = forensics.FAULT_MAP.get(e.get("kind"))
+            if fam is not None:
+                self._families_seen.add(fam)
+
+    def _probe(self, router, ledger, wave, accounted):
+        self._snapshot_named_families()
+        inv = {}
+        for rep in router.replicas:
+            if not (rep.alive and rep.state in ("live",
+                                                "routed_around")):
+                # a dead replica's pool is wreckage (its device memory
+                # dies with the process in reality) — the leak
+                # invariant audits serviceable pools
+                continue
+            dec = getattr(rep.engine, "decoder", None)
+            if dec is None or not getattr(dec, "_paged", False):
+                continue
+            inv["pool_balance_r{0}".format(rep.replica_id)] = (
+                pool_balance_probe(dec)
+            )
+        inv["ledger"] = ledger_probe(router, ledger)
+        inv["accounting"] = dict(accounted)
+        if accounted["returned"] != accounted["submitted"]:
+            raise InvariantViolation(
+                "dropped requests: submitted {0}, returned {1} "
+                "(wave {2})".format(
+                    accounted["submitted"], accounted["returned"], wave
+                )
+            )
+        if accounted["errors"] != accounted["poisoned"]:
+            raise InvariantViolation(
+                "error records ({0}) != injected poison rows ({1}) — "
+                "a healthy request errored or a poison vanished "
+                "(wave {2})".format(
+                    accounted["errors"], accounted["poisoned"], wave
+                )
+            )
+        return inv
+
+    def _forensics_probe(self, extra_events=None):
+        """``explain`` over the journal must name every journal-
+        visible injected family in the chaos vocabulary.  Poison rows
+        are accounted by the error-record invariant instead (they are
+        per-request records, not fleet incidents).  Families sampled
+        live during the run (:meth:`_snapshot_named_families`) count:
+        the journal's bounded rings legitimately evict minute-one
+        evidence by minute five — the invariant is that every family
+        WAS named while its evidence was live, not that a bounded
+        ring retains everything forever."""
+        from tensorflowonspark_tpu import forensics
+        from tensorflowonspark_tpu.telemetry import journal as jm
+
+        events = [e.to_dict() for e in jm.get_journal().events()]
+        for e in extra_events or []:
+            if e not in events:
+                events.append(e)
+        export = os.path.join(self.workdir, "journal_export.json")
+        with open(export, "w") as f:
+            json.dump({"events": events}, f)
+        report = forensics.explain([export])
+        named = {
+            forensics.FAULT_MAP[ev["kind"]]
+            for ev in report["timeline"]
+            if ev["kind"] in forensics.FAULT_MAP
+        }
+        named |= self._families_seen
+        want = {
+            f["kind"] for f in self.report["faults"]
+            if f["kind"] not in ("poison_rows", "leak_lease")
+        }
+        # leak_lease is named via its reaping (lease_reaped)
+        if any(f["kind"] == "leak_lease" for f in self.report["faults"]):
+            want.add("leak_lease")
+        missing = want - named
+        if missing:
+            raise InvariantViolation(
+                "forensics explain failed to name injected fault "
+                "families {0} (named: {1})".format(
+                    sorted(missing), sorted(named)
+                )
+            )
+        return {"named": sorted(named), "report_window_sec":
+                report.get("window_sec")}
+
+    # -- run ------------------------------------------------------------
+
+    def _serving_faults_fired(self, router):
+        """Have all in-band serving faults landed?  (The index-based
+        triggers need enough traffic to reach their counters; waves
+        keep flowing past the time budget until they do.)"""
+        from tensorflowonspark_tpu.telemetry import journal as jm
+
+        def eng_sum(key):
+            return sum(
+                int(r.engine.stats.get(key, 0))
+                for r in router.replicas
+            )
+
+        return (
+            eng_sum("prefill_worker_deaths") >= 1
+            and eng_sum("prefill_watchdog_fires") >= 1
+            and eng_sum("watchdog_fires") >= 1
+            and len(jm.get_journal().events(kind="lease_reaped")) >= 1
+            and router.stats.get("quarantined", 0) >= 1
+            and router.stats.get("replica_deaths", 0) >= 1
+        )
+
+    def run(self):
+        import threading
+
+        from tensorflowonspark_tpu.telemetry import ledger as ledger_mod
+        from tensorflowonspark_tpu.testing import chaos
+
+        t_start = time.monotonic()
+        ledger = ledger_mod.get_ledger()
+        ledger.enabled_override = True
+
+        serving_plan = self._serving_plan()
+        self._poison_waves = {1: "bad_dtype", 3: "missing_key"}
+
+        training = None
+        gate = None
+        storm = None
+        trainer = None
+        train_err = {}
+        router = None
+        remediation = None
+        if self.include_training:
+            spec = self._training_spec()
+            full_plan = chaos.ChaosPlan.combined(
+                slow_executor=spec["slow_executor"],
+                kill_leader=spec["kill_leader"],
+                corrupt_checkpoint=spec["corrupt_checkpoint"],
+            )
+            full_plan.faults.append(dict(spec["kill"], kind="kill"))
+            cluster_plan_path = full_plan.save(
+                os.path.join(self.workdir, "train_plan.json")
+            )
+            training = self._build_cluster(cluster_plan_path)
+            engine, cluster, plane, guards = training
+            from tensorflowonspark_tpu.telemetry.health import (
+                CleanRoundsSensor,
+            )
+
+            gate = CleanRoundsSensor(plane, rounds=2)
+
+        serving_plan_path = serving_plan.save(
+            os.path.join(self.workdir, "serving_plan.json")
+        )
+        try:
+            router = self._build_fleet(serving_plan_path,
+                                       readmit_gate=gate)
+            if training is not None:
+                engine, cluster, plane, guards = training
+                remediation = cluster.start_remediation(
+                    router=router, interval=0.25, guardrails=guards,
+                    straggler={"sustain": 2, "grow_after": 9999},
+                    autoscale=None, page=None, slo_rollback=None,
+                )
+                storm = self._start_training_storm(cluster, spec)
+
+                def _train():
+                    try:
+                        parts = [
+                            [float(i) for i in range(80)]
+                            for _ in range(8)
+                        ]
+                        cluster.train(
+                            parts, num_epochs=2, feed_timeout=120
+                        )
+                    except Exception as e:  # noqa: BLE001
+                        train_err["exc"] = e
+
+                trainer = threading.Thread(target=_train, daemon=True)
+                trainer.start()
+            # the exactness probe compares ledger rows against the
+            # ROUTER's decode wall: zero the ledger only now, after
+            # the warmup traffic (which ran outside the router)
+            ledger.reset()
+            deadline = t_start + self.minutes * 60.0
+            hard_cap = deadline + 120.0
+            wave = 0
+            while True:
+                rows, poisons = self._wave_rows(wave)
+                out = list(router.serve([dict(r) for r in rows]))
+                accounted = {
+                    "submitted": len(rows),
+                    "returned": len(out),
+                    "errors": sum(1 for r in out if "error" in r),
+                    "poisoned": len(poisons),
+                }
+                inv = self._probe(router, ledger, wave, accounted)
+                self.report["waves"].append({
+                    "wave": wave, "accounting": accounted,
+                    "t_sec": round(time.monotonic() - t_start, 3),
+                })
+                self.report["invariants"] = inv
+                wave += 1
+                now = time.monotonic()
+                fired = self._serving_faults_fired(router)
+                if wave >= 5 and fired and now >= deadline:
+                    break
+                if now >= hard_cap:
+                    # the forensics probe below fails loudly on any
+                    # fault the load never reached
+                    logger.warning(
+                        "soak hard cap reached with faults unfired"
+                    )
+                    break
+            if training is not None:
+                engine, cluster, plane, guards = training
+                if trainer is not None:
+                    trainer.join(timeout=180)
+                if storm is not None:
+                    storm.join(timeout=60)
+                if "exc" in train_err:
+                    raise train_err["exc"]
+                self._await_remediation(remediation)
+                extra = cluster.journal()["events"]
+            else:
+                extra = None
+            self.report["router_stats"] = {
+                k: v for k, v in router.stats.items()
+                if isinstance(v, (int, float, str))
+            }
+            self.report["invariants"]["forensics"] = (
+                self._forensics_probe(extra_events=extra)
+            )
+            self.report["passed"] = True
+            self.report["wall_sec"] = round(
+                time.monotonic() - t_start, 3
+            )
+            return self.report
+        finally:
+            os.environ.pop(chaos.TFOS_CHAOS_PLAN, None)
+            if router is not None:
+                try:
+                    router.close()
+                except Exception:
+                    logger.exception("router close failed")
+            if training is not None:
+                engine, cluster, plane, guards = training
+                try:
+                    cluster.shutdown(grace_secs=1, timeout=60)
+                except Exception:
+                    logger.exception("cluster shutdown failed")
+                engine.stop()
+            ledger.enabled_override = None
+            if self.report_path:
+                with open(self.report_path, "w") as f:
+                    json.dump(self.report, f, indent=2, default=str)
+                logger.info("soak report written to %s",
+                            self.report_path)
+
+    def _start_training_storm(self, cluster, spec):
+        """Driver-side timed faults (the e2e protocol): the leader-
+        death SIGNAL at its offset, and a REAL corrupted export pushed
+        through the CheckpointWatcher validation pipeline."""
+        import threading
+
+        from tensorflowonspark_tpu import hot_swap, telemetry
+        from tensorflowonspark_tpu.testing import chaos
+
+        t0 = time.monotonic()
+        sched = sorted(
+            (s["at_sec"], k) for k, s in spec.items()
+            if "at_sec" in s
+        )
+
+        def _storm():
+            for at_sec, kind in sched:
+                delay = t0 + at_sec - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                if kind == "kill_leader":
+                    telemetry.get_tracer().mark(
+                        "leader_failover", trace="hier",
+                        severity="page",
+                        window=spec["kill_leader"]["at_window"],
+                        injected=True,
+                    )
+                elif kind == "corrupt_checkpoint":
+                    root = os.path.join(self.workdir, "exports")
+                    step_dir = os.path.join(root, "7")
+                    os.makedirs(step_dir, exist_ok=True)
+                    with open(os.path.join(
+                        step_dir, "manifest.json"
+                    ), "w") as f:
+                        f.write('{"complete": true}')
+                    chaos.corrupt_checkpoint(
+                        step_dir,
+                        spec["corrupt_checkpoint"]["corrupt_kind"],
+                    )
+                    hot_swap.CheckpointWatcher(
+                        root, background=False
+                    ).poll()
+
+        t = threading.Thread(target=_storm, daemon=True)
+        t.start()
+        return t
+
+    def _await_remediation(self, remediation, timeout=30.0):
+        """Give the policy engine its grace window to land the
+        decisions the storm forces, then record them."""
+        deadline = time.monotonic() + timeout
+        want = {"elastic_shrink"}
+        while time.monotonic() < deadline:
+            executed = {
+                d["action"] for d in remediation.decisions
+                if d["executed"]
+            }
+            if want <= executed:
+                break
+            time.sleep(0.25)
+        self.report["remediation_decisions"] = [
+            {"action": d["action"], "policy": d["policy"],
+             "executed": d["executed"]}
+            for d in remediation.decisions
+        ]
+
+
+def _soak_train_fn(args, ctx):
+    """Executor-side feed loop publishing the real per-executor
+    telemetry the health plane scrapes, with the chaos hooks wrapping
+    the feed and the step counter (slow_executor lands in feed_wait;
+    a plan ``kill`` SIGKILLs the compute process at its step)."""
+    import time as _t
+
+    import numpy as np
+
+    from tensorflowonspark_tpu import telemetry, tensorboard
+    from tensorflowonspark_tpu.testing import chaos as _chaos
+
+    reg = telemetry.get_registry()
+    h_step = reg.histogram("train.step_sec")
+    h_feed = reg.histogram("train.feed_wait_sec")
+    steps = reg.counter("train.steps")
+    feed = ctx.get_data_feed(train_mode=True)
+    delay = _chaos.slow_feed_fn(ctx)
+    if delay is not None:
+        feed = _chaos.SlowFeed(feed, delay)
+    kill = _chaos.step_fault_fn(ctx)
+    n = 0
+    while not feed.should_stop():
+        t0 = _t.perf_counter()
+        rows = feed.next_batch(4)
+        h_feed.observe(_t.perf_counter() - t0)
+        if not rows:
+            continue
+        t1 = _t.perf_counter()
+        float(np.sum(np.asarray(rows, dtype=np.float64)))
+        _t.sleep(0.004)
+        h_step.observe(_t.perf_counter() - t1)
+        steps.inc()
+        n += 1
+        if kill is not None:
+            kill(n)
+        tensorboard.profile_step()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m tensorflowonspark_tpu.testing.soak",
+        description="all-faults soak over a live training + serving "
+                    "stack (module docstring)",
+    )
+    p.add_argument("--minutes", type=float, default=5.0,
+                   help="load budget in minutes (default 5)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="schedule/prompt seed (default 0)")
+    p.add_argument("--replicas", type=int, default=3)
+    p.add_argument("--fast", action="store_true",
+                   help="serving-plane only (no training cluster): "
+                        "the deterministic tier-1 lane")
+    p.add_argument("--report", default="soak_report.json",
+                   help="JSON report path (default soak_report.json)")
+    args = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    runner = SoakRunner(
+        minutes=args.minutes, seed=args.seed,
+        include_training=not args.fast, replicas=args.replicas,
+        report_path=args.report,
+    )
+    try:
+        report = runner.run()
+    except InvariantViolation as e:
+        logger.error("SOAK FAILED: %s", e)
+        runner.report["violation"] = str(e)
+        if args.report:
+            with open(args.report, "w") as f:
+                json.dump(runner.report, f, indent=2, default=str)
+        return 1
+    print(json.dumps({
+        "passed": report["passed"],
+        "waves": len(report["waves"]),
+        "faults_injected": len(report["faults"]),
+        "wall_sec": report.get("wall_sec"),
+        "report": args.report,
+    }, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

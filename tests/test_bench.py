@@ -155,6 +155,24 @@ def _full_record():
             "ttft_p50_ms": 26.2, "ttft_p99_ms": 409.7,
             "serving_disagg_p99_gain": 0.996, "token_exact": True,
         },
+        "serving_faults": {
+            "slots": 2, "max_new_tokens": 12, "rows": 24,
+            "kill_prefill": {"clean_rows_per_sec": 96.7,
+                             "fault_rows_per_sec": 89.7,
+                             "fault_recovery_sec": 0.019,
+                             "fault_goodput_dip_pct": 7.24,
+                             "token_exact": True,
+                             "pool_balanced": True},
+            "kill_replica": {"clean_rows_per_sec": 98.8,
+                             "fault_rows_per_sec": 95.5,
+                             "fault_recovery_sec": 0.009,
+                             "fault_goodput_dip_pct": 3.42,
+                             "token_exact": True,
+                             "redispatch_sec": 0.03,
+                             "redispatched": 5},
+            "fault_recovery_sec": 0.019,
+            "fault_goodput_dip_pct": 7.24, "dropped": 0,
+        },
         "serving_tpu": {"mnist": {"rows_per_sec": 643.2},
                         "resnet50": {"rows_per_sec": 51.5,
                                      "wire_mb_per_batch": 38.535},
@@ -246,6 +264,10 @@ def test_summary_is_compact_standalone_json(tmp_path):
     # TTFT p99 ratio + the split engine's TTFT p50
     assert parsed["serving_disagg_p99_gain"] == 0.996
     assert parsed["serving_ttft_ms"] == 26.2
+    # fault-containment plane (ISSUE 19): worst-of-two contained
+    # faults' added wall + goodput dip
+    assert parsed["fault_recovery_sec"] == 0.019
+    assert parsed["fault_goodput_dip_pct"] == 7.24
     # auto-parallelism planner plane (ISSUE 18): worst-case gap of
     # config="auto" vs hand-tuned, and the exactly-one-re-plan count
     # from the injected-drift mini-run
@@ -283,6 +305,7 @@ def test_summary_keys_are_exactly_the_headline_set(tmp_path):
         "serving_prefix_gain", "spec_accept_rate",
         "paged_admit_gain", "int4_tok_s",
         "serving_disagg_p99_gain", "serving_ttft_ms",
+        "fault_recovery_sec", "fault_goodput_dip_pct",
         "planner_gap_pct", "replan_events",
         "async_ps_compressed_steps_s",
         "async_vs_sync", "hier_ps_vs_sync", "feed_wire_mb_per_step",

@@ -1,0 +1,460 @@
+"""Fault containment for the disaggregated serving plane (ISSUE 19).
+
+Four layers, bottom up:
+
+- **lease lifecycle** — ``PagePool`` handoff leases carry an owner and
+  a deadline; ``reap_orphans`` reclaims leases orphaned by a dead or
+  wedged PrefillWorker with refcounts provably balanced (pure pool
+  unit tests, no jax);
+- **prefill supervision** — the chaos faults ``kill_prefill`` /
+  ``wedge_prefill`` / ``leak_lease`` are contained by the engine
+  (reap → unified-path re-prefill) TOKEN-IDENTICALLY to a fault-free
+  run, with the recovery journaled at page severity and the whole
+  story on the request's original trace id;
+- **property sweep** — every serving-side chaos family leaves the
+  page pool balanced: refcount census equals the radix cache's
+  committed pages at one reference each, nothing in flight, the
+  reserved trash page parked;
+- **the soak harness** — the fast serving-only all-faults soak
+  (testing/soak.py) runs in tier-1 via its CLI entry point; the full
+  5-minute training+serving acceptance soak stays behind ``-m slow``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from tensorflowonspark_tpu import serving, serving_engine, telemetry  # noqa: E402
+from tensorflowonspark_tpu.models import transformer as tr  # noqa: E402
+from tensorflowonspark_tpu.prefix_cache import (  # noqa: E402
+    PagePool, PoolExhausted,
+)
+from tensorflowonspark_tpu.telemetry import journal as journal_mod  # noqa: E402
+from tensorflowonspark_tpu.testing import chaos  # noqa: E402
+from tensorflowonspark_tpu.testing import soak as soak_mod  # noqa: E402
+
+pytestmark = [pytest.mark.chaos, pytest.mark.chaos_serving]
+
+#: the flagship disaggregated stack at test size (test_serving_disagg)
+FLAGSHIP = {
+    "vocab_size": 64, "num_layers": 2, "num_heads": 4,
+    "num_kv_heads": 2, "head_dim": 8, "embed_dim": 16, "mlp_dim": 32,
+    "max_seq_len": 128, "dtype": "float32", "attention_window": 48,
+    "cache_dtype": "int8",
+}
+DISAGG = {
+    "kv_layout": "paged", "prefix_cache": True, "prefix_block": 8,
+    "disaggregate": True,
+}
+
+
+def _gen_predict(seed=0, max_new=6):
+    """A FRESH predictor per test: the chaos prefill hooks arm on the
+    predictor's cached decoder, so sharing one across differently-
+    planned tests would leak one plan's spent-fault state into the
+    next."""
+    model = tr.Transformer(tr.TransformerConfig(**FLAGSHIP))
+    params = jax.tree.map(np.asarray, model.init(
+        jax.random.PRNGKey(seed), jnp.zeros((1, 8), jnp.int32)
+    )["params"])
+    return tr.serving_builder(params, dict(
+        FLAGSHIP, mode="generate", max_new_tokens=max_new,
+        pad_multiple=16, **DISAGG
+    ))
+
+
+def _rows(lens, seed=3, vocab=64):
+    rng = np.random.RandomState(seed)
+    return [{"prompt": rng.randint(1, vocab, (n,)).astype(np.int32)}
+            for n in lens]
+
+
+def _serve(predict, rows, mapping=None, **kw):
+    stats = {}
+    out = list(serving.predict_rows(
+        predict, [dict(r) for r in rows],
+        mapping or {"prompt": "tokens"}, batch_size=2,
+        schedule="continuous", stats=stats, **kw
+    ))
+    return out, stats
+
+
+def _tokens(out):
+    return [list(map(int, r["generated"])) for r in out]
+
+
+def _warm_reference(predict, rows, monkeypatch, mapping=None):
+    """Reference run BEFORE the plan is advertised — the repo's
+    warm-first convention: watchdog timeouts assume compiled programs
+    (a cold compile under the watchdog fires it spuriously)."""
+    monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+    out, _ = _serve(predict, rows, mapping=mapping)
+    return out
+
+
+def _arm(plan, tmp_path, monkeypatch):
+    path = plan.save(str(tmp_path / "plan.json"))
+    monkeypatch.setenv(chaos.TFOS_CHAOS_PLAN, path)
+
+
+# ----------------------------------------------------------------------
+# lease lifecycle (pure PagePool, no jax)
+# ----------------------------------------------------------------------
+
+
+class TestLeaseLifecycle:
+    def test_lease_names_owner_age_and_deadline(self):
+        clk = [0.0]
+        pool = PagePool(8, clock=lambda: clk[0])
+        pages = pool.alloc(3)
+        lease = pool.begin_handoff(pages, owner="req-7",
+                                   deadline_sec=2.0)
+        clk[0] = 1.0
+        (rec,) = pool.handoff_leases()
+        assert rec["lease"] == lease
+        assert rec["owner"] == "req-7"
+        assert rec["pages"] == 3
+        assert rec["age_sec"] == pytest.approx(1.0)
+        assert rec["deadline_sec"] == 2.0 and not rec["expired"]
+        assert "req-7" in pool.lease_table()
+        clk[0] = 3.5
+        assert pool.handoff_leases()[0]["expired"]
+        assert "EXPIRED" in pool.lease_table()
+
+    def test_reap_by_owner_balances_refcounts(self):
+        pool = PagePool(8)
+        pages = pool.alloc(3)
+        pool.begin_handoff(pages, owner="req-7")
+        reaped = pool.reap_orphans(owner="req-7")
+        assert [r["owner"] for r in reaped] == ["req-7"]
+        assert pool.refcount_census() == {}
+        stats = pool.stats()
+        assert stats["pool_pages_handoff"] == 0
+        assert stats["pool_leases"] == 0
+        assert pool.available() == 7  # every non-reserved page free
+
+    def test_reap_by_deadline_touches_only_expired(self):
+        clk = [0.0]
+        pool = PagePool(16, clock=lambda: clk[0])
+        old, young = pool.alloc(2), pool.alloc(2)
+        pool.begin_handoff(old, owner="old", deadline_sec=0.5)
+        pool.begin_handoff(young, owner="young", deadline_sec=5.0)
+        clk[0] = 1.0
+        reaped = pool.reap_orphans()
+        assert [r["owner"] for r in reaped] == ["old"]
+        assert [r["owner"] for r in pool.handoff_leases()] == ["young"]
+        # an un-deadlined lease is owner-reapable only, never by age
+        forever = pool.alloc(1)
+        pool.begin_handoff(forever, owner="forever")
+        clk[0] = 1e9
+        assert all(r["owner"] != "forever"
+                   for r in pool.reap_orphans())
+        assert any(r["owner"] == "forever"
+                   for r in pool.handoff_leases())
+
+    def test_reap_of_shared_page_releases_exactly_one_ref(self):
+        # cached-prefix pages enter a handoff RETAINED once on top of
+        # the radix's reference; reaping must return exactly that one
+        pool = PagePool(8)
+        pages = pool.alloc(2)  # the "radix" reference
+        pool.retain(pages)     # the handoff's reference
+        pool.begin_handoff(pages, owner="req-1")
+        pool.reap_orphans(owner="req-1")
+        assert pool.refcount_census() == {int(p): 1 for p in pages}
+        pool.release(pages)
+        assert pool.refcount_census() == {}
+
+    def test_pool_exhausted_names_the_owning_lease(self):
+        pool = PagePool(4)
+        pool.begin_handoff(pool.alloc(3), owner="req-42")
+        with pytest.raises(PoolExhausted, match="req-42"):
+            pool.alloc(2)
+
+    def test_end_handoff_drains_leases_page_by_page(self):
+        pool = PagePool(8)
+        pages = pool.alloc(4)
+        pool.begin_handoff(pages, owner="r")
+        pool.end_handoff(pages[:2])
+        assert pool.stats()["pool_leases"] == 1  # partially drained
+        pool.end_handoff(pages[2:])
+        assert pool.stats()["pool_leases"] == 0
+        assert pool.stats()["pool_pages_handoff"] == 0
+
+    def test_reserved_trash_pages_never_allocated(self):
+        pool = PagePool(4, reserved=2)
+        got = pool.alloc(2)
+        assert min(got) >= 2
+        with pytest.raises(PoolExhausted):
+            pool.alloc(1)
+        assert all(p >= 2 for p in pool.refcount_census())
+
+
+# ----------------------------------------------------------------------
+# prefill supervision: chaos faults contained token-identically
+# ----------------------------------------------------------------------
+
+
+LENS = (12, 9, 17, 8, 21, 11)
+
+
+class TestPrefillContainment:
+    def test_kill_prefill_recovers_token_identical(
+            self, tmp_path, monkeypatch):
+        predict = _gen_predict()
+        rows = _rows(LENS)
+        ref = _warm_reference(predict, rows, monkeypatch)
+        _arm(chaos.ChaosPlan().kill_prefill(at_admit=1), tmp_path,
+             monkeypatch)
+        out, stats = _serve(predict, rows, watchdog_timeout=1.0)
+        assert _tokens(out) == _tokens(ref)
+        assert stats["prefill_worker_deaths"] == 1
+        assert stats["prefill_restarts"] >= 1
+        assert stats["leases_reaped"] >= 1
+        assert stats["errors"] == 0
+        ev = journal_mod.get_journal().events(
+            kind="prefill_worker_dead")
+        assert ev and ev[-1].severity == "page"
+
+    def test_wedge_prefill_watchdog_fires_and_recovers(
+            self, tmp_path, monkeypatch):
+        predict = _gen_predict(seed=1)
+        rows = _rows(LENS, seed=5)
+        ref = _warm_reference(predict, rows, monkeypatch)
+        _arm(chaos.ChaosPlan().wedge_prefill(at_admit=1, hang_sec=5.0),
+             tmp_path, monkeypatch)
+        out, stats = _serve(predict, rows, watchdog_timeout=1.0)
+        assert _tokens(out) == _tokens(ref)
+        assert stats["prefill_watchdog_fires"] == 1
+        assert stats["errors"] == 0
+        ev = journal_mod.get_journal().events(
+            kind="prefill_watchdog_fire")
+        assert ev and ev[-1].severity == "page"
+
+    def test_leaked_lease_reaped_by_deadline(
+            self, tmp_path, monkeypatch):
+        predict = _gen_predict(seed=2)
+        rows = _rows(LENS, seed=9)
+        ref = _warm_reference(predict, rows, monkeypatch)
+        journal_mod.get_journal().clear()
+        # zero deadline: expired by the very next scheduling pass (a
+        # warm 6-row serve can finish inside any real deadline)
+        _arm(chaos.ChaosPlan().leak_lease(at_admit=1,
+                                          deadline_sec=0.0),
+             tmp_path, monkeypatch)
+        out, stats = _serve(predict, rows, watchdog_timeout=1.0)
+        assert _tokens(out) == _tokens(ref)
+        assert stats["leases_reaped"] >= 1
+        assert stats["errors"] == 0
+        ev = journal_mod.get_journal().events(kind="lease_reaped")
+        assert ev and ev[-1].severity == "page"
+        assert ev[-1].attrs.get("owner") == "chaos:leak_lease"
+
+    def test_recovery_rides_the_original_trace(
+            self, tmp_path, monkeypatch):
+        # the stranded request's unified re-prefill continues the SAME
+        # trace id: one merged story per request, fault or no fault
+        predict = _gen_predict(seed=3)
+        rows = _rows(LENS, seed=11)
+        for i, r in enumerate(rows):
+            r["trace"] = "contain-%d" % i
+        mapping = {"prompt": "tokens", "trace": "trace_id"}
+        tracer = telemetry.get_tracer()
+        _warm_reference(predict, rows, monkeypatch, mapping=mapping)
+        _arm(chaos.ChaosPlan().kill_prefill(at_admit=1), tmp_path,
+             monkeypatch)
+        tracer.clear()
+        out, stats = _serve(predict, rows, mapping=mapping,
+                            watchdog_timeout=1.0)
+        assert stats["prefill_worker_deaths"] == 1
+        recovered = [
+            s for i in range(len(rows))
+            for s in tracer.spans(trace="contain-%d" % i)
+            if s["name"] == "prefill"
+            and s["attrs"].get("prefill_recovered")
+        ]
+        assert len(recovered) == 1
+        trace_id = recovered[0]["trace"]
+        kinds = [s["name"] for s in tracer.spans(trace=trace_id)]
+        for expected in ("admission", "prefill", "decode_chunk",
+                         "emit"):
+            assert expected in kinds, (trace_id, kinds)
+
+
+# ----------------------------------------------------------------------
+# property sweep: every family leaves the pool balanced
+# ----------------------------------------------------------------------
+
+
+def _family_plans():
+    return [
+        ("kill_prefill",
+         lambda p: p.kill_prefill(at_admit=1)),
+        ("wedge_prefill",
+         lambda p: p.wedge_prefill(at_admit=1, hang_sec=3.0)),
+        ("leak_lease",
+         lambda p: p.leak_lease(at_admit=1, deadline_sec=0.0)),
+        ("wedge_dispatch",
+         lambda p: p.wedge_dispatch(at_chunk=2, hang_sec=3.0)),
+        ("poison_rows", None),
+    ]
+
+
+class TestPoolBalanceSweep:
+    @pytest.mark.parametrize(
+        "family,arm", _family_plans(),
+        ids=[f for f, _ in _family_plans()],
+    )
+    def test_family_leaves_pool_balanced(self, family, arm, tmp_path,
+                                         monkeypatch):
+        predict = _gen_predict(seed=4)
+        rows = _rows(LENS, seed=13)
+        _warm_reference(predict, rows, monkeypatch)
+        if arm is not None:
+            plan = chaos.ChaosPlan()
+            arm(plan)
+            _arm(plan, tmp_path, monkeypatch)
+        load = [dict(r) for r in rows]
+        if family == "poison_rows":
+            load.insert(2, chaos.poison_row("bad_dtype"))
+        eng = serving_engine.ServingEngine(
+            predict, {"prompt": "tokens"}, None, 2,
+            on_error="record", watchdog_timeout=1.0,
+        )
+        out = list(eng.serve(load))
+        assert len(out) == len(load)
+        errors = sum(1 for r in out if "error" in r)
+        assert errors == (1 if family == "poison_rows" else 0)
+        rep = soak_mod.pool_balance_probe(eng.decoder, grace_sec=5.0)
+        assert rep["balanced"], rep
+        assert rep["trash_referenced"] == []
+
+    def test_probe_raises_on_an_actual_leak(self):
+        # the probe itself must be falsifiable: a page held outside
+        # the radix census is a named violation, not a pass
+        predict = _gen_predict(seed=5)
+        eng = serving_engine.ServingEngine(
+            predict, {"prompt": "tokens"}, None, 2, on_error="record",
+        )
+        list(eng.serve(_rows((8, 10))))
+        leak = eng.decoder.page_pool.alloc(1)
+        try:
+            with pytest.raises(soak_mod.InvariantViolation,
+                               match="never rebalanced"):
+                soak_mod.pool_balance_probe(eng.decoder,
+                                            grace_sec=0.2)
+        finally:
+            eng.decoder.page_pool.release(leak)
+
+
+# ----------------------------------------------------------------------
+# the all-faults soak harness
+# ----------------------------------------------------------------------
+
+
+class TestSoakHarness:
+    def test_fast_soak_cli_all_serving_faults(self, tmp_path,
+                                              monkeypatch):
+        # the tier-1 CI lane: seeded, serving-only, deterministic
+        # schedule — every serving fault family injected, contained
+        # and named, well under a minute
+        monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+        report_path = str(tmp_path / "soak_report.json")
+        rc = soak_mod.main([
+            "--fast", "--minutes", "0.02", "--seed", "7",
+            "--report", report_path,
+        ])
+        assert rc == 0
+        with open(report_path) as f:
+            report = json.load(f)
+        assert report["passed"] is True
+        assert report["mode"] == "serving_only"
+        assert report["waves"]
+        led = report["invariants"]["ledger"]
+        assert led["chip_sec"] == pytest.approx(
+            led["decode_wall_sec"], rel=1e-6
+        )
+        named = set(report["invariants"]["forensics"]["named"])
+        assert {
+            "kill_prefill", "wedge_prefill", "leak_lease",
+            "wedge_dispatch", "device_error", "kill_replica",
+        } <= named
+        injected = {f["kind"] for f in report["faults"]}
+        assert "poison_rows" in injected
+
+    def test_schedule_is_seed_deterministic(self):
+        a = soak_mod.SoakRunner(seed=11, include_training=False)
+        b = soak_mod.SoakRunner(seed=11, include_training=False)
+        assert a._serving_plan().faults == b._serving_plan().faults
+        assert a.report["faults"] == b.report["faults"]
+
+    def test_forensics_naming_survives_journal_ring_eviction(self):
+        # regression: a 5-minute soak's serving traffic evicts the
+        # minute-one straggler_flagged event from the journal's
+        # bounded severity rings before the end-of-run probe reads
+        # them — the runner samples named families each wave, and a
+        # family once named must stay named
+        j = journal_mod.get_journal()
+        j.clear()
+        runner = soak_mod.SoakRunner(include_training=False)
+        try:
+            j.emit("straggler_flagged", severity="warn",
+                   trace="fleet", executor=1)
+            runner._snapshot_named_families()
+            j.clear()  # ring eviction, taken to the limit
+            runner.report["faults"] = [
+                {"kind": "slow_executor", "plane": "training"}
+            ]
+            out = runner._forensics_probe()
+            assert "slow_executor" in out["named"]
+        finally:
+            j.clear()
+
+    def test_ledger_probe_survives_row_eviction(self):
+        # regression: a long soak pushes more requests than the
+        # bounded ledger retains (max_rows closed-row LRU); the
+        # exactness probe must count the evicted remainder, not fail
+        # the moment the 4097th request's row evicts the 1st
+        from tensorflowonspark_tpu.telemetry import (
+            ledger as ledger_mod,
+        )
+
+        led = ledger_mod.UsageLedger(max_rows=4)
+        for i in range(16):
+            led.settle("req-%d" % i, tokens_in=1, tokens_out=1,
+                       chip_sec=0.125)
+        assert led.rows_evicted > 0
+
+        class _R:
+            stats = {"decode_wall_sec": 16 * 0.125}
+
+        out = soak_mod.ledger_probe(_R(), led)
+        assert out["chip_sec"] == pytest.approx(2.0)
+
+    @pytest.mark.slow
+    def test_full_soak_five_minutes_all_families(self, tmp_path,
+                                                 monkeypatch):
+        # the acceptance soak: live hier-training cluster + fleet
+        # serving (one disaggregated engine) under EVERY chaos family
+        monkeypatch.delenv(chaos.TFOS_CHAOS_PLAN, raising=False)
+        runner = soak_mod.SoakRunner(
+            minutes=5.0, seed=7, include_training=True, replicas=3,
+            report_path=str(tmp_path / "soak_report.json"),
+        )
+        report = runner.run()
+        assert report["passed"] is True
+        named = set(report["invariants"]["forensics"]["named"])
+        assert {
+            "kill_prefill", "wedge_prefill", "leak_lease",
+            "wedge_dispatch", "device_error", "kill_replica",
+            "kill", "kill_leader", "slow_executor",
+            "corrupt_checkpoint",
+        } <= named
+        executed = {
+            d["action"] for d in report["remediation_decisions"]
+            if d["executed"]
+        }
+        assert "elastic_shrink" in executed

@@ -874,3 +874,117 @@ class TestSurface:
             for s in snap
         )
         router.close()
+
+
+# ----------------------------------------------------------------------
+# device-error quarantine containment (ISSUE 19 tentpole)
+# ----------------------------------------------------------------------
+
+
+class TestQuarantineFake:
+    def test_device_error_quarantines_not_kills(self, tmp_path):
+        # a device error is CONTAINED: the replica quarantines (state
+        # "routed_around", engine rebuilt, probe traffic) instead of
+        # dying, and the router re-dispatches committed-token-safe —
+        # the stream stays token-identical to the single-engine oracle
+        rows = _prompts([5, 7, 3, 9, 4, 6, 8, 5, 7, 3, 9, 4])
+        ref = _fake_reference(rows, max_new=12, chunk=2)
+        plan = chaos.ChaosPlan().device_error(0, at_chunk=2)
+        path = plan.save(str(tmp_path / "plan.json"))
+        os.environ[chaos.TFOS_CHAOS_PLAN] = path
+        try:
+            router = _fake_router(n=2, slots=2, max_new=12, chunk=2)
+            out = list(router.serve([dict(r) for r in rows]))
+            router.close()
+        finally:
+            del os.environ[chaos.TFOS_CHAOS_PLAN]
+        assert len(out) == len(rows)
+        assert all("error" not in r for r in out)
+        assert all(_same_tokens(a, b) for a, b in zip(ref, out))
+        assert router.stats["quarantined"] == 1
+        assert router.stats["replica_deaths"] == 0
+        rep = router.replicas[0]
+        assert rep.alive
+        assert rep.state in ("live", "routed_around")
+        j = journal_mod.get_journal()
+        ev = j.events(kind="replica_quarantined")
+        assert ev and ev[-1].severity == "page"
+
+
+# ----------------------------------------------------------------------
+# gated re-admission (ISSUE 19 satellite: CleanRoundsSensor seam)
+# ----------------------------------------------------------------------
+
+
+class _StubGate(object):
+    """The readmit_gate surface (poll/ready/streak/rounds) with a
+    hand-operated valve — the router contract test; the real
+    CleanRoundsSensor is covered in tests/test_health.py."""
+
+    def __init__(self):
+        self.open = False
+        self.polls = 0
+        self.rounds = 3
+
+    @property
+    def streak(self):
+        return self.rounds if self.open else 0
+
+    def poll(self):
+        self.polls += 1
+
+    def ready(self):
+        return self.open
+
+
+class TestReadmitGate:
+    def _slow_router(self, gate):
+        return FleetRouter(
+            None, {"prompt": "tokens"}, replicas=2, num_slots=1,
+            predict_factory=lambda: FakePredict(
+                chunk=4, max_new=4, delay=0.015
+            ),
+            replica_queue_depth=1, poll_sec=0.01,
+            suspect_rounds=1, probe_every=2, readmit_rounds=2,
+            min_slow_sec=0.1, slow_factor=3.0, readmit_gate=gate,
+        )
+
+    def test_gate_holds_then_releases_readmission(self, tmp_path):
+        plan = chaos.ChaosPlan().slow_replica(
+            0, per_chunk_sec=0.3, chunks=2
+        )
+        path = plan.save(str(tmp_path / "plan.json"))
+        os.environ[chaos.TFOS_CHAOS_PLAN] = path
+        gate = _StubGate()
+        try:
+            router = self._slow_router(gate)
+            # first stream: the straggler is evicted, probes clean,
+            # but the CLOSED gate must hold the re-admission
+            out1 = list(router.serve(
+                [dict(r) for r in _prompts([4] * 80)]
+            ))
+            assert len(out1) == 80
+            assert router.stats["evicted"] >= 1
+            assert router.stats["readmitted"] == 0
+            assert router.replicas[0].state == "routed_around"
+            assert gate.polls >= 1
+            j = journal_mod.get_journal()
+            gated = j.events(kind="readmit_gated")
+            assert gated
+            attrs = gated[-1].attrs
+            assert attrs["required_rounds"] == gate.rounds
+            assert attrs["clean_health_rounds"] == 0
+            # second stream over the SAME warm fleet (serve is
+            # re-entrant): the gate is open now — clean probe rounds
+            # re-admit the replica and journal the release
+            gate.open = True
+            out2 = list(router.serve(
+                [dict(r) for r in _prompts([4] * 40, seed=11)]
+            ))
+            router.close()
+        finally:
+            del os.environ[chaos.TFOS_CHAOS_PLAN]
+        assert len(out2) == 40
+        assert router.stats["readmitted"] >= 1
+        assert router.replicas[0].state == "live"
+        assert journal_mod.get_journal().events(kind="readmit_cleared")

@@ -812,3 +812,82 @@ def test_cluster_monitor_note_straggler():
     mon.clear_straggler(2)
     assert mon.health_hints == {}
     mon.clear_straggler(2)  # idempotent
+
+
+# ----------------------------------------------------------------------
+# CleanRoundsSensor: the quality gate over the plane (ISSUE 19)
+# ----------------------------------------------------------------------
+
+
+class _FakeStore:
+    def __init__(self):
+        self.scrapes = 0
+
+
+class _FakeSlo:
+    def __init__(self):
+        self.firing = False
+
+    def active(self):
+        return ["alert"] if self.firing else []
+
+
+class _FakePlane:
+    def __init__(self):
+        self.hints = {}
+        self.slo = _FakeSlo()
+        self.store = _FakeStore()
+
+
+class TestCleanRoundsSensor:
+    def test_streak_advances_once_per_scrape_round(self):
+        plane = _FakePlane()
+        gate = health.CleanRoundsSensor(plane, rounds=3)
+        assert not gate.ready()
+        # many polls inside one round fold together
+        plane.store.scrapes = 1
+        for _ in range(5):
+            gate.poll()
+        assert gate.streak == 1
+        plane.store.scrapes = 2
+        gate.poll()
+        plane.store.scrapes = 3
+        assert gate.poll() is True
+        assert gate.ready()
+
+    def test_straggler_hint_resets_the_streak_mid_round(self):
+        plane = _FakePlane()
+        gate = health.CleanRoundsSensor(plane, rounds=2)
+        plane.store.scrapes = 1
+        gate.poll()
+        plane.store.scrapes = 2
+        gate.poll()
+        assert gate.ready()
+        # unhealth must never be smoothed away: a hint zeroes the
+        # streak even without a new scrape
+        plane.hints = {2: {"phase": "feed"}}
+        assert gate.poll() is False
+        assert gate.streak == 0
+        plane.hints = {}
+        plane.store.scrapes = 3
+        gate.poll()
+        assert not gate.ready()  # must re-earn ALL rounds
+
+    def test_firing_slo_alert_is_dirty(self):
+        plane = _FakePlane()
+        gate = health.CleanRoundsSensor(plane, rounds=1)
+        plane.slo.firing = True
+        plane.store.scrapes = 1
+        assert gate.poll() is False
+        plane.slo.firing = False
+        plane.store.scrapes = 2
+        assert gate.poll() is True
+
+    def test_reset_forgets_the_streak_and_round(self):
+        plane = _FakePlane()
+        gate = health.CleanRoundsSensor(plane, rounds=1)
+        plane.store.scrapes = 1
+        gate.poll()
+        assert gate.ready()
+        gate.reset()
+        assert gate.streak == 0 and not gate.ready()

@@ -1437,3 +1437,136 @@ class TestCombinedChaosE2E:
         finally:
             engine.stop()
             os.environ.pop(chaos.TFOS_CHAOS_PLAN, None)
+
+
+# ----------------------------------------------------------------------
+# ISSUE 19: the prefill-restart verb + the gated elastic release
+# ----------------------------------------------------------------------
+
+
+class _StubDisaggEngine:
+    def __init__(self):
+        self._prefill_worker = object()
+        self.restarts = 0
+
+    def restart_prefill_worker(self, reason=None):
+        self.restarts += 1
+        self.reason = reason
+
+
+class _StubReplica:
+    def __init__(self, rid, engine, alive=True):
+        self.replica_id = rid
+        self.engine = engine
+        self.alive = alive
+
+
+class _StubFleet:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+
+class _StubCluster:
+    def __init__(self):
+        self.held = []
+        self.released = []
+
+    def hold_executor(self, executor, reason=None):
+        self.held.append(executor)
+        return executor
+
+    def release_executor(self, executor):
+        self.released.append(executor)
+        return executor
+
+
+class _HandGate:
+    """The CleanRoundsSensor surface with a hand-operated valve (the
+    real sensor is covered in tests/test_health.py)."""
+
+    def __init__(self, open=False, rounds=3):
+        self.open = open
+        self.rounds = rounds
+        self.polls = 0
+
+    @property
+    def streak(self):
+        return self.rounds if self.open else 1
+
+    def poll(self):
+        self.polls += 1
+
+    def ready(self):
+        return self.open
+
+
+class TestRestartPrefillVerb:
+    def test_combined_falls_through_cluster_to_fleet(self):
+        from tensorflowonspark_tpu.remediation import (
+            ClusterActuators,
+            CombinedActuators,
+            FleetActuators,
+        )
+
+        disagg = _StubDisaggEngine()
+        fleet = _StubFleet([
+            _StubReplica(0, _StubDisaggEngine(), alive=False),
+            _StubReplica(1, object()),      # not disaggregated
+            _StubReplica(2, disagg),
+        ])
+        acts = CombinedActuators(
+            ClusterActuators(_StubCluster()),   # no prefill verb
+            FleetActuators(fleet),
+        )
+        assert acts.restart_prefill() == [2]
+        assert disagg.restarts == 1
+        assert disagg.reason == "remediation"
+        # dead replica 0's worker was left alone
+        assert fleet.replicas[0].engine.restarts == 0
+
+    def test_restart_prefill_refuses_without_a_disagg_engine(self):
+        from tensorflowonspark_tpu.remediation import FleetActuators
+
+        acts = FleetActuators(_StubFleet([_StubReplica(0, object())]))
+        with pytest.raises(UnsupportedAction, match="disaggregated"):
+            acts.restart_prefill()
+
+
+class TestGatedElasticRelease:
+    def test_grow_refuses_while_gate_is_closed(self):
+        from tensorflowonspark_tpu.remediation import ClusterActuators
+
+        cluster = _StubCluster()
+        gate = _HandGate(open=False)
+        acts = ClusterActuators(cluster, release_gate=gate)
+        # shrink is NEVER gated (getting unhealthy capacity out must
+        # not wait on the plane being clean)
+        assert acts.elastic_shrink(3) == 3
+        with pytest.raises(UnsupportedAction, match="1/3 clean"):
+            acts.elastic_grow(3)
+        assert cluster.released == []
+        ev = journal_mod.get_journal().events(kind="readmit_gated")
+        assert ev and ev[-1].trace == "remediation"
+        assert ev[-1].attrs["required_rounds"] == 3
+        # journaled once per blocked streak, not per refusal
+        n = len(journal_mod.get_journal().events(kind="readmit_gated"))
+        with pytest.raises(UnsupportedAction):
+            acts.elastic_grow(3)
+        assert len(
+            journal_mod.get_journal().events(kind="readmit_gated")
+        ) == n
+
+    def test_grow_releases_once_gate_opens(self):
+        from tensorflowonspark_tpu.remediation import ClusterActuators
+
+        cluster = _StubCluster()
+        gate = _HandGate(open=False)
+        acts = ClusterActuators(cluster, release_gate=gate)
+        with pytest.raises(UnsupportedAction):
+            acts.elastic_grow(2)
+        gate.open = True
+        assert acts.elastic_grow(2) == 2
+        assert cluster.released == [2]
+        ev = journal_mod.get_journal().events(kind="readmit_cleared")
+        assert ev and ev[-1].trace == "remediation"
+        assert ev[-1].attrs["executor"] == 2
